@@ -1,0 +1,154 @@
+"""L1 data model tests (reference analog: tests/common/unittest_common.cc)."""
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import (
+    Buffer,
+    Caps,
+    DataType,
+    IntRange,
+    TensorFormat,
+    TensorSpec,
+    TensorsInfo,
+    ValueList,
+    caps_from_tensors_info,
+    parse_caps_string,
+    tensors_info_from_caps,
+)
+from nnstreamer_tpu.core.tensors import validate_arrays
+from nnstreamer_tpu.core.data import TypedValue, parse_number
+
+
+class TestDataType:
+    def test_round_trip_numpy(self):
+        for dt in DataType:
+            assert DataType.from_any(dt.np_dtype) is dt
+
+    def test_bfloat16(self):
+        assert DataType.BFLOAT16.itemsize == 2
+        a = np.zeros(3, DataType.BFLOAT16.np_dtype)
+        assert DataType.from_any(a.dtype) is DataType.BFLOAT16
+
+    def test_from_string(self):
+        assert DataType.from_any("uint8") is DataType.UINT8
+        assert DataType.from_any(np.float32) is DataType.FLOAT32
+
+
+class TestTensorSpec:
+    def test_dim_string_round_trip(self):
+        # reference order: lowest dim first ("3:224:224:1" = NHWC (1,224,224,3))
+        s = TensorSpec.from_dim_string("3:224:224:1", "uint8")
+        assert s.shape == (1, 224, 224, 3)
+        assert s.to_dim_string() == "3:224:224:1"
+        assert s.nbytes == 224 * 224 * 3
+
+    def test_unfixated(self):
+        s = TensorSpec((None, 224, 224, 3))
+        assert not s.is_fixated
+        with pytest.raises(ValueError):
+            s.num_elements
+
+    def test_matches(self):
+        s = TensorSpec((2, 3), "float32")
+        assert s.matches(np.zeros((2, 3), np.float32))
+        assert not s.matches(np.zeros((2, 3), np.float64))
+        assert not s.matches(np.zeros((2, 4), np.float32))
+
+    def test_rank_limit(self):
+        with pytest.raises(ValueError):
+            TensorSpec((1,) * 17)
+
+
+class TestTensorsInfo:
+    def test_fields_round_trip(self):
+        info = TensorsInfo.of(
+            TensorSpec((1, 224, 224, 3), "uint8"), TensorSpec((1, 1001), "float32")
+        )
+        back = TensorsInfo.from_fields(info.to_fields())
+        assert info.is_equal(back)
+        assert back.num_tensors == 2
+
+    def test_is_equal_ignores_names(self):
+        a = TensorsInfo.of(TensorSpec((2, 2), "float32", "x"))
+        b = TensorsInfo.of(TensorSpec((2, 2), "float32", "y"))
+        assert a.is_equal(b)
+
+    def test_validate_arrays(self):
+        info = TensorsInfo.of(TensorSpec((2, 3), "float32"))
+        validate_arrays(info, [np.zeros((2, 3), np.float32)])
+        with pytest.raises(ValueError):
+            validate_arrays(info, [np.zeros((2, 3), np.int32)])
+        with pytest.raises(ValueError):
+            validate_arrays(info, [])
+
+
+class TestCaps:
+    def test_intersect_fixed(self):
+        a = Caps.new("other/tensors", format="static", num_tensors=1)
+        b = Caps.new("other/tensors", format="static")
+        i = a.intersect(b)
+        assert not i.is_empty
+        assert i.first.get("num_tensors") == 1
+
+    def test_intersect_mismatch(self):
+        a = Caps.new("other/tensors", format="static")
+        b = Caps.new("other/tensors", format="flexible")
+        assert a.intersect(b).is_empty
+
+    def test_range_and_list(self):
+        a = Caps.new("video/raw", width=IntRange(1, 4096), format=ValueList(("RGB", "GRAY8")))
+        b = Caps.new("video/raw", width=640, format="RGB")
+        i = a.intersect(b)
+        assert i.first.get("width") == 640
+        assert i.first.get("format") == "RGB"
+        assert i.is_fixed
+
+    def test_fixate(self):
+        a = Caps.new("video/raw", width=IntRange(16, 32), format=ValueList(("RGB", "BGR")))
+        f = a.fixate()
+        assert f.first.get("width") == 16
+        assert f.first.get("format") == "RGB"
+        assert f.is_fixed
+
+    def test_parse_caps_string(self):
+        c = parse_caps_string(
+            "other/tensors,format=static,dimensions=3:224:224:1,types=uint8,framerate=30/1"
+        )
+        info = tensors_info_from_caps(c)
+        assert info.specs[0].shape == (1, 224, 224, 3)
+        assert info.specs[0].dtype is DataType.UINT8
+        assert c.first.get("framerate") == (30, 1)
+
+    def test_caps_info_round_trip(self):
+        info = TensorsInfo.of(TensorSpec((1, 10), "float32"))
+        caps = caps_from_tensors_info(info)
+        assert tensors_info_from_caps(caps).is_equal(info)
+
+    def test_parse_list_value(self):
+        c = parse_caps_string("video/raw,format={RGB,GRAY8},width=[16,4096]")
+        s = c.first
+        assert isinstance(s.get("format"), ValueList)
+        assert isinstance(s.get("width"), IntRange)
+
+
+class TestBuffer:
+    def test_basic(self):
+        b = Buffer.of(np.zeros((2, 3), np.float32), np.ones(4, np.uint8), pts=1.5)
+        assert b.num_tensors == 2
+        assert b.nbytes == 24 + 4
+        assert not b.on_device
+        spec = b.spec()
+        assert spec.format is TensorFormat.FLEXIBLE
+        assert spec.specs[0].shape == (2, 3)
+
+    def test_meta(self):
+        b = Buffer.of(np.zeros(1, np.uint8)).with_meta(client_id=7)
+        assert b.meta["client_id"] == 7
+
+
+class TestTypedValue:
+    def test_typecast_and_arith_sources(self):
+        v = TypedValue.of(300, "int16").typecast("uint8")
+        assert v.item() == 300 % 256  # numpy wrap semantics
+        assert parse_number("0x10") == 16
+        assert parse_number("-2.5") == -2.5

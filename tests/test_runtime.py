@@ -1,0 +1,132 @@
+"""Pipeline runtime tests (reference analog: core pipeline construction and
+data-flow cases in tests/nnstreamer_plugins/unittest_plugins.cc and
+tests/nnstreamer_sink/unittest_sink.cc)."""
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, MessageType
+from nnstreamer_tpu.registry.elements import element_factories, make_element
+from nnstreamer_tpu.runtime.parse import parse_launch
+from nnstreamer_tpu.runtime.pipeline import Pipeline
+
+
+def test_element_factories_present():
+    names = element_factories()
+    for required in ("queue", "tensor_src", "tensor_sink", "appsrc", "videotestsrc"):
+        assert required in names
+
+
+class TestBasicFlow:
+    def test_src_to_sink(self):
+        pipe = parse_launch("tensor_src num-buffers=5 dimensions=4:4 ! tensor_sink name=out")
+        sink = pipe.get("out")
+        msg = pipe.run(timeout=10)
+        assert msg.type is MessageType.EOS
+        assert sink.buffer_count == 5
+
+    def test_through_queue(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=8 dimensions=2:3 types=uint8 pattern=counter "
+            "! queue max-size-buffers=4 ! tensor_sink name=out"
+        )
+        sink = pipe.get("out")
+        pipe.play()
+        bufs = [sink.pull(timeout=5) for _ in range(8)]
+        pipe.wait(timeout=10)
+        pipe.stop()
+        assert all(b is not None for b in bufs)
+        # counter pattern: frame i has every element == i
+        for i, b in enumerate(bufs):
+            assert b.tensors[0].shape == (3, 2)
+            assert np.all(b.tensors[0] == i)
+        # timestamps are monotone
+        pts = [b.pts for b in bufs]
+        assert pts == sorted(pts)
+
+    def test_appsrc_caps_and_data(self):
+        pipe = parse_launch(
+            'appsrc name=in caps="other/tensors,format=static,dimensions=3:2,types=float32" '
+            "! tensor_sink name=out"
+        )
+        src, sink = pipe.get("in"), pipe.get("out")
+        pipe.play()
+        for i in range(3):
+            src.push_buffer(np.full((2, 3), i, np.float32))
+        src.end_of_stream()
+        msg = pipe.wait(timeout=10)
+        pipe.stop()
+        assert msg.type is MessageType.EOS
+        assert sink.buffer_count == 3
+        assert np.all(sink.pull().tensors[0] == 0)
+
+    def test_videotestsrc(self):
+        pipe = parse_launch(
+            "videotestsrc num-buffers=2 width=32 height=16 format=RGB ! fakesink name=out"
+        )
+        pipe.run(timeout=10)
+        assert pipe.get("out").buffer_count == 2
+
+
+class TestCapsNegotiation:
+    def test_capsfilter_pass(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=1 dimensions=4:4 types=float32 "
+            "! other/tensors,format=static ! tensor_sink name=out"
+        )
+        pipe.run(timeout=10)
+        assert pipe.get("out").buffer_count == 1
+
+    def test_capsfilter_reject(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=1 dimensions=4:4 types=float32 "
+            "! other/tensors,format=sparse ! tensor_sink name=out"
+        )
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=5)
+        pipe.stop()
+        assert msg is not None
+
+    def test_template_mismatch_at_link_time(self):
+        with pytest.raises(ValueError):
+            parse_launch("videotestsrc ! tensor_sink")
+
+
+class TestParse:
+    def test_named_elements_and_tee_syntax(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=3 dimensions=2 name=s ! tee name=t "
+            "t. ! queue ! tensor_sink name=a  t. ! queue ! tensor_sink name=b"
+        )
+        pipe.run(timeout=10)
+        assert pipe.get("a").buffer_count == 3
+        assert pipe.get("b").buffer_count == 3
+
+    def test_unknown_element(self):
+        with pytest.raises(ValueError, match="no such element"):
+            parse_launch("definitely_not_an_element ! fakesink")
+
+    def test_unknown_property(self):
+        with pytest.raises(Exception, match="unknown property"):
+            parse_launch("tensor_src nonsense=1 ! fakesink")
+
+    def test_dot_dump(self):
+        pipe = parse_launch("tensor_src num-buffers=1 ! tensor_sink")
+        dot = pipe.to_dot()
+        assert "digraph" in dot and "->" in dot
+
+
+class TestLeakyQueue:
+    def test_leaky_downstream_drops_old(self):
+        # slow consumer: sink sleeps; leaky queue keeps newest
+        pipe = parse_launch(
+            "tensor_src num-buffers=50 dimensions=1 pattern=counter "
+            "! queue max-size-buffers=2 leaky=downstream ! tensor_sink name=out"
+        )
+        sink = pipe.get("out")
+        seen = []
+        sink.connect(lambda b: (seen.append(int(b.tensors[0][0])), time.sleep(0.005)))
+        pipe.run(timeout=20)
+        assert len(seen) < 50  # some frames were dropped
+        assert seen == sorted(seen)  # order preserved
