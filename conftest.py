@@ -1,18 +1,22 @@
 """Root conftest: force an 8-device virtual CPU mesh for all tests.
 
-Multi-chip TPU hardware is not available in CI; all sharding/parallelism tests
-run against ``xla_force_host_platform_device_count=8`` on the CPU backend
-(the reference's analog is loopback testing of its distributed layer, see
-SURVEY.md §4). Must run before the first ``import jax`` anywhere.
+Multi-chip TPU hardware is not available in CI; all sharding/parallelism
+tests run against 8 virtual CPU devices (the reference's analog is loopback
+testing of its distributed layer, see SURVEY.md §4).
+
+NOTE: this image pre-imports jax at interpreter start (sitecustomize
+registers the TPU tunnel) with JAX_PLATFORMS already latched, so setting env
+vars here is too late — we must update jax.config before the first backend
+initialization instead.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,34 @@
+"""Framed-bytes converter: the serialization decoders' inverse (L4).
+
+Reference analogs: ``tensor_converter_flatbuf.cc`` / ``-flexbuf.cc`` /
+``-protobuf.cc`` — deserialize ``other/flatbuf-tensor`` style streams back to
+``other/tensors``. Uses the shared wire format (core/serialize.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, TensorFormat, TensorsInfo
+from ..core.serialize import unpack_tensors
+from ..registry.subplugin import SubpluginKind, register
+from .base import Converter, register_converter
+
+
+@register_converter
+class BytesConverter(Converter):
+    NAME = "flexbuf"
+
+    def get_out_info(self, in_caps: Caps) -> TensorsInfo:
+        return TensorsInfo((), TensorFormat.FLEXIBLE)  # shapes ride per frame
+
+    def convert(self, buf: Buffer) -> Optional[Buffer]:
+        blob = np.ascontiguousarray(np.asarray(buf.tensors[0])).tobytes()
+        out = unpack_tensors(blob)
+        out.pts = buf.pts if out.pts is None else out.pts
+        return out
+
+
+register(SubpluginKind.CONVERTER, "flatbuf", BytesConverter)
+register(SubpluginKind.CONVERTER, "protobuf", BytesConverter)

@@ -1,0 +1,150 @@
+"""bounding_boxes decoder: detections → video overlay (L4).
+
+Reference analog: ``ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c``
+(2292 LoC, 9 box formats at :157-203). Supported modes here (option1):
+
+  * ``mobilenet-ssd-postprocess`` (aka ``tf-ssd``): tensors
+    [boxes (N,4) norm ymin,xmin,ymax,xmax; scores (N,) or (N,C)];
+  * ``yolov5``: (N, 5+C) rows [cx,cy,w,h,obj,cls...] (pixels or normalized);
+  * ``yolov8``: (4+C, N) or (N, 4+C) rows [cx,cy,w,h,cls...];
+  * ``custom``: a registered python callback (register_bbox_parser).
+
+Options (reference option2..): option2 = "W:H" output video size;
+option3 = labels file; option4 = score threshold; option5 = IoU threshold.
+Output: RGBA video frame with box rectangles drawn (transparent background,
+to be alpha-blended over the source video — the reference's ``compositor``
+pattern); decoded detections also ride in ``buf.meta["detections"]``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, TensorsInfo
+from ..core.caps import VIDEO_MIME
+from ..ops.nms import nms_numpy
+from .base import Decoder, register_decoder
+
+_custom_parsers: Dict[str, Callable] = {}
+
+
+def register_bbox_parser(name: str, fn: Callable) -> None:
+    """fn(tensors) -> (boxes (N,4) normalized [ymin,xmin,ymax,xmax], scores
+    (N,), classes (N,))."""
+    _custom_parsers[name] = fn
+
+
+@register_decoder
+class BoundingBoxes(Decoder):
+    MODE = "bounding_boxes"
+
+    def init(self, options):
+        super().init(options)
+        self.fmt = self.option(1, "mobilenet-ssd-postprocess")
+        wh = self.option(2, "320:240").split(":")
+        self.width, self.height = int(wh[0]), int(wh[1])
+        self.labels: List[str] = []
+        path = self.option(3)
+        if path:
+            with open(path) as fh:
+                self.labels = [ln.strip() for ln in fh if ln.strip()]
+        self.score_threshold = float(self.option(4, "0.25"))
+        self.iou_threshold = float(self.option(5, "0.5"))
+        # yolov8 tensor layout: auto | boxes-first ((N,4+C) rows) |
+        # coords-first ((4+C,N) columns). auto transposes when the first dim
+        # is smaller — right for real heads (84, 8400) but ambiguous when
+        # N < 4+C, hence the override.
+        self.layout = self.option(6, "auto")
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        return Caps.new(VIDEO_MIME, format="RGBA", width=self.width, height=self.height)
+
+    # -- per-format parsing → normalized boxes ------------------------------
+    def _parse(self, tensors) -> tuple:
+        fmt = self.fmt
+        if fmt in ("mobilenet-ssd-postprocess", "tf-ssd", "mp-palm-detection"):
+            boxes = np.asarray(tensors[0]).reshape(-1, 4).astype(np.float32)
+            scores = np.asarray(tensors[1]).astype(np.float32)
+            if scores.ndim > 1:
+                scores = scores.reshape(boxes.shape[0], -1)
+                classes = scores.argmax(-1)
+                scores = scores.max(-1)
+            else:
+                scores = scores.reshape(-1)
+                classes = np.zeros(scores.shape[0], np.int64)
+            return boxes, scores, classes
+        if fmt in ("yolov5", "yolov8"):
+            a = np.asarray(tensors[0]).astype(np.float32)
+            a = a.reshape(-1, a.shape[-1]) if a.ndim > 2 else a
+            if fmt == "yolov8":
+                transpose = (
+                    self.layout == "coords-first"
+                    or (self.layout == "auto" and a.shape[0] < a.shape[1])
+                )
+                if transpose:  # (4+C, N) layout
+                    a = a.T
+                cxcywh, cls = a[:, :4], a[:, 4:]
+                scores = cls.max(-1)
+                classes = cls.argmax(-1)
+            else:
+                cxcywh, obj, cls = a[:, :4], a[:, 4], a[:, 5:]
+                cls_score = cls.max(-1) if cls.size else np.ones_like(obj)
+                scores = obj * cls_score
+                classes = cls.argmax(-1) if cls.size else np.zeros(len(obj), np.int64)
+            # normalize if values look like pixels
+            scale = (
+                np.array([self.width, self.height, self.width, self.height], np.float32)
+                if cxcywh.max() > 2.0
+                else np.ones(4, np.float32)
+            )
+            cx, cy = cxcywh[:, 0] / scale[0], cxcywh[:, 1] / scale[1]
+            w, h = cxcywh[:, 2] / scale[2], cxcywh[:, 3] / scale[3]
+            boxes = np.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2], axis=1)
+            return boxes, scores, classes
+        if fmt in _custom_parsers:
+            return _custom_parsers[fmt](tensors)
+        raise ValueError(f"bounding_boxes: unknown format '{self.fmt}'")
+
+    # -- decode -------------------------------------------------------------
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        boxes, scores, classes = self._parse(buf.tensors)
+        keep = nms_numpy(boxes, scores, self.iou_threshold, self.score_threshold)
+        frame = np.zeros((self.height, self.width, 4), np.uint8)
+        detections = []
+        for i in keep:
+            ymin, xmin, ymax, xmax = np.clip(boxes[i], 0.0, 1.0)
+            x1, y1 = int(xmin * self.width), int(ymin * self.height)
+            x2, y2 = int(xmax * self.width), int(ymax * self.height)
+            cls = int(classes[i])
+            color = _class_color(cls)
+            _draw_rect(frame, x1, y1, x2, y2, color)
+            detections.append({
+                "box": [x1, y1, x2 - x1, y2 - y1],
+                "score": float(scores[i]),
+                "class": cls,
+                "label": self.labels[cls] if cls < len(self.labels) else str(cls),
+            })
+        out = Buffer([frame])
+        out.meta["detections"] = detections
+        return out
+
+
+def _class_color(cls: int) -> np.ndarray:
+    rng = np.random.default_rng(cls + 1)
+    rgb = rng.integers(64, 255, 3)
+    return np.array([*rgb, 255], np.uint8)
+
+
+def _draw_rect(frame: np.ndarray, x1: int, y1: int, x2: int, y2: int,
+               color: np.ndarray, thickness: int = 2) -> None:
+    h, w = frame.shape[:2]
+    x1, x2 = max(x1, 0), min(x2, w - 1)
+    y1, y2 = max(y1, 0), min(y2, h - 1)
+    if x2 <= x1 or y2 <= y1:
+        return
+    t = thickness
+    frame[y1:y1 + t, x1:x2] = color
+    frame[max(y2 - t, 0):y2, x1:x2] = color
+    frame[y1:y2, x1:x1 + t] = color
+    frame[y1:y2, max(x2 - t, 0):x2] = color

@@ -1,0 +1,34 @@
+"""Serialization decoders: tensors → framed bytes (L4).
+
+Reference analogs: ``tensordec-flatbuf.cc`` / ``-flexbuf.cc`` /
+``-protobuf.cc`` — all three reference IDLs collapse to one portable binary
+framing (core/serialize.py); the mode aliases are kept for launch-string
+parity.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, TensorsInfo
+from ..core.caps import OCTET_MIME
+from ..core.serialize import pack_tensors
+from ..registry.subplugin import SubpluginKind, register
+from .base import Decoder, register_decoder
+
+
+@register_decoder
+class FlexBuf(Decoder):
+    MODE = "flexbuf"
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        return Caps.new(OCTET_MIME, framed="tensors")
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        return Buffer([np.frombuffer(pack_tensors(buf), np.uint8)])
+
+
+# launch-string parity aliases for the reference's other IDLs
+register(SubpluginKind.DECODER, "flatbuf", FlexBuf)
+register(SubpluginKind.DECODER, "protobuf", FlexBuf)

@@ -33,7 +33,9 @@ class TensorSink(SinkElement):
     """
 
     ELEMENT_NAME = "tensor_sink"
-    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    # accepts any media: plays both the reference's tensor_sink (tensors) and
+    # appsink (text/video pulls in decoder tests) roles
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
     PROPERTIES = {
         "sync": Prop(False, prop_bool, "honor buffer pts against the clock (unused yet)"),
         "max_stored": Prop(256, int, "keep last N buffers for pull() (0 = unbounded)"),

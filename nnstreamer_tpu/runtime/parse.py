@@ -57,6 +57,26 @@ class CapsFilter(TransformElement):
 
 _NAME_REF_RE = re.compile(r"^(?P<el>[A-Za-z_][\w-]*)\.(?P<pad>[\w%]*)$")
 
+
+def _pad_links(text: str) -> str:
+    """Space-pad '!' link separators, but never inside quoted values
+    (a model path like "dir/my!file.py" must survive intact)."""
+    out = []
+    quote = None
+    for ch in text:
+        if quote:
+            if ch == quote:
+                quote = None
+            out.append(ch)
+        elif ch in "\"'":
+            quote = ch
+            out.append(ch)
+        elif ch == "!":
+            out.append(" ! ")
+        else:
+            out.append(ch)
+    return "".join(out)
+
 # One chain entry: ("el", Element) or ("ref", element_name, pad_name|None)
 Entry = tuple
 
@@ -66,7 +86,7 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
     from ..registry.elements import make_element
 
     pipe = pipeline or Pipeline()
-    tokens = shlex.split(description.replace("!", " ! "))
+    tokens = shlex.split(_pad_links(description))
 
     # Group tokens into entries, entries into chains. Entries within a chain
     # are separated by '!'; a non-property token with no preceding '!' starts

@@ -1,0 +1,228 @@
+"""Decoder/converter subplugin tests (reference analog:
+tests/nnstreamer_decoder_*/ golden pipelines)."""
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer
+from nnstreamer_tpu.core.serialize import pack_tensors, unpack_tensors
+from nnstreamer_tpu.ops.nms import iou_matrix, nms_jax, nms_numpy
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+def run_collect(launch: str, push=None, sink_name="out", timeout=20.0):
+    pipe = parse_launch(launch)
+    sink = pipe.get(sink_name)
+    collected = []
+    sink.connect(collected.append)
+    if push is None:
+        pipe.run(timeout=timeout)
+    else:
+        src = pipe.get("in")
+        pipe.play()
+        for b in push:
+            src.push_buffer(b)
+        src.end_of_stream()
+        pipe.wait(timeout=timeout)
+        pipe.stop()
+    return collected
+
+
+class TestImageLabeling:
+    def test_label_lookup(self, tmp_path):
+        labels = tmp_path / "labels.txt"
+        labels.write_text("cat\ndog\nbird\n")
+        scores = np.array([0.1, 0.9, 0.2], np.float32)
+        out = run_collect(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=3,types=float32 "
+            f"! tensor_decoder mode=image_labeling option1={labels} ! tensor_sink name=out",
+            push=[scores],
+        )
+        assert out[0].meta["label"] == "dog"
+        assert bytes(np.asarray(out[0].tensors[0])) == b"dog"
+
+    def test_end_to_end_with_model(self, tmp_path):
+        labels = tmp_path / "labels.txt"
+        labels.write_text("\n".join(f"class{i}" for i in range(10)))
+        out = run_collect(
+            "tensor_src num-buffers=2 dimensions=10:1 types=float32 pattern=random "
+            "! tensor_filter framework=jax model=builtin://passthrough "
+            f"! tensor_decoder mode=image_labeling option1={labels} ! tensor_sink name=out"
+        )
+        assert len(out) == 2
+        assert out[0].meta["label"].startswith("class")
+
+
+class TestDirectVideo:
+    def test_tensor_to_video(self):
+        out = run_collect(
+            "tensor_src num-buffers=1 dimensions=3:8:4:1 types=uint8 pattern=ones "
+            "! tensor_decoder mode=direct_video ! tensor_sink name=out"
+        )
+        # sink template rejects video/raw; use fakesink instead
+        assert out  # pragma: no cover
+
+    def test_video_roundtrip(self):
+        pipe = parse_launch(
+            "videotestsrc num-buffers=1 width=8 height=4 format=RGB pattern=solid "
+            "! tensor_converter ! tensor_decoder mode=direct_video ! fakesink name=out"
+        )
+        pipe.run(timeout=10)
+        assert pipe.get("out").buffer_count == 1
+
+
+class TestBoundingBoxes:
+    def test_ssd_postprocess_draw_and_meta(self):
+        boxes = np.array(
+            [[0.1, 0.1, 0.5, 0.5], [0.12, 0.12, 0.52, 0.52], [0.6, 0.6, 0.9, 0.9]],
+            np.float32,
+        )
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        out = run_collect(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=4:3.3,types=float32 "
+            "! tensor_decoder mode=bounding_boxes option1=mobilenet-ssd-postprocess "
+            "option2=100:100 ! tensor_sink name=out",
+            push=[[boxes, scores]],
+        )
+        frame = np.asarray(out[0].tensors[0])
+        assert frame.shape == (100, 100, 4)
+        assert len(out[0].meta["detections"]) == 2
+
+    def test_detections_meta(self):
+        from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
+        from nnstreamer_tpu.core import TensorsInfo
+
+        dec = BoundingBoxes()
+        dec.init(["mobilenet-ssd-postprocess", "100:100", None, "0.5", "0.5",
+                  None, None, None, None])
+        boxes = np.array(
+            [[0.1, 0.1, 0.5, 0.5], [0.11, 0.11, 0.51, 0.51], [0.6, 0.6, 0.9, 0.9]],
+            np.float32,
+        )
+        scores = np.array([0.9, 0.85, 0.7], np.float32)
+        out = dec.decode(Buffer([boxes, scores]), TensorsInfo())
+        dets = out.meta["detections"]
+        assert len(dets) == 2  # overlapping pair suppressed to 1 + distinct 1
+        frame = np.asarray(out.tensors[0])
+        assert frame.shape == (100, 100, 4)
+        assert frame[:, :, 3].max() == 255  # something was drawn
+
+    def test_yolov8_layout(self):
+        from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
+        from nnstreamer_tpu.core import TensorsInfo
+
+        dec = BoundingBoxes()
+        dec.init(["yolov8", "640:640", None, "0.3", "0.5", None, None, None, None])
+        # (4+C, N) layout with C=2, N=10 (N >> 4+C, as real yolov8 heads emit)
+        a = np.zeros((6, 10), np.float32)
+        a[:4, 0] = [320, 320, 100, 100]  # cx,cy,w,h in pixels
+        a[4, 0] = 0.9                    # class 0 score
+        out = dec.decode(Buffer([a]), TensorsInfo())
+        dets = out.meta["detections"]
+        assert len(dets) == 1
+        assert dets[0]["box"][2] == 100  # width in pixels
+
+
+class TestNms:
+    def test_iou_and_greedy(self):
+        boxes = np.array([[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        m = iou_matrix(boxes)
+        assert m[0, 1] == pytest.approx(1.0)
+        assert m[0, 2] == 0.0
+        keep = nms_numpy(boxes, scores, 0.5, 0.1)
+        assert list(keep) == [0, 2]
+
+    def test_jax_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        centers = rng.random((20, 2)).astype(np.float32)
+        sizes = rng.random((20, 2)).astype(np.float32) * 0.3
+        boxes = np.concatenate([centers - sizes / 2, centers + sizes / 2], axis=1)
+        scores = rng.random(20).astype(np.float32)
+        keep_np = nms_numpy(boxes, scores, 0.5, 0.2, max_out=10)
+        kept, valid = nms_jax(boxes, scores, 0.5, 0.2, max_out=10)
+        keep_j = np.asarray(kept)[np.asarray(valid)]
+        assert list(keep_j) == list(keep_np)
+
+
+class TestSegmentPose:
+    def test_segment_palette(self):
+        logits = np.zeros((4, 4, 3), np.float32)
+        logits[:2, :, 1] = 5.0  # top half = class 1
+        out = run_collect(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=3:4:4,types=float32 "
+            "! tensor_decoder mode=image_segment ! tensor_sink name=out",
+            push=[logits],
+        )
+        assert np.asarray(out[0].tensors[0]).shape == (4, 4, 3)
+
+    def test_segment_direct(self):
+        from nnstreamer_tpu.decoders.segment_pose import ImageSegment
+        from nnstreamer_tpu.core import TensorsInfo
+
+        dec = ImageSegment()
+        dec.init([None] * 9)
+        logits = np.zeros((4, 4, 3), np.float32)
+        logits[:2, :, 1] = 5.0
+        out = dec.decode(Buffer([logits]), TensorsInfo())
+        cm = out.meta["class_map"]
+        assert cm[0, 0] == 1 and cm[3, 3] == 0
+        frame = np.asarray(out.tensors[0])
+        assert frame.shape == (4, 4, 3)
+        assert not np.array_equal(frame[0, 0], frame[3, 3])
+
+    def test_pose_coords(self):
+        from nnstreamer_tpu.decoders.segment_pose import PoseEstimation
+        from nnstreamer_tpu.core import TensorsInfo
+
+        dec = PoseEstimation()
+        dec.init(["100:100", "coords"] + [None] * 7)
+        kps = np.full((17, 2), 0.5, np.float32)
+        out = dec.decode(Buffer([kps]), TensorsInfo())
+        frame = np.asarray(out.tensors[0])
+        assert frame[50, 50, 3] == 255  # keypoint drawn at center
+
+
+class TestSerializeRoundtrip:
+    def test_pack_unpack(self):
+        buf = Buffer([np.arange(6, dtype=np.float32).reshape(2, 3),
+                      np.array([1, 2], np.int64)], pts=1.25)
+        buf.meta["client_id"] = 42
+        blob = pack_tensors(buf)
+        back = unpack_tensors(blob)
+        assert back.pts == 1.25
+        assert back.meta["client_id"] == 42
+        assert np.array_equal(back.tensors[0], buf.tensors[0])
+        assert back.tensors[1].dtype == np.int64
+
+    def test_decoder_converter_pipeline_roundtrip(self):
+        out = run_collect(
+            "tensor_src num-buffers=2 dimensions=3:2 types=float32 pattern=counter "
+            "! tensor_decoder mode=flexbuf "
+            "! tensor_converter subplugin=flexbuf ! tensor_sink name=out"
+        )
+        assert len(out) == 2
+        assert np.asarray(out[1].tensors[0]).shape == (2, 3)
+        assert np.allclose(np.asarray(out[1].tensors[0]), 1.0)
+
+
+class TestTensorRegionCropLoop:
+    def test_region_into_crop(self):
+        # detection boxes -> tensor_region -> tensor_crop on video tensors
+        pipe = parse_launch(
+            "tensor_crop name=c ! tensor_sink name=out "
+            "videotestsrc num-buffers=1 width=20 height=20 format=RGB ! tensor_converter ! c.raw "
+            "appsrc name=boxes caps=other/tensors,format=static,dimensions=4:1.1,types=float32 "
+            "! tensor_decoder mode=tensor_region option1=1 option2=20:20 ! c.info"
+        )
+        out = []
+        pipe.get("out").connect(out.append)
+        boxes_src = pipe.get("boxes")
+        pipe.play()
+        boxes = np.array([[0.25, 0.25, 0.75, 0.75]], np.float32)  # ymin,xmin,ymax,xmax
+        scores = np.array([0.9], np.float32)
+        boxes_src.push_buffer([boxes, scores])
+        boxes_src.end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        crop = np.asarray(out[0].tensors[0])
+        assert crop.shape == (1, 10, 10, 3)

@@ -1,0 +1,374 @@
+"""Element-family tests (reference analog: element-by-element cases in
+tests/nnstreamer_plugins/unittest_plugins.cc)."""
+import json
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, MessageType
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+def run_collect(launch: str, sink_name: str = "out", timeout: float = 20.0):
+    """Run a pipeline to EOS, returning buffers collected at ``sink_name``."""
+    pipe = parse_launch(launch)
+    sink = pipe.get(sink_name)
+    collected = []
+    sink.connect(collected.append)
+    pipe.run(timeout=timeout)
+    return collected
+
+
+class TestTransform:
+    def test_typecast(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=1 dimensions=4 types=float32 pattern=ones "
+            "! tensor_transform mode=typecast option=uint8 ! tensor_sink name=out"
+        )
+        assert np.asarray(bufs[0].tensors[0]).dtype == np.uint8
+
+    def test_arithmetic_chain(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=1 dimensions=4 types=uint8 pattern=ones "
+            "! tensor_transform mode=arithmetic option=typecast:float32,add:-0.5,mul:2 "
+            "! tensor_sink name=out"
+        )
+        a = np.asarray(bufs[0].tensors[0])
+        assert a.dtype == np.float32
+        assert np.allclose(a, 1.0)  # (1 - 0.5) * 2
+
+    def test_transpose_and_caps(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=1 dimensions=4:2:3 types=float32 "  # shape (3,2,4)
+            "! tensor_transform mode=transpose option=2:1:0 ! tensor_sink name=out"
+        )
+        assert np.asarray(bufs[0].tensors[0]).shape == (4, 2, 3)
+
+    def test_stand(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=1 dimensions=100 types=float32 pattern=random "
+            "! tensor_transform mode=stand option=default ! tensor_sink name=out"
+        )
+        a = np.asarray(bufs[0].tensors[0])
+        assert abs(a.mean()) < 1e-5 and abs(a.std() - 1.0) < 1e-4
+
+    def test_clamp(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=1 dimensions=8 types=float32 pattern=ones "
+            "! tensor_transform mode=arithmetic option=mul:10 "
+            "! tensor_transform mode=clamp option=0:5 ! tensor_sink name=out"
+        )
+        assert np.all(np.asarray(bufs[0].tensors[0]) == 5.0)
+
+
+class TestConverter:
+    def test_video_to_tensor(self):
+        bufs = run_collect(
+            "videotestsrc num-buffers=2 width=32 height=16 format=RGB pattern=solid "
+            "! tensor_converter ! tensor_sink name=out"
+        )
+        a = np.asarray(bufs[0].tensors[0])
+        assert a.shape == (1, 16, 32, 3)
+        assert a.dtype == np.uint8
+
+    def test_frames_per_tensor(self):
+        bufs = run_collect(
+            "videotestsrc num-buffers=4 width=8 height=8 format=GRAY8 "
+            "! tensor_converter frames-per-tensor=2 ! tensor_sink name=out"
+        )
+        assert len(bufs) == 2
+        assert np.asarray(bufs[0].tensors[0]).shape == (2, 8, 8, 1)
+
+    def test_video_pipeline_into_filter(self):
+        bufs = run_collect(
+            "videotestsrc num-buffers=1 width=16 height=16 format=RGB "
+            "! tensor_converter "
+            "! tensor_transform mode=arithmetic option=typecast:float32,div:255 "
+            "! tensor_filter framework=jax model=builtin://average "
+            "! tensor_sink name=out"
+        )
+        a = np.asarray(bufs[0].tensors[0])
+        assert a.shape == (1, 1, 1, 1)
+
+
+class TestAggregator:
+    def test_stack_batch(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=6 dimensions=4 types=float32 pattern=counter "
+            "! tensor_aggregator frames-out=3 concat=false ! tensor_sink name=out"
+        )
+        assert len(bufs) == 2
+        a = np.asarray(bufs[0].tensors[0])
+        assert a.shape == (3, 4)
+        assert np.allclose(a[:, 0], [0, 1, 2])
+
+    def test_concat_axis(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=4 dimensions=2:1 types=float32 pattern=counter "
+            "! tensor_aggregator frames-out=2 frames-dim=0 ! tensor_sink name=out"
+        )
+        assert len(bufs) == 2
+        assert np.asarray(bufs[0].tensors[0]).shape == (2, 2)
+
+    def test_sliding_window(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=4 dimensions=1 types=float32 pattern=counter "
+            "! tensor_aggregator frames-out=2 frames-flush=1 concat=false "
+            "! tensor_sink name=out"
+        )
+        # windows: [0,1],[1,2],[2,3]
+        assert len(bufs) == 3
+        assert np.allclose(np.asarray(bufs[1].tensors[0]).ravel(), [1, 2])
+
+
+class TestMuxDemux:
+    def test_mux_slowest(self):
+        bufs = run_collect(
+            "tensor_mux name=m sync-mode=slowest ! tensor_sink name=out "
+            "tensor_src num-buffers=3 dimensions=2 types=float32 ! m.sink_0 "
+            "tensor_src num-buffers=3 dimensions=3 types=uint8 ! m.sink_1"
+        )
+        assert len(bufs) == 3
+        assert bufs[0].num_tensors == 2
+        assert np.asarray(bufs[0].tensors[1]).shape == (3,)
+
+    def test_demux_pick(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=2 dimensions=2.3.4 types=float32 ! "
+            "tensor_demux name=d tensorpick=2,0 "
+            "d.src_0 ! tensor_sink name=a  d.src_1 ! tensor_sink name=b"
+        )
+        a_bufs, b_bufs = [], []
+        pipe.get("a").connect(a_bufs.append)
+        pipe.get("b").connect(b_bufs.append)
+        pipe.run(timeout=20)
+        assert np.asarray(a_bufs[0].tensors[0]).shape == (4,)
+        assert np.asarray(b_bufs[0].tensors[0]).shape == (2,)
+
+
+class TestMergeSplit:
+    def test_merge_axis0(self):
+        bufs = run_collect(
+            "tensor_merge name=m option=0 ! tensor_sink name=out "
+            "tensor_src num-buffers=2 dimensions=3:2 types=float32 pattern=ones ! m.sink_0 "
+            "tensor_src num-buffers=2 dimensions=3:4 types=float32 pattern=zeros ! m.sink_1"
+        )
+        a = np.asarray(bufs[0].tensors[0])
+        assert a.shape == (6, 3)
+        assert np.allclose(a[:2], 1.0) and np.allclose(a[2:], 0.0)
+
+    def test_split_even(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=1 dimensions=2:4 types=float32 pattern=counter ! "
+            "tensor_split name=s axis=0 "
+            "s.src_0 ! tensor_sink name=a  s.src_1 ! tensor_sink name=b"
+        )
+        a_bufs, b_bufs = [], []
+        pipe.get("a").connect(a_bufs.append)
+        pipe.get("b").connect(b_bufs.append)
+        pipe.run(timeout=20)
+        assert np.asarray(a_bufs[0].tensors[0]).shape == (2, 2)
+        assert np.asarray(b_bufs[0].tensors[0]).shape == (2, 2)
+
+    def test_split_segments_caps(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=1 dimensions=1:6 types=float32 ! "
+            "tensor_split name=s axis=0 tensorseg=2,4 "
+            "s.src_0 ! tensor_sink name=a  s.src_1 ! tensor_sink name=b"
+        )
+        pipe.run(timeout=20)
+        # shape (2,1) -> reference dim string "1:2"; (4,1) -> "1:4"
+        assert "dimensions=1:2" in str(pipe.get("a").sinkpad.caps)
+        assert "dimensions=1:4" in str(pipe.get("b").sinkpad.caps)
+
+
+class TestIf:
+    def test_average_gate(self):
+        # counter pattern: frames 0..4; pass only when average > 2 (frames 3,4)
+        bufs = run_collect(
+            "tensor_src num-buffers=5 dimensions=4 types=float32 pattern=counter "
+            "! tensor_if compared-value=tensor-average-value compared-value-option=0 "
+            "operator=gt supplied-value=2 then=passthrough else=skip "
+            "! tensor_sink name=out"
+        )
+        assert len(bufs) == 2
+        assert np.allclose(np.asarray(bufs[0].tensors[0]), 3.0)
+
+    def test_fill_zero_else(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=3 dimensions=2 types=float32 pattern=counter "
+            "! tensor_if compared-value=a-value compared-value-option=0:0 "
+            "operator=ge supplied-value=1 then=passthrough else=fill-zero "
+            "! tensor_sink name=out"
+        )
+        assert len(bufs) == 3
+        assert np.allclose(np.asarray(bufs[0].tensors[0]), 0.0)
+        assert np.allclose(np.asarray(bufs[2].tensors[0]), 2.0)
+
+    def test_custom_condition(self):
+        from nnstreamer_tpu.elements.cond import (
+            register_if_condition,
+            unregister_if_condition,
+        )
+
+        register_if_condition("even", lambda b: b.offset % 2 == 0)
+        try:
+            bufs = run_collect(
+                "tensor_src num-buffers=4 dimensions=1 types=float32 pattern=counter "
+                "! tensor_if compared-value=custom compared-value-option=even "
+                "then=passthrough else=skip ! tensor_sink name=out"
+            )
+            assert len(bufs) == 2
+        finally:
+            unregister_if_condition("even")
+
+
+class TestCrop:
+    def test_crop_regions(self):
+        pipe = parse_launch(
+            "tensor_crop name=c ! tensor_sink name=out "
+            "videotestsrc num-buffers=2 width=16 height=16 format=RGB "
+            "! tensor_converter ! c.raw "
+            "appsrc name=regions caps=other/tensors,format=static,dimensions=4:2,types=int32 "
+            "! c.info"
+        )
+        sink, regions = pipe.get("out"), pipe.get("regions")
+        collected = []
+        sink.connect(collected.append)
+        pipe.play()
+        for _ in range(2):
+            regions.push_buffer(np.array([[0, 0, 4, 8], [2, 2, 6, 6]], np.int32))
+        regions.end_of_stream()
+        pipe.wait(timeout=20)
+        pipe.stop()
+        assert len(collected) == 2
+        crops = collected[0].tensors
+        assert np.asarray(crops[0]).shape == (1, 8, 4, 3)   # h=8, w=4
+        assert np.asarray(crops[1]).shape == (1, 6, 6, 3)
+
+
+class TestRate:
+    def test_rate_drops(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=20 dimensions=1 framerate=200 "
+            "! tensor_rate name=r framerate=50 ! tensor_sink name=out"
+        )
+        pipe.run(timeout=20)
+        r = pipe.get("r")
+        assert r.in_count == 20
+        assert r.out_count < 20
+        assert r.out_count + r.drop_count == 20
+
+    def test_throttle_event_reaches_filter(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=10 dimensions=2 framerate=0 "
+            "! tensor_filter framework=jax model=builtin://passthrough name=f "
+            "! tensor_rate framerate=10 throttle=true ! tensor_sink name=out"
+        )
+        pipe.run(timeout=20)
+        assert pipe.get("f")._throttle_delay_s == pytest.approx(0.1)
+
+
+class TestRepo:
+    def test_feedback_slot(self):
+        from nnstreamer_tpu.elements.repo import REPO
+
+        REPO.reset()
+        p1 = parse_launch(
+            "tensor_src num-buffers=3 dimensions=2 types=float32 pattern=counter "
+            "! tensor_repo_sink slot-index=7"
+        )
+        p1.run(timeout=10)
+        p2 = parse_launch(
+            "tensor_repo_src slot-index=7 "
+            "caps=other/tensors,format=static,dimensions=2,types=float32 "
+            "! tensor_sink name=out"
+        )
+        out = []
+        p2.get("out").connect(out.append)
+        p2.play()
+        p2.wait(timeout=10)
+        p2.stop()
+        assert len(out) >= 2  # slot keeps last N (depth=2)
+
+
+class TestSparse:
+    def test_enc_dec_roundtrip(self):
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=2:4,types=float32 "
+            "! tensor_sparse_enc ! tensor_sparse_dec ! tensor_sink name=out"
+        )
+        src = pipe.get("in")
+        out = []
+        pipe.get("out").connect(out.append)
+        pipe.play()
+        dense = np.zeros((4, 2), np.float32)
+        dense[0, 1] = 5.0
+        dense[3, 0] = -2.0
+        src.push_buffer(dense)
+        src.end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+        assert np.array_equal(np.asarray(out[0].tensors[0]), dense)
+
+
+class TestJoin:
+    def test_join_branches(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=4 dimensions=1 types=float32 pattern=counter "
+            "! tensor_if compared-value=a-value compared-value-option=0:0 operator=lt "
+            "supplied-value=2 then=passthrough else=skip ! j.sink_0 "
+            "join name=j ! tensor_sink name=out"
+        )
+        assert len(bufs) == 2
+
+
+class TestDataRepo:
+    def test_write_then_read(self, tmp_path):
+        data, meta = str(tmp_path / "d.dat"), str(tmp_path / "d.json")
+        p1 = parse_launch(
+            "tensor_src num-buffers=5 dimensions=3 types=float32 pattern=counter "
+            f"! datareposink location={data} json={meta}"
+        )
+        p1.run(timeout=10)
+        with open(meta) as fh:
+            m = json.load(fh)
+        assert m["total_samples"] == 5
+        p2 = parse_launch(
+            f"datareposrc location={data} json={meta} start-sample-index=1 "
+            "stop-sample-index=3 epochs=2 ! tensor_sink name=out"
+        )
+        out = []
+        p2.get("out").connect(out.append)
+        p2.run(timeout=10)
+        assert len(out) == 6  # samples 1..3, twice
+        assert np.allclose(np.asarray(out[0].tensors[0]), 1.0)
+
+    def test_shuffle_deterministic(self, tmp_path):
+        data, meta = str(tmp_path / "d.dat"), str(tmp_path / "d.json")
+        parse_launch(
+            "tensor_src num-buffers=8 dimensions=1 types=float32 pattern=counter "
+            f"! datareposink location={data} json={meta}"
+        ).run(timeout=10)
+
+        def read(seed):
+            p = parse_launch(
+                f"datareposrc location={data} json={meta} is-shuffle=true seed={seed} "
+                "! tensor_sink name=out"
+            )
+            vals = []
+            p.get("out").connect(lambda b: vals.append(float(np.asarray(b.tensors[0])[0])))
+            p.run(timeout=10)
+            return vals
+
+        a, b = read(3), read(3)
+        assert a == b           # reproducible
+        assert a != sorted(a)   # actually shuffled
+
+
+class TestDebug:
+    def test_passthrough(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=2 dimensions=2 ! tensor_debug ! tensor_sink name=out"
+        )
+        assert len(bufs) == 2

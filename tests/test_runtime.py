@@ -90,7 +90,7 @@ class TestCapsNegotiation:
 
     def test_template_mismatch_at_link_time(self):
         with pytest.raises(ValueError):
-            parse_launch("videotestsrc ! tensor_sink")
+            parse_launch("videotestsrc ! tensor_transform mode=typecast option=uint8")
 
 
 class TestParse:
