@@ -1,0 +1,99 @@
+"""Benchmark: MobileNet-v2 224×224 streaming pipeline fps + p50 latency.
+
+The BASELINE.json north star: the reference's image-classification pipeline
+(videotestsrc → tensor_converter → tensor_filter → tensor_decoder) at
+≥2000 fps aggregate on TPU. This runs the same topology through our
+framework on the available device (TPU under the driver; CPU fallback when
+forced) with tensor_aggregator batching frames into the MXU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline = fps / 2000 (the target, BASELINE.md — the reference repo
+publishes no numbers of its own).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_FPS = 2000.0  # BASELINE.json target on TPU
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+WARMUP_BATCHES = 3
+MEASURE_BATCHES = int(os.environ.get("BENCH_BATCHES", "30"))
+
+
+def main() -> None:
+    import numpy as np
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    devices = jax.devices()
+    platform = devices[0].platform
+
+    from nnstreamer_tpu.runtime.parse import parse_launch
+
+    total_frames = (WARMUP_BATCHES + MEASURE_BATCHES) * BATCH
+    pipe = parse_launch(
+        f"tensor_src num-buffers={total_frames} dimensions=3:224:224:1 "
+        "types=uint8 pattern=random "
+        "! tensor_transform mode=arithmetic option=typecast:float32,div:127.5,add:-1 "
+        f"! tensor_aggregator frames-out={BATCH} frames-dim=0 concat=true "
+        "! tensor_filter framework=jax "
+        "model=nnstreamer_tpu.models.mobilenet_v2:filter_model name=f sync-invoke=true "
+        "! tensor_sink name=out max-stored=1"
+    )
+    sink = pipe.get("out")
+    times = []
+    sink.connect(lambda b: times.append(time.monotonic()))
+    t_start = time.monotonic()
+    pipe.play()
+    deadline = time.monotonic() + 600
+    want = WARMUP_BATCHES + MEASURE_BATCHES
+    while len(times) < want and time.monotonic() < deadline:
+        time.sleep(0.05)
+    pipe.stop()
+    if len(times) <= WARMUP_BATCHES + 1:
+        raise RuntimeError(f"bench produced only {len(times)} batches")
+
+    # batches completed after warmup, timed from the last warmup batch
+    n_measured = len(times) - WARMUP_BATCHES
+    span = times[-1] - times[WARMUP_BATCHES - 1]
+    fps = n_measured * BATCH / span if span > 0 else 0.0
+
+    # p50 single-frame end-to-end latency via SingleShot (batch=1)
+    from nnstreamer_tpu.single import SingleShot
+
+    lat = []
+    with SingleShot("jax", "nnstreamer_tpu.models.mobilenet_v2:filter_model") as s:
+        x = np.random.rand(1, 224, 224, 3).astype(np.float32)
+        out = s.invoke(x)
+        out[0].block_until_ready()  # compile
+        for _ in range(30):
+            t0 = time.monotonic()
+            out = s.invoke(x)
+            out[0].block_until_ready()
+            lat.append(time.monotonic() - t0)
+    p50_ms = sorted(lat)[len(lat) // 2] * 1e3
+
+    result = {
+        "metric": "mobilenet_v2_224_pipeline_fps",
+        "value": round(fps, 1),
+        "unit": "fps",
+        "vs_baseline": round(fps / BASELINE_FPS, 3),
+        "p50_latency_ms": round(p50_ms, 2),
+        "batch": BATCH,
+        "platform": platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -102,8 +102,7 @@ class JaxBackend(FilterBackend):
         self._fn: Optional[Callable] = None
         self._in_info: Optional[TensorsInfo] = None
         self._out_info: Optional[TensorsInfo] = None
-        self._cache: Dict[tuple, Callable] = {}
-        self._cache_lock = threading.Lock()
+        self._jit: Optional[Callable] = None
         self._device = None
 
     # -- open/close ---------------------------------------------------------
@@ -175,8 +174,7 @@ class JaxBackend(FilterBackend):
 
     def close(self) -> None:
         self._fn = None
-        with self._cache_lock:
-            self._cache.clear()
+        self._jit = None
         super().close()
 
     # -- info ---------------------------------------------------------------
@@ -199,19 +197,15 @@ class JaxBackend(FilterBackend):
         return self._out_info
 
     # -- invoke -------------------------------------------------------------
-    def _compiled_for(self, inputs: List[Any]) -> Callable:
+    def _jitted(self) -> Callable:
+        # jax.jit's own trace cache keys on input signatures — one wrapper
+        # covers every shape bucket (recompiles per new signature, reuses
+        # compiled executables otherwise)
         import jax
 
-        key = tuple((tuple(x.shape), str(np.asarray(x).dtype) if isinstance(x, np.ndarray) else str(x.dtype))
-                    for x in inputs)
-        fn = self._cache.get(key)
-        if fn is None:
-            with self._cache_lock:
-                fn = self._cache.get(key)
-                if fn is None:
-                    fn = jax.jit(lambda *xs: _as_tuple(self._fn(*xs)))
-                    self._cache[key] = fn
-        return fn
+        if self._jit is None:
+            self._jit = jax.jit(lambda *xs: _as_tuple(self._fn(*xs)))
+        return self._jit
 
     def invoke(self, inputs: List[Any]) -> List[Any]:
         import jax
@@ -222,7 +216,7 @@ class JaxBackend(FilterBackend):
             x if hasattr(x, "addressable_shards") else jax.device_put(x, self._device)
             for x in inputs
         ]
-        out = self._compiled_for(device_inputs)(*device_inputs)
+        out = self._jitted()(*device_inputs)
         return list(out)
 
     def handle_event(self, event: BackendEvent, data: Optional[dict] = None) -> None:
@@ -231,5 +225,4 @@ class JaxBackend(FilterBackend):
             # old + new co-resident until swap completes.
             new_fn = self._load_model(self.props.model, self.props)
             self._fn = new_fn
-            with self._cache_lock:
-                self._cache.clear()
+            self._jit = None  # recompile against the new model

@@ -51,11 +51,4 @@ class TorchBackend(FilterBackend):
         if isinstance(out, (list, tuple)):
             return [o.numpy() for o in out]
         return [out.numpy()]
-
-    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
-        """Probe with zeros (torch has no eval_shape)."""
-        zeros = [np.zeros(s.shape, s.dtype.np_dtype) for s in in_info.specs]
-        outs = self.invoke(zeros)
-        return TensorsInfo.of(
-            *(TensorSpec(o.shape, DataType.from_any(o.dtype)) for o in outs)
-        )
+    # set_input_info: inherited zeros-probe (torch has no eval_shape)

@@ -59,6 +59,8 @@ class IntRange:
             if lo > hi:
                 return None
             return lo if lo == hi else IntRange(lo, hi)
+        if isinstance(other, ValueList):
+            return other.intersect(self)  # keep intersection symmetric
         if other in self:
             return other
         return None

@@ -77,6 +77,9 @@ class BoundingBoxes(Decoder):
         if fmt in ("yolov5", "yolov8"):
             a = np.asarray(tensors[0]).astype(np.float32)
             a = a.reshape(-1, a.shape[-1]) if a.ndim > 2 else a
+            if a.size == 0:  # zero candidates: legal on flexible streams
+                empty = np.zeros((0,), np.float32)
+                return np.zeros((0, 4), np.float32), empty, empty.astype(np.int64)
             if fmt == "yolov8":
                 transpose = (
                     self.layout == "coords-first"

@@ -113,6 +113,10 @@ class TensorAggregator(TransformElement):
         sl[dim] = slice(idx * size, (idx + 1) * size)
         return a[tuple(sl)]
 
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._window = []
+
     def handle_eos(self) -> None:
         self._window = []
         super().handle_eos()

@@ -67,6 +67,36 @@ class TensorIf(TransformElement):
         "else_option": Prop(None, str, ""),
     }
 
+    # -- negotiation --------------------------------------------------------
+    def transform_caps(self, src_pad):
+        """tensorpick changes the stream's tensor count — src caps must
+        reflect it (reference adjusts caps for TENSORPICK). Branches that
+        emit data must agree on the selection; skip branches don't count."""
+        from ..core import TensorsInfo, caps_from_tensors_info, tensors_info_from_caps
+
+        in_caps = self.sink_pads[0].caps
+        picks = None
+        for action_key, option_key in (("then", "then_option"), ("else", "else_option")):
+            action = self.props[action_key]
+            if action == "skip":
+                continue
+            branch_picks = (
+                [int(p) for p in str(self.props[option_key] or "0").split(",")]
+                if action == "tensorpick"
+                else None  # full tensor set
+            )
+            if picks is None and action == "tensorpick":
+                picks = branch_picks
+            elif branch_picks != picks and not (picks is None and branch_picks is None):
+                raise ElementError(
+                    f"{self.describe()}: then/else branches emit different "
+                    "tensor selections; caps would be inconsistent"
+                )
+        if picks is None:
+            return in_caps
+        info = tensors_info_from_caps(in_caps)
+        return caps_from_tensors_info(TensorsInfo.of(*(info.specs[i] for i in picks)))
+
     # -- condition ----------------------------------------------------------
     def _compared_value(self, buf: Buffer) -> float:
         kind = self.props["compared_value"]

@@ -145,6 +145,10 @@ class TensorConverter(TransformElement):
             return raw
         return np.asarray(t)
 
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._pending = []
+
     def handle_eos(self) -> None:
         # flush partial chunk (reference drops it; we also drop — a partial
         # batch would violate the negotiated static shape)

@@ -110,6 +110,11 @@ class DataRepoSrc(SourceElement):
         self._begin_epoch()
         return caps
 
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._epoch = 0
+        self._pos = 0
+
     def _begin_epoch(self) -> None:
         self._order = list(self._indices)
         if self.props["is_shuffle"]:

@@ -56,6 +56,11 @@ class _PacedSource(SourceElement):
         self._frame = 0
         self._t0: Optional[float] = None
 
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._frame = 0
+        self._t0 = None
+
     def _pace(self) -> Optional[dict]:
         """Returns timestamp kwargs for the next frame, or None when done."""
         n = self.props["num_buffers"]

@@ -153,6 +153,17 @@ class Element:
     def stop(self) -> None:
         """Transition to stopped; override to release resources."""
 
+    def reset_flow(self) -> None:
+        """Reset per-run stream state so the pipeline can replay after a
+        stop(): EOS latches and negotiated caps are cleared (caps are
+        re-announced by sources on the next start). Override to clear
+        element-specific accumulation; always call super()."""
+        self._eos_sent = False
+        self._negotiated = False
+        for pad in self.sink_pads + self.src_pads:
+            pad.got_eos = False
+            pad.caps = None
+
     # -- messages -----------------------------------------------------------
     def post_message(self, msg_type: MessageType, **data) -> None:
         if self.pipeline is not None:

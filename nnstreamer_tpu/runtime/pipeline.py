@@ -86,6 +86,8 @@ class Pipeline:
         self._validate_links()
         self._playing = True
         self._eos_sinks.clear()
+        for el in self.elements.values():
+            el.reset_flow()
         # start non-sources first so queues/filters are ready before data flows
         for el in self.elements.values():
             if not isinstance(el, SourceElement):

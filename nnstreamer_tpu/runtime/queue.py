@@ -34,26 +34,26 @@ class _Channel:
         self._dq: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
-
-    def _buf_count(self) -> int:
-        return sum(1 for kind, _ in self._dq if kind == "buf")
+        self._n_bufs = 0  # buffers in _dq (events excluded), O(1) hot path
 
     def put_buf(self, buf: Buffer) -> None:
         with self._cond:
-            if self.capacity > 0 and self._buf_count() >= self.capacity:
+            if self.capacity > 0 and self._n_bufs >= self.capacity:
                 if self.leaky == "upstream":
                     return  # drop the incoming (newest) buffer
                 if self.leaky == "downstream":
                     for i, (kind, _) in enumerate(self._dq):
                         if kind == "buf":
                             del self._dq[i]  # drop the oldest buffer
+                            self._n_bufs -= 1
                             break
                 else:
-                    while not self._closed and self._buf_count() >= self.capacity:
+                    while not self._closed and self._n_bufs >= self.capacity:
                         self._cond.wait()  # backpressure
                     if self._closed:
                         return
             self._dq.append(("buf", buf))
+            self._n_bufs += 1
             self._cond.notify_all()
 
     def put_event(self, event: Event) -> None:
@@ -72,12 +72,15 @@ class _Channel:
             while not self._dq:
                 self._cond.wait()
             item = self._dq.popleft()
+            if item[0] == "buf":
+                self._n_bufs -= 1
             self._cond.notify_all()
             return item
 
     def clear(self) -> None:
         with self._cond:
             self._dq.clear()
+            self._n_bufs = 0
             self._cond.notify_all()
 
     def reopen(self) -> None:

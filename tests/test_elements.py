@@ -372,3 +372,31 @@ class TestDebug:
             "tensor_src num-buffers=2 dimensions=2 ! tensor_debug ! tensor_sink name=out"
         )
         assert len(bufs) == 2
+
+
+class TestIfTensorpickCaps:
+    def test_tensorpick_negotiates_reduced_caps(self):
+        bufs = run_collect(
+            "tensor_src num-buffers=2 dimensions=2.5 types=float32 pattern=ones "
+            "! tensor_if compared-value=a-value compared-value-option=0:0 "
+            "operator=ge supplied-value=0 then=tensorpick then-option=1 else=skip "
+            "! tensor_filter framework=jax model=builtin://scaler?factor=4 "
+            "! tensor_sink name=out"
+        )
+        assert len(bufs) == 2
+        assert np.asarray(bufs[0].tensors[0]).shape == (5,)
+        assert np.allclose(np.asarray(bufs[0].tensors[0]), 4.0)
+
+    def test_conflicting_branch_selections_error(self):
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            "tensor_src num-buffers=1 dimensions=2.5 types=float32 "
+            "! tensor_if compared-value=a-value compared-value-option=0:0 "
+            "operator=ge supplied-value=0 then=tensorpick then-option=1 "
+            "else=passthrough ! tensor_sink name=out"
+        )
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=5)
+        pipe.stop()
+        assert msg is not None and "tensor selections" in msg.data["error"]

@@ -1,0 +1,100 @@
+"""Sharding/parallelism tests on the 8-device virtual CPU mesh (SURVEY.md §4:
+"multi-node without a cluster")."""
+import numpy as np
+import pytest
+
+import jax
+
+from nnstreamer_tpu.parallel.mesh import AXES, factor_devices, make_mesh
+from nnstreamer_tpu.parallel.shard import ShardedRunner
+
+
+class TestMesh:
+    def test_factor_devices(self):
+        assert factor_devices(8) == {"dp": 2, "tp": 2, "sp": 2}
+        assert factor_devices(4) == {"dp": 2, "tp": 2, "sp": 1}
+        f6 = factor_devices(6)
+        assert f6["dp"] * f6["tp"] * f6["sp"] == 6
+        assert factor_devices(7) == {"dp": 7, "tp": 1, "sp": 1}
+        assert factor_devices(1) == {"dp": 1, "tp": 1, "sp": 1}
+
+    def test_make_mesh_8(self):
+        mesh = make_mesh()
+        assert mesh.devices.size == 8
+        assert set(mesh.axis_names) == set(AXES)
+
+
+class TestTransformerSharded:
+    def test_loss_decreases_on_mesh(self):
+        from nnstreamer_tpu.models.transformer import (
+            TransformerConfig,
+            init_params,
+            make_train_step,
+        )
+
+        mesh = make_mesh()
+        cfg = TransformerConfig(vocab=64, dim=32, heads=4, layers=2, max_seq=17)
+        params = init_params(cfg)
+        step, shard_params, data_sharding = make_train_step(cfg, mesh, lr=0.05)
+        params = shard_params(params)
+        rng = np.random.default_rng(0)
+        # a memorizable repeating pattern
+        tokens = np.tile(np.arange(16, dtype=np.int32), (4, 2))[:, :17]
+        tokens = jax.device_put(tokens, data_sharding)
+        losses = []
+        for _ in range(10):
+            params, loss = step(params, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.9
+        # params actually sharded over the mesh
+        wqkv = params["blocks"][0]["wqkv"]
+        assert len(wqkv.addressable_shards) == 8
+
+    def test_sharded_matches_single_device(self):
+        from nnstreamer_tpu.models.transformer import (
+            TransformerConfig,
+            init_params,
+            loss_fn,
+        )
+
+        cfg = TransformerConfig(vocab=32, dim=32, heads=2, layers=1, max_seq=9)
+        params = init_params(cfg)
+        tokens = np.random.default_rng(1).integers(0, 32, (2, 9)).astype(np.int32)
+        ref = float(loss_fn(cfg, params, tokens))
+        mesh = make_mesh()
+        from nnstreamer_tpu.models.transformer import make_train_step
+
+        step, shard_params, data_sharding = make_train_step(cfg, mesh, lr=0.0)
+        sharded = shard_params(params)
+        # batch=2 not divisible by dp=2*... pad to 4? dp=2 here; 2 is fine
+        tok = jax.device_put(np.tile(tokens, (2, 1)), data_sharding)
+        _, loss = step(sharded, tok)
+        assert abs(float(loss) - ref) < 1e-4  # same loss distributed vs single
+
+
+class TestShardedRunner:
+    def test_dp_batch_split(self):
+        runner = ShardedRunner(lambda x: x * 2 + 1)
+        batch = np.arange(16, dtype=np.float32).reshape(16, 1)
+        out = np.asarray(runner(batch))
+        assert np.allclose(out, batch * 2 + 1)
+        assert runner.batch_divisor == 8
+
+    def test_indivisible_batch_rejected(self):
+        runner = ShardedRunner(lambda x: x)
+        with pytest.raises(ValueError, match="not divisible"):
+            runner(np.zeros((3, 2), np.float32))
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip(self):
+        import __graft_entry__
+
+        __graft_entry__.dryrun_multichip(8)
+
+    def test_entry_compiles(self):
+        import __graft_entry__
+
+        fn, args = __graft_entry__.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (1, 1001)

@@ -1,0 +1,153 @@
+"""Among-device query/offload tests — loopback, the reference's approach
+(SURVEY.md §4: tests/nnstreamer_edge/query/runTest.sh echo server,
+multi-client; free ports picked dynamically)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, MessageType
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+def start_echo_server(port=0, model="builtin://passthrough", server_id=0):
+    """Server pipeline: serversrc ! filter ! serversink (reference echo test)."""
+    pipe = parse_launch(
+        f"tensor_query_serversrc name=ssrc id={server_id} port={port} "
+        "caps=other/tensors,format=static,dimensions=4,types=float32 "
+        f"! tensor_filter framework=jax model={model} "
+        f"! tensor_query_serversink id={server_id}"
+    )
+    pipe.play()
+    # wait for the listener to bind
+    src = pipe.get("ssrc")
+    deadline = time.monotonic() + 5
+    while src.bound_port == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return pipe, src.bound_port
+
+
+class TestQueryLoopback:
+    def test_echo_roundtrip(self):
+        server, port = start_echo_server(model="builtin://scaler?factor=3")
+        try:
+            client = parse_launch(
+                "appsrc name=in caps=other/tensors,format=static,dimensions=4,types=float32 "
+                f"! tensor_query_client host=127.0.0.1 port={port} "
+                "! tensor_sink name=out"
+            )
+            out = []
+            client.get("out").connect(out.append)
+            client.play()
+            src = client.get("in")
+            for i in range(3):
+                src.push_buffer(np.full(4, i, np.float32))
+            src.end_of_stream()
+            deadline = time.monotonic() + 10
+            while len(out) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            client.stop()
+            assert len(out) == 3
+            assert np.allclose(np.asarray(out[1].tensors[0]), 3.0)  # 1*3
+        finally:
+            server.stop()
+
+    def test_multi_client_routing(self):
+        server, port = start_echo_server(model="builtin://passthrough", server_id=1)
+        try:
+            clients, outs = [], []
+            for c in range(3):
+                pipe = parse_launch(
+                    "appsrc name=in caps=other/tensors,format=static,dimensions=4,types=float32 "
+                    f"! tensor_query_client host=127.0.0.1 port={port} "
+                    "! tensor_sink name=out"
+                )
+                collected = []
+                pipe.get("out").connect(collected.append)
+                pipe.play()
+                clients.append(pipe)
+                outs.append(collected)
+            # each client sends its own value; answers must route back correctly
+            for c, pipe in enumerate(clients):
+                pipe.get("in").push_buffer(np.full(4, c * 10.0, np.float32))
+            deadline = time.monotonic() + 10
+            while any(len(o) < 1 for o in outs) and time.monotonic() < deadline:
+                time.sleep(0.02)
+            for c, collected in enumerate(outs):
+                assert len(collected) == 1, f"client {c} got {len(collected)}"
+                assert np.allclose(np.asarray(collected[0].tensors[0]), c * 10.0)
+        finally:
+            for pipe in clients:
+                pipe.stop()
+            server.stop()
+
+    def test_caps_mismatch_rejected(self):
+        server, port = start_echo_server(server_id=2)
+        try:
+            client = parse_launch(
+                "appsrc name=in caps=other/tensors,format=static,dimensions=9,types=int32 "
+                f"! tensor_query_client host=127.0.0.1 port={port} "
+                "! tensor_sink name=out"
+            )
+            client.play()
+            # the handshake itself rejects the caps (remote negotiation)
+            msg = client.bus.wait_for((MessageType.ERROR,), timeout=5)
+            assert msg is not None
+            assert "rejected" in msg.data["error"]
+            client.stop()
+        finally:
+            server.stop()
+
+
+class TestEdgePubSub:
+    def test_topic_stream(self):
+        pub = parse_launch(
+            "tensor_src num-buffers=200 dimensions=2 types=float32 pattern=counter "
+            "framerate=100 ! edgesink name=pub topic=sensor port=0"
+        )
+        pub.play()
+        deadline = time.monotonic() + 5
+        while pub.get("pub").bound_port == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        port = pub.get("pub").bound_port
+        try:
+            sub = parse_launch(
+                f"edgesrc dest-host=127.0.0.1 dest-port={port} topic=sensor "
+                "! tensor_sink name=out"
+            )
+            out = []
+            sub.get("out").connect(out.append)
+            sub.play()
+            deadline = time.monotonic() + 10
+            while len(out) < 5 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            sub.stop()
+            assert len(out) >= 5
+            vals = [float(np.asarray(b.tensors[0])[0]) for b in out]
+            assert vals == sorted(vals)  # in-order delivery
+        finally:
+            pub.stop()
+
+    def test_unknown_topic(self):
+        pub = parse_launch(
+            "tensor_src num-buffers=50 dimensions=1 framerate=50 "
+            "! edgesink name=pub topic=real port=0"
+        )
+        pub.play()
+        deadline = time.monotonic() + 5
+        while pub.get("pub").bound_port == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        port = pub.get("pub").bound_port
+        try:
+            sub = parse_launch(
+                f"edgesrc dest-host=127.0.0.1 dest-port={port} topic=nope "
+                "! tensor_sink name=out"
+            )
+            sub.play()
+            msg = sub.bus.wait_for((MessageType.ERROR,), timeout=5)
+            assert msg is not None
+            assert "unknown topic" in msg.data["error"]
+            sub.stop()
+        finally:
+            pub.stop()

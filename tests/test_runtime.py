@@ -130,3 +130,27 @@ class TestLeakyQueue:
         pipe.run(timeout=20)
         assert len(seen) < 50  # some frames were dropped
         assert seen == sorted(seen)  # order preserved
+
+
+class TestReplay:
+    def test_pipeline_replays_after_stop(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=3 dimensions=2 ! queue ! tensor_sink name=out"
+        )
+        pipe.run(timeout=10)
+        assert pipe.get("out").buffer_count == 3
+        pipe.run(timeout=10)  # second run must replay cleanly
+        assert pipe.get("out").buffer_count == 6
+
+    def test_filter_chain_replays(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=2 dimensions=2 types=float32 pattern=ones "
+            "! tensor_filter framework=jax model=builtin://scaler?factor=2 "
+            "! tensor_filter framework=jax model=builtin://add?value=1 "
+            "! tensor_sink name=out"
+        )
+        pipe.run(timeout=15)
+        pipe.run(timeout=15)
+        sink = pipe.get("out")
+        assert sink.buffer_count == 4
+        assert np.all(np.asarray(sink.pull().tensors[0]) == 3.0)  # 1*2+1

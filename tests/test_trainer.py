@@ -1,0 +1,116 @@
+"""In-pipeline training tests (reference analog: tensor_trainer + datarepo
+training pipelines, SURVEY.md §3.5; checkpoint/resume §5.4)."""
+import textwrap
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import MessageType
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+MODEL_CONFIG = textwrap.dedent("""
+    import jax
+    import jax.numpy as jnp
+
+    # linear regression: y = x @ w + b
+    def init(rng, example_inputs):
+        x = example_inputs[0]
+        return {
+            "w": jnp.zeros((x.shape[-1], 1), jnp.float32),
+            "b": jnp.zeros((1,), jnp.float32),
+        }
+
+    def loss_fn(params, inputs, labels):
+        x, y = inputs[0], labels[0]
+        pred = x @ params["w"] + params["b"]
+        loss = jnp.mean((pred - y) ** 2)
+        return loss, {"accuracy": jnp.exp(-loss)}
+""")
+
+
+@pytest.fixture
+def model_config(tmp_path):
+    p = tmp_path / "linreg.py"
+    p.write_text(MODEL_CONFIG)
+    return str(p)
+
+
+def make_dataset(tmp_path, n=64):
+    """Write (x, y=2x+1) sample pairs through datareposink."""
+    rng = np.random.default_rng(0)
+    data, meta = str(tmp_path / "d.dat"), str(tmp_path / "d.json")
+    pipe = parse_launch(
+        "appsrc name=in caps=other/tensors,format=static,dimensions=3.1,types=float32 "
+        f"! datareposink location={data} json={meta}"
+    )
+    pipe.play()
+    src = pipe.get("in")
+    for _ in range(n):
+        x = rng.normal(size=3).astype(np.float32)
+        y = np.array([2 * x.sum() + 1], np.float32)
+        src.push_buffer([x, y])
+    src.end_of_stream()
+    pipe.wait(timeout=15)
+    pipe.stop()
+    return data, meta
+
+
+class TestTrainer:
+    def test_training_reduces_loss_and_saves(self, tmp_path, model_config):
+        data, meta = make_dataset(tmp_path)
+        save = str(tmp_path / "model.msgpack")
+        pipe = parse_launch(
+            f"datareposrc location={data} json={meta} epochs=8 "
+            f"! tensor_trainer name=t model-config={model_config} "
+            f"model-save-path={save} num-training-samples=64 epochs=8 "
+            "custom=batch:16,lr:0.1"
+        )
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ELEMENT, MessageType.ERROR), timeout=60)
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert msg is not None and msg.type is MessageType.ELEMENT
+        assert msg.data["event"] == "training-complete"
+        assert msg.data["epochs"] == 8
+        assert msg.data["samples"] == 64 * 8
+        backend = None  # element already stopped; use message payload
+        assert msg.data["training_loss"] < 1.0  # started ~ (2x+1)^2 scale
+        import os
+        assert os.path.exists(save)
+
+    def test_resume_from_checkpoint(self, tmp_path, model_config):
+        data, meta = make_dataset(tmp_path)
+        ckpt1 = str(tmp_path / "m1.msgpack")
+        pipe = parse_launch(
+            f"datareposrc location={data} json={meta} epochs=4 "
+            f"! tensor_trainer model-config={model_config} model-save-path={ckpt1} "
+            "num-training-samples=64 epochs=4 custom=batch:16,lr:0.1"
+        )
+        pipe.play()
+        m1 = pipe.bus.wait_for((MessageType.ELEMENT,), timeout=60)
+        pipe.wait(timeout=30)
+        pipe.stop()
+        loss1 = m1.data["training_loss"]
+
+        ckpt2 = str(tmp_path / "m2.msgpack")
+        pipe2 = parse_launch(
+            f"datareposrc location={data} json={meta} epochs=4 "
+            f"! tensor_trainer model-config={model_config} model-load-path={ckpt1} "
+            f"model-save-path={ckpt2} num-training-samples=64 epochs=4 "
+            "custom=batch:16,lr:0.1"
+        )
+        pipe2.play()
+        m2 = pipe2.bus.wait_for((MessageType.ELEMENT,), timeout=60)
+        pipe2.wait(timeout=30)
+        pipe2.stop()
+        assert m2.data["training_loss"] < loss1  # resumed training improves
+
+    def test_wrong_tensor_count_errors(self, model_config):
+        pipe = parse_launch(
+            "tensor_src num-buffers=1 dimensions=3 types=float32 "
+            f"! tensor_trainer model-config={model_config} num-inputs=1 num-labels=1"
+        )
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+        pipe.stop()
+        assert msg is not None and "expected 1 inputs" in msg.data["error"]
