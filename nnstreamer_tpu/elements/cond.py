@@ -75,23 +75,24 @@ class TensorIf(TransformElement):
         from ..core import TensorsInfo, caps_from_tensors_info, tensors_info_from_caps
 
         in_caps = self.sink_pads[0].caps
-        picks = None
+        # collect each emitting branch's selection (None = full tensor set);
+        # all emitting branches must agree, regardless of then/else order
+        selections = []
         for action_key, option_key in (("then", "then_option"), ("else", "else_option")):
             action = self.props[action_key]
             if action == "skip":
                 continue
-            branch_picks = (
+            selections.append(
                 [int(p) for p in str(self.props[option_key] or "0").split(",")]
                 if action == "tensorpick"
                 else None  # full tensor set
             )
-            if picks is None and action == "tensorpick":
-                picks = branch_picks
-            elif branch_picks != picks and not (picks is None and branch_picks is None):
-                raise ElementError(
-                    f"{self.describe()}: then/else branches emit different "
-                    "tensor selections; caps would be inconsistent"
-                )
+        if len(set(map(repr, selections))) > 1:
+            raise ElementError(
+                f"{self.describe()}: then/else branches emit different "
+                "tensor selections; caps would be inconsistent"
+            )
+        picks = selections[0] if selections else None
         if picks is None:
             return in_caps
         info = tensors_info_from_caps(in_caps)

@@ -65,18 +65,21 @@ class TensorTrainer(SinkElement):
         self.backend.push_data(arrays[:n_in], arrays[n_in:])
         self._pushed += 1
 
+    PROPERTIES_EOS_TIMEOUT_S = 120.0
+
     def handle_eos(self) -> None:
         if self.backend is not None:
             self.backend.end_of_data()
-            self.backend.wait_complete(timeout=120.0)
+            done = self.backend.wait_complete(timeout=self.PROPERTIES_EOS_TIMEOUT_S)
             s = self.backend.stats
+            saved = self.props["model_save_path"] or None
             self.post_message(
                 MessageType.ELEMENT,
-                event="training-complete",
+                event="training-complete" if done else "training-timeout",
                 epochs=s.epoch_count,
                 training_loss=s.training_loss,
                 training_accuracy=s.training_accuracy,
-                model_saved=self.props["model_save_path"] or None,
+                model_saved=saved if done else None,
                 samples=self._pushed,
             )
         super().handle_eos()

@@ -26,6 +26,10 @@ class TransformerConfig:
     layers: int = 2
     mlp_mult: int = 4
     max_seq: int = 128
+    # attention impl: "gspmd" (sharding-constraint driven, XLA picks the
+    # collectives), "ring" (ppermute ring attention over sp), "ulysses"
+    # (all_to_all head/seq reshard over sp) — see parallel/context.py
+    attn_impl: str = "gspmd"
 
     @property
     def head_dim(self) -> int:
@@ -103,6 +107,12 @@ def forward(cfg: TransformerConfig, params, tokens, mesh=None):
             x, NamedSharding(mesh, P(*spec))
         )
 
+    ctx_attn = None
+    if mesh is not None and cfg.attn_impl != "gspmd":
+        from ..parallel.context import make_context_attention
+
+        ctx_attn = make_context_attention(mesh, impl=cfg.attn_impl)
+
     B, S = tokens.shape
     x = params["embed"][tokens] + params["pos"][:S][None, :, :]
     x = constrain(x, "dp", "sp", None)
@@ -116,10 +126,14 @@ def forward(cfg: TransformerConfig, params, tokens, mesh=None):
             return t.reshape(B, S, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
 
         q, kk, v = heads(q), heads(kk), heads(v)   # (B,H,S,Dh)
-        att = (q @ kk.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
-        att = jnp.where(mask[None, None], att, -1e30)
-        att = jax.nn.softmax(att, axis=-1)
-        o = (att @ v).transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        if ctx_attn is not None:
+            o = ctx_attn(q, kk, v)
+            o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        else:
+            att = (q @ kk.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+            att = jnp.where(mask[None, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1)
+            o = (att @ v).transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
         x = x + o @ blk["wo"]
         x = constrain(x, "dp", "sp", None)
         h = _rmsnorm(x, blk["ln2"])

@@ -148,16 +148,33 @@ class TensorQueryServerSink(SinkElement):
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
     PROPERTIES = {"id": Prop(0, int, "shared server id (pairs src and sink)")}
 
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.server: Optional[QueryServer] = None
+
+    def start(self) -> None:
+        if self.server is None:
+            self.server = get_shared_server(self.props["id"])
+        super().start()
+
     def set_caps(self, pad: Pad, caps: Caps) -> None:
-        server = get_shared_server(self.props["id"])
-        server.caps = caps  # advertised to clients in the handshake
+        if self.server is None:
+            self.server = get_shared_server(self.props["id"])
+        self.server.caps = caps  # advertised to clients in the handshake
 
     def render(self, buf: Buffer) -> None:
         client_id = buf.meta.get("client_id")
         if client_id is None:
             logger.warning("%s: answer without client_id meta dropped", self.name)
             return
-        get_shared_server(self.props["id"]).send(client_id, buf)
+        if self.server is not None:
+            self.server.send(client_id, buf)
+
+    def stop(self) -> None:
+        super().stop()
+        if self.server is not None:
+            release_shared_server(self.props["id"])
+            self.server = None
 
 
 # ---------------------------------------------------------------------------

@@ -148,21 +148,32 @@ class QueryServer:
 # Shared per-id server table (reference tensor_query_server.c:76-117):
 # serversrc and serversink with the same id use one QueryServer.
 _servers: Dict[int, QueryServer] = {}
+_server_refs: Dict[int, int] = {}
 _servers_lock = threading.Lock()
 
 
 def get_shared_server(server_id: int, host: str = "127.0.0.1",
                       port: int = 0) -> QueryServer:
+    """Acquire the shared server for ``server_id`` (refcounted: serversrc and
+    serversink each acquire in start() and release in stop(), mirroring the
+    reference's shared edge-handle table, tensor_query_server.c:76-117)."""
     with _servers_lock:
         srv = _servers.get(server_id)
         if srv is None:
             srv = QueryServer(host, port).start()
             _servers[server_id] = srv
+            _server_refs[server_id] = 0
+        _server_refs[server_id] += 1
         return srv
 
 
 def release_shared_server(server_id: int) -> None:
     with _servers_lock:
-        srv = _servers.pop(server_id, None)
-    if srv is not None:
-        srv.stop()
+        if server_id not in _servers:
+            return
+        _server_refs[server_id] -= 1
+        if _server_refs[server_id] > 0:
+            return
+        srv = _servers.pop(server_id)
+        _server_refs.pop(server_id, None)
+    srv.stop()

@@ -73,18 +73,40 @@ class OptaxTrainer(TrainerBackend):
         self._thread.start()
 
     def push_data(self, inputs: Sequence[Any], labels: Sequence[Any]) -> None:
-        self._q.put(("data", [np.asarray(x) for x in inputs],
-                     [np.asarray(y) for y in labels]))
+        item = ("data", [np.asarray(x) for x in inputs],
+                [np.asarray(y) for y in labels])
+        # bounded put that never deadlocks: once the training thread exits
+        # (epoch target reached) the queue has no consumer — drop instead of
+        # blocking the streaming thread forever
+        while self._running.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return
+            except _queue.Full:
+                if self._thread is None or not self._thread.is_alive():
+                    return
 
     def end_of_data(self) -> None:
-        self._q.put(("end", None, None))
+        try:
+            self._q.put_nowait(("end", None, None))
+        except _queue.Full:
+            pass  # thread already finished its epochs; _complete is/will be set
 
     def wait_complete(self, timeout: float = 60.0) -> bool:
         return self._complete.wait(timeout)
 
     def stop(self) -> None:
         self._running.clear()
-        self._q.put(("stop", None, None))
+        # drain so the sentinel always fits and a dead consumer can't block us
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
+        try:
+            self._q.put_nowait(("stop", None, None))
+        except _queue.Full:
+            pass
         if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join(timeout=10.0)
         self._thread = None

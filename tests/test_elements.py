@@ -400,3 +400,19 @@ class TestIfTensorpickCaps:
         msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=5)
         pipe.stop()
         assert msg is not None and "tensor selections" in msg.data["error"]
+
+    def test_conflicting_branch_selections_error_reversed_order(self):
+        # then=passthrough (full set) + else=tensorpick must error too —
+        # the check may not depend on which branch holds the pick
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            "tensor_src num-buffers=1 dimensions=2.5 types=float32 "
+            "! tensor_if compared-value=a-value compared-value-option=0:0 "
+            "operator=ge supplied-value=0 then=passthrough "
+            "else=tensorpick else-option=1 ! tensor_sink name=out"
+        )
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=5)
+        pipe.stop()
+        assert msg is not None and "tensor selections" in msg.data["error"]

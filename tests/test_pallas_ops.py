@@ -1,0 +1,37 @@
+"""Pallas kernels via interpret mode on CPU (no TPU in CI)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.ops.pallas_attention import flash_attention
+
+
+def dense_attention(q, k, v, causal=True):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        S = q.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool))[None, None], s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("S,bq,bk", [(64, 32, 32), (64, 64, 16)])
+def test_flash_matches_dense(causal, S, bq, bk):
+    rng = np.random.default_rng(0)
+    shape = (2, 2, S, 16)
+    q, k, v = (jnp.asarray(rng.standard_normal(shape), jnp.float32)
+               for _ in range(3))
+    want = dense_attention(q, k, v, causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_rejects_ragged_seq():
+    q = jnp.zeros((1, 1, 100, 16), jnp.float32)
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
