@@ -25,44 +25,61 @@ MAGIC = b"NNST"
 VERSION = 1
 
 
-def pack_tensors(buf: Buffer, extra_meta: Optional[dict] = None) -> bytes:
+def pack_tensors(buf: Buffer, extra_meta: Optional[dict] = None) -> memoryview:
+    """Serialize one frame into a single freshly-gathered buffer.
+
+    Headers are built in Python (tiny); tensor payloads are copied exactly
+    once, by one native memcpy-gather pass — the reference's encoders pay a
+    per-tensor copy plus a join copy. Returns a ``memoryview`` (socket send
+    paths consume it without another copy; call ``bytes()`` if an owning
+    immutable copy is needed).
+    """
+    from .. import native
+
     arrays = [np.ascontiguousarray(np.asarray(t)) for t in buf.as_numpy().tensors]
     meta = {k: v for k, v in buf.meta.items() if _jsonable(v)}
     if extra_meta:
         meta.update(extra_meta)
     meta_blob = json.dumps(meta).encode()
-    parts = [
-        MAGIC,
-        struct.pack("<HIdI", VERSION, len(arrays),
-                    math.nan if buf.pts is None else buf.pts, len(meta_blob)),
-        meta_blob,
-    ]
+    parts: List[np.ndarray] = [_bview(
+        MAGIC
+        + struct.pack("<HIdI", VERSION, len(arrays),
+                      math.nan if buf.pts is None else buf.pts, len(meta_blob))
+        + meta_blob
+    )]
     for a in arrays:
         dt = DataType.from_any(a.dtype).value.encode()
-        parts.append(struct.pack("<B", len(dt)))
-        parts.append(dt)
-        parts.append(struct.pack("<B", a.ndim))
-        parts.append(struct.pack(f"<{a.ndim}Q", *a.shape))
-        parts.append(struct.pack("<Q", a.nbytes))
-        parts.append(a.tobytes())
-    return b"".join(parts)
+        header = (
+            struct.pack("<B", len(dt)) + dt + struct.pack("<B", a.ndim)
+            + struct.pack(f"<{a.ndim}Q", *a.shape) + struct.pack("<Q", a.nbytes)
+        )
+        parts.append(_bview(header))
+        parts.append(a.reshape(-1).view(np.uint8))
+    return native.gather(parts).data
 
 
-def unpack_tensors(blob: bytes) -> Buffer:
-    if blob[:4] != MAGIC:
+def _bview(b: bytes) -> np.ndarray:
+    return np.frombuffer(b, np.uint8)
+
+
+def unpack_tensors(blob) -> Buffer:
+    """Deserialize one frame from any contiguous byte buffer (bytes,
+    bytearray, memoryview, or uint8 ndarray)."""
+    blob = memoryview(blob).cast("B")
+    if bytes(blob[:4]) != MAGIC:
         raise ValueError("bad tensor frame magic")
     off = 4
     version, n, pts, meta_len = struct.unpack_from("<HIdI", blob, off)
     if version != VERSION:
         raise ValueError(f"unsupported frame version {version}")
     off += struct.calcsize("<HIdI")
-    meta = json.loads(blob[off:off + meta_len] or b"{}")
+    meta = json.loads(bytes(blob[off:off + meta_len]) or b"{}")
     off += meta_len
     tensors = []
     for _ in range(n):
         (dt_len,) = struct.unpack_from("<B", blob, off)
         off += 1
-        dtype = DataType(blob[off:off + dt_len].decode())
+        dtype = DataType(bytes(blob[off:off + dt_len]).decode())
         off += dt_len
         (rank,) = struct.unpack_from("<B", blob, off)
         off += 1

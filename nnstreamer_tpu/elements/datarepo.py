@@ -82,6 +82,8 @@ class DataRepoSrc(SourceElement):
         "epochs": Prop(1, int),
         "is_shuffle": Prop(False, prop_bool, "shuffle sample order per epoch"),
         "seed": Prop(0, int, "shuffle RNG seed (reproducibility)"),
+        "use_native": Prop(True, prop_bool,
+                           "prefetch samples with the C++ reader when built"),
     }
 
     def __init__(self, name=None, **props):
@@ -92,6 +94,7 @@ class DataRepoSrc(SourceElement):
         self._pos = 0
         self._epoch = 0
         self._rng = np.random.default_rng(self.props["seed"])
+        self._native_reader = None
 
     def get_src_caps(self) -> Caps:
         with open(self.props["json"]) as fh:
@@ -108,12 +111,51 @@ class DataRepoSrc(SourceElement):
         self._indices = list(range(start, stop + 1))
         self._data = np.memmap(self.props["location"], dtype=np.uint8, mode="r")
         self._begin_epoch()
+        if self.props["use_native"]:
+            self._open_native()
         return caps
+
+    # keep the materialized multi-epoch order bounded; past this the python
+    # per-epoch path is the right trade (O(N) memory)
+    _NATIVE_MAX_ORDER = 1 << 24
+
+    def _open_native(self) -> None:
+        """Hand the full multi-epoch sample order to the C++ prefetcher so
+        disk reads overlap pipeline compute (including across epochs)."""
+        from .. import native
+
+        if self._native_reader is not None:
+            self._native_reader.close()
+            self._native_reader = None
+        if not native.available():
+            return
+        epochs = max(self.props["epochs"], 1)
+        if epochs * len(self._indices) > self._NATIVE_MAX_ORDER:
+            return
+        full_order: List[int] = []
+        rng = np.random.default_rng(self.props["seed"])
+        for _ in range(epochs):
+            epoch_order = list(self._indices)
+            if self.props["is_shuffle"]:
+                rng.shuffle(epoch_order)
+            full_order.extend(epoch_order)
+        try:
+            self._native_reader = native.RepoReader(
+                self.props["location"], self._sample_size, full_order,
+            )
+        except (OSError, RuntimeError):
+            self._native_reader = None
 
     def reset_flow(self) -> None:
         super().reset_flow()
         self._epoch = 0
         self._pos = 0
+        # replay determinism: a fresh run re-seeds the shuffle stream, so the
+        # python and native paths emit identical orders on every play()
+        self._rng = np.random.default_rng(self.props["seed"])
+        if self._native_reader is not None:
+            self._native_reader.close()
+            self._native_reader = None
 
     def _begin_epoch(self) -> None:
         self._order = list(self._indices)
@@ -122,6 +164,9 @@ class DataRepoSrc(SourceElement):
         self._pos = 0
 
     def create(self) -> Optional[Buffer]:
+        reader = self._native_reader  # local ref: stop() may null it
+        if reader is not None:
+            return self._create_native(reader)
         if self._pos >= len(self._order):
             self._epoch += 1
             if self._epoch >= self.props["epochs"]:
@@ -131,6 +176,24 @@ class DataRepoSrc(SourceElement):
         self._pos += 1
         base = idx * self._sample_size
         raw = np.asarray(self._data[base:base + self._sample_size])
+        return self._unpack(raw, idx)
+
+    def _create_native(self, reader) -> Optional[Buffer]:
+        try:
+            got = reader.next()
+        except StopIteration:
+            return None
+        except OSError as e:
+            raise ElementError(f"{self.describe()}: native read failed: {e}")
+        if got is None:  # no timeout requested, should not happen
+            return None
+        view, idx, block = got
+        try:
+            return self._unpack(view, int(idx))
+        finally:
+            reader.release(block)
+
+    def _unpack(self, raw: np.ndarray, idx: int) -> Buffer:
         tensors = []
         off = 0
         for spec in self._info.specs:
@@ -138,3 +201,16 @@ class DataRepoSrc(SourceElement):
             tensors.append(chunk.view(spec.dtype.np_dtype).reshape(spec.shape).copy())
             off += spec.nbytes
         return Buffer(tensors, offset=idx)
+
+    def stop(self) -> None:
+        # teardown order matters: drop the run flag (so the woken task thread
+        # can't emit a fake EOS), unblock a consumer stuck in next(), join the
+        # task thread, and only then free native state
+        self._running.clear()
+        reader = self._native_reader
+        if reader is not None:
+            reader.cancel()
+        super().stop()
+        if reader is not None:
+            reader.close()
+            self._native_reader = None

@@ -31,8 +31,16 @@ class MsgType(enum.IntEnum):
     ERROR = 4
 
 
-def send_msg(sock: socket.socket, msg_type: MsgType, payload: bytes = b"") -> None:
-    sock.sendall(_HEADER.pack(MAGIC, int(msg_type), len(payload)) + payload)
+def send_msg(sock: socket.socket, msg_type: MsgType, payload=b"") -> None:
+    """Send one frame; accepts bytes or a memoryview payload. Large payloads
+    go out as a second sendall so a memoryview from ``pack_tensors`` is never
+    copied into a concatenated bytes object."""
+    header = _HEADER.pack(MAGIC, int(msg_type), len(payload))
+    if len(payload) <= 1 << 13:
+        sock.sendall(header + bytes(payload))
+    else:
+        sock.sendall(header)
+        sock.sendall(payload)
 
 
 def recv_msg(sock: socket.socket) -> Optional[Tuple[MsgType, bytes]]:
