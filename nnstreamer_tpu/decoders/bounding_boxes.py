@@ -5,6 +5,10 @@ Reference analog: ``ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c``
 
   * ``mobilenet-ssd-postprocess`` (aka ``tf-ssd``): tensors
     [boxes (N,4) norm ymin,xmin,ymax,xmax; scores (N,) or (N,C)];
+  * ``mobilenet-ssd``: RAW head tensors [locations (N,4) center-variance
+    offsets; class logits (N,C)] + a prior-box file (option7, ``.npy``
+    (N,4) [cy,cx,h,w] — the reference's box_priors.txt role); sigmoid
+    scores, anchors decoded on host via models.ssd_mobilenet.decode_boxes_np;
   * ``yolov5``: (N, 5+C) rows [cx,cy,w,h,obj,cls...] (pixels or normalized);
   * ``yolov8``: (4+C, N) or (N, 4+C) rows [cx,cy,w,h,cls...];
   * ``custom``: a registered python callback (register_bbox_parser).
@@ -56,6 +60,13 @@ class BoundingBoxes(Decoder):
         # is smaller — right for real heads (84, 8400) but ambiguous when
         # N < 4+C, hence the override.
         self.layout = self.option(6, "auto")
+        self.anchors = None
+        priors = self.option(7)
+        if priors:
+            self.anchors = np.load(priors).astype(np.float32)
+        elif self.fmt == "mobilenet-ssd":
+            raise ValueError(
+                "bounding_boxes: mobilenet-ssd (raw) needs option7=<priors.npy>")
 
     def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
         return Caps.new(VIDEO_MIME, format="RGBA", width=self.width, height=self.height)
@@ -63,6 +74,16 @@ class BoundingBoxes(Decoder):
     # -- per-format parsing → normalized boxes ------------------------------
     def _parse(self, tensors) -> tuple:
         fmt = self.fmt
+        if fmt == "mobilenet-ssd":
+            from ..models.ssd_mobilenet import decode_boxes_np
+
+            loc = np.asarray(tensors[0]).reshape(-1, 4).astype(np.float32)
+            logits = np.asarray(tensors[1]).astype(np.float32)
+            logits = logits.reshape(loc.shape[0], -1)
+            boxes = decode_boxes_np(loc, self.anchors)
+            scores = 1.0 / (1.0 + np.exp(-logits))  # sigmoid
+            classes = scores.argmax(-1)
+            return boxes, scores.max(-1), classes
         if fmt in ("mobilenet-ssd-postprocess", "tf-ssd", "mp-palm-detection"):
             boxes = np.asarray(tensors[0]).reshape(-1, 4).astype(np.float32)
             scores = np.asarray(tensors[1]).astype(np.float32)

@@ -132,13 +132,15 @@ class DataRepoSrc(SourceElement):
         epochs = max(self.props["epochs"], 1)
         if epochs * len(self._indices) > self._NATIVE_MAX_ORDER:
             return
-        full_order: List[int] = []
+        idx = np.asarray(self._indices, np.uint64)
         rng = np.random.default_rng(self.props["seed"])
+        parts = []
         for _ in range(epochs):
-            epoch_order = list(self._indices)
+            e = idx.copy()
             if self.props["is_shuffle"]:
-                rng.shuffle(epoch_order)
-            full_order.extend(epoch_order)
+                rng.shuffle(e)  # same Generator draws as the python path
+            parts.append(e)
+        full_order = np.concatenate(parts) if len(parts) > 1 else parts[0]
         try:
             self._native_reader = native.RepoReader(
                 self.props["location"], self._sample_size, full_order,

@@ -76,12 +76,15 @@ def _load() -> Optional[ctypes.CDLL]:
             _build_failed = True
             return None
         if lib.nns_abi_version() != ABI_VERSION:
-            logger.warning("native ABI mismatch; rebuilding")
+            # rebuild so the NEXT process gets a good library, but don't
+            # re-dlopen here: glibc dedups by pathname and would hand back
+            # the stale mapping — fail native for this process instead
+            logger.warning("native ABI mismatch; rebuilding and disabling "
+                           "native for this process")
             os.unlink(_LIB_PATH)
-            if not _build():
-                _build_failed = True
-                return None
-            lib = ctypes.CDLL(_LIB_PATH)
+            _build()
+            _build_failed = True
+            return None
         _bind(lib)
         _lib = lib
         return _lib
@@ -158,13 +161,13 @@ class BufferPool:
         p = self._lib.nns_pool_acquire(self._h)
         return p or None
 
-    def acquire_array(self) -> Optional[np.ndarray]:
+    def acquire_array(self):
+        """Returns ``(uint8 view, block_ptr)`` or None; pass ``block_ptr``
+        back to :meth:`release` when done."""
         p = self.acquire()
         if p is None:
             return None
-        arr = _as_numpy(p, self.block_size)
-        arr._nns_block = p  # keep the raw pointer for release()
-        return arr
+        return _as_numpy(p, self.block_size), p
 
     def release(self, block: int) -> None:
         self._lib.nns_pool_release(self._h, block)
@@ -243,10 +246,11 @@ class RepoReader:
         # pool sized so the prefetcher can fill the ring while the consumer
         # holds a couple of blocks
         self._pool = BufferPool(sample_size, max_blocks=prefetch_depth + 4)
-        arr = (ctypes.c_uint64 * len(order))(*order)
+        order_arr = np.ascontiguousarray(order, dtype=np.uint64)
         self._h = lib.nns_repo_open(
-            path.encode(), sample_size, arr, len(order), self._pool._h,
-            prefetch_depth,
+            path.encode(), sample_size,
+            order_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(order_arr), self._pool._h, prefetch_depth,
         )
         if not self._h:
             self._pool.close()
@@ -317,6 +321,9 @@ def gather(parts: List[np.ndarray], out: Optional[np.ndarray] = None) -> np.ndar
 def scatter(src: np.ndarray, outs: List[np.ndarray]) -> None:
     """Split a contiguous byte buffer into the given arrays natively."""
     src = np.ascontiguousarray(src)
+    need = sum(o.nbytes for o in outs)
+    if need > src.nbytes:
+        raise ValueError(f"scatter source too small ({src.nbytes} < {need})")
     if not available():
         off = 0
         for o in outs:

@@ -1,0 +1,172 @@
+"""Model-zoo end-to-end tests: detection / segmentation / pose pipelines.
+
+Reference analogs: tests/nnstreamer_decoder_boundingbox/, _image_segment/,
+_pose/ — golden pipelines over real (tiny) models. Here the models are our
+own jax implementations at small image sizes (CPU-friendly), driven through
+the full launch-DSL path: src → filter(framework=jax) → decoder → sink.
+"""
+import textwrap
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+SIZE = 64  # tiny spatial size keeps CPU compile+run fast
+
+
+def _model_file(tmp_path, body: str):
+    f = tmp_path / "m.py"
+    f.write_text(textwrap.dedent(body))
+    return f
+
+
+def _run_one(launch: str, sink_name: str = "out", timeout: float = 120.0):
+    pipe = parse_launch(launch)
+    got = []
+    pipe.get(sink_name).connect(lambda b: got.append(b))
+    pipe.run(timeout=timeout)
+    assert got, "pipeline produced no buffers"
+    return got
+
+
+class TestSSD:
+    def test_anchors_cover_all_strides(self):
+        from nnstreamer_tpu.models.ssd_mobilenet import make_anchors
+
+        a = make_anchors(SIZE, (8, 16, 32, 64))
+        assert a.shape == (3 * (8 * 8 + 4 * 4 + 2 * 2 + 1), 4)
+        assert np.all(a[:, :2] >= 0) and np.all(a[:, :2] <= 1)
+
+    def test_device_decode_matches_host_decode(self):
+        """On-device box decode (apply_fn) == host decode_boxes_np over the
+        raw head — the two decoder paths must agree."""
+        from nnstreamer_tpu.models.ssd_mobilenet import (
+            build_ssd_mobilenet, decode_boxes_np,
+        )
+
+        apply_fn, params, anchors = build_ssd_mobilenet(
+            num_classes=5, image_size=SIZE, compute_dtype="float32")
+        x = np.random.default_rng(0).standard_normal(
+            (1, SIZE, SIZE, 3)).astype(np.float32)
+        boxes_dev, scores = apply_fn(params, x)
+        loc, logits = apply_fn.raw(params, x)
+        boxes_host = decode_boxes_np(np.asarray(loc)[0], anchors)
+        np.testing.assert_allclose(
+            np.asarray(boxes_dev)[0], boxes_host, rtol=1e-4, atol=1e-5)
+        s = np.asarray(scores)
+        assert s.min() >= 0 and s.max() <= 1
+
+    def test_detection_pipeline_postprocess_mode(self, tmp_path):
+        mf = _model_file(tmp_path, f"""
+            from nnstreamer_tpu.models.ssd_mobilenet import build_ssd_mobilenet
+            _a, _p, _ = build_ssd_mobilenet(num_classes=5, image_size={SIZE},
+                                            compute_dtype="float32")
+            def model(x):
+                return _a(_p, x)
+        """)
+        got = _run_one(
+            f"tensor_src num-buffers=2 dimensions=3:{SIZE}:{SIZE}:1 "
+            "types=float32 pattern=random "
+            f"! tensor_filter framework=jax model={mf} "
+            "! tensor_decoder mode=bounding_boxes "
+            "option1=mobilenet-ssd-postprocess option2=64:64 option4=0.0 "
+            "! tensor_sink name=out"
+        )
+        frame = np.asarray(got[0].tensors[0])
+        assert frame.shape == (64, 64, 4) and frame.dtype == np.uint8
+        assert isinstance(got[0].meta["detections"], list)
+
+    def test_detection_pipeline_raw_mode_with_priors(self, tmp_path):
+        from nnstreamer_tpu.models.ssd_mobilenet import save_anchors
+
+        priors = tmp_path / "priors.npy"
+        save_anchors(str(priors), image_size=SIZE)
+        mf = _model_file(tmp_path, f"""
+            from nnstreamer_tpu.models.ssd_mobilenet import build_ssd_mobilenet
+            _a, _p, _ = build_ssd_mobilenet(num_classes=5, image_size={SIZE},
+                                            compute_dtype="float32")
+            def model(x):
+                return _a.raw(_p, x)
+        """)
+        got = _run_one(
+            f"tensor_src num-buffers=1 dimensions=3:{SIZE}:{SIZE}:1 "
+            "types=float32 pattern=random "
+            f"! tensor_filter framework=jax model={mf} "
+            "! tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
+            f"option2=64:64 option4=0.0 option7={priors} "
+            "! tensor_sink name=out"
+        )
+        assert np.asarray(got[0].tensors[0]).shape == (64, 64, 4)
+
+    def test_raw_mode_requires_priors(self):
+        from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
+
+        dec = BoundingBoxes()
+        with pytest.raises(ValueError, match="option7"):
+            dec.init(["mobilenet-ssd"])
+
+
+class TestDeepLab:
+    def test_segmentation_pipeline(self, tmp_path):
+        mf = _model_file(tmp_path, f"""
+            from nnstreamer_tpu.models.deeplab import build_deeplab
+            _a, _p = build_deeplab(num_classes=6, image_size={SIZE},
+                                   compute_dtype="float32")
+            def model(x):
+                return _a(_p, x)
+        """)
+        got = _run_one(
+            f"tensor_src num-buffers=1 dimensions=3:{SIZE}:{SIZE}:1 "
+            "types=float32 pattern=random "
+            f"! tensor_filter framework=jax model={mf} "
+            "! tensor_decoder mode=image_segment option1=tflite-deeplab "
+            "! tensor_sink name=out"
+        )
+        frame = np.asarray(got[0].tensors[0])
+        assert frame.shape == (SIZE, SIZE, 3) and frame.dtype == np.uint8
+        assert got[0].meta["class_map"].shape == (SIZE, SIZE)
+
+    def test_logits_at_input_resolution(self):
+        from nnstreamer_tpu.models.deeplab import build_deeplab
+
+        apply_fn, params = build_deeplab(num_classes=4, image_size=32,
+                                         compute_dtype="float32")
+        out = apply_fn(params, np.zeros((2, 32, 32, 3), np.float32))
+        assert np.asarray(out).shape == (2, 32, 32, 4)
+
+
+class TestPoseNet:
+    def test_pose_pipeline_heatmap_mode(self, tmp_path):
+        mf = _model_file(tmp_path, f"""
+            from nnstreamer_tpu.models.posenet import build_posenet
+            _a, _p = build_posenet(image_size={SIZE}, compute_dtype="float32")
+            def model(x):
+                return _a(_p, x)
+        """)
+        got = _run_one(
+            f"tensor_src num-buffers=1 dimensions=3:{SIZE}:{SIZE}:1 "
+            "types=float32 pattern=random "
+            f"! tensor_filter framework=jax model={mf} "
+            "! tensor_decoder mode=pose_estimation option1=64:64 option2=heatmap "
+            "! tensor_sink name=out"
+        )
+        frame = np.asarray(got[0].tensors[0])
+        assert frame.shape == (64, 64, 4)
+        kps = got[0].meta["keypoints"]
+        assert kps.shape == (17, 2)
+        assert kps.min() >= 0.0 and kps.max() <= 1.0
+
+    def test_device_keypoints_match_host_argmax(self):
+        from nnstreamer_tpu.models.posenet import build_posenet
+
+        apply_fn, params = build_posenet(image_size=32, compute_dtype="float32")
+        x = np.random.default_rng(1).standard_normal((1, 32, 32, 3)).astype(np.float32)
+        hm = np.asarray(apply_fn(params, x))[0]
+        kps_dev = np.asarray(apply_fn.keypoints(params, x))[0]
+        hh, ww, kk = hm.shape
+        idx = hm.reshape(-1, kk).argmax(0)
+        ys, xs = np.unravel_index(idx, (hh, ww))
+        np.testing.assert_allclose(kps_dev[:, 0], xs / (ww - 1), atol=1e-6)
+        np.testing.assert_allclose(kps_dev[:, 1], ys / (hh - 1), atol=1e-6)
