@@ -6,3 +6,4 @@ unknown media to these via its ``subplugin`` property.
 """
 from .base import Converter, register_converter  # noqa: F401
 from . import bytes_converter  # noqa: F401
+from . import python_converter  # noqa: F401

@@ -5,6 +5,7 @@ Reference analog: ``ext/nnstreamer/tensor_decoder/`` (13 modes, SURVEY.md
 """
 from .base import Decoder, register_decoder  # noqa: F401
 from . import simple  # noqa: F401
+from . import font  # noqa: F401
 from . import bounding_boxes  # noqa: F401
 from . import segment_pose  # noqa: F401
 from . import serialize  # noqa: F401

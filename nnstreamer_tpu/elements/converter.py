@@ -55,6 +55,9 @@ class TensorConverter(TransformElement):
         "input_dim": Prop(None, str, "dim string for octet/text input"),
         "input_type": Prop("uint8", str, "dtype for octet/text input"),
         "subplugin": Prop(None, str, "external converter subplugin name"),
+        "subplugin_option": Prop(None, str,
+                                 "option string handed to the subplugin "
+                                 "(e.g. python3 converter .py file)"),
     }
 
     def __init__(self, name=None, **props):
@@ -72,7 +75,13 @@ class TensorConverter(TransformElement):
         n = self.props["frames_per_tensor"]
         if self.props["subplugin"]:
             cls = get_subplugin(SubpluginKind.CONVERTER, self.props["subplugin"])
-            self._ext = cls() if isinstance(cls, type) else cls
+            opt = self.props["subplugin_option"]
+            if not isinstance(cls, type):
+                self._ext = cls
+            elif opt is not None:
+                self._ext = cls(opt)
+            else:
+                self._ext = cls()
             self._mode = "external"
             self._out_info = self._ext.get_out_info(caps)
             return

@@ -38,49 +38,14 @@ def build_mobilenet_v2(num_classes: int = 1001, width_mult: float = 1.0,
     import jax.numpy as jnp
     from flax import linen as nn
 
+    from ._blocks import make_blocks
+
     cdt = jnp.dtype(compute_dtype)
+    ConvBnRelu, InvertedResidual = make_blocks(compute_dtype)
 
     def ch(c: int) -> int:
         v = max(8, int(c * width_mult + 4) // 8 * 8)
         return v
-
-    class ConvBnRelu(nn.Module):
-        features: int
-        kernel: Tuple[int, int] = (3, 3)
-        strides: int = 1
-        groups: int = 1
-        act: bool = True
-
-        @nn.compact
-        def __call__(self, x):
-            x = nn.Conv(self.features, self.kernel, strides=self.strides,
-                        padding="SAME", feature_group_count=self.groups,
-                        use_bias=False, dtype=cdt)(x)
-            # inference-mode BN = per-channel scale + bias
-            scale = self.param("bn_scale", nn.initializers.ones, (self.features,))
-            bias = self.param("bn_bias", nn.initializers.zeros, (self.features,))
-            x = x * scale.astype(cdt) + bias.astype(cdt)
-            if self.act:
-                x = jnp.minimum(jax.nn.relu(x), 6.0)  # relu6
-            return x
-
-    class InvertedResidual(nn.Module):
-        features: int
-        strides: int
-        expand: int
-
-        @nn.compact
-        def __call__(self, x):
-            in_ch = x.shape[-1]
-            h = x
-            if self.expand != 1:
-                h = ConvBnRelu(in_ch * self.expand, (1, 1))(h)
-            h = ConvBnRelu(in_ch * self.expand, (3, 3), strides=self.strides,
-                           groups=in_ch * self.expand)(h)
-            h = ConvBnRelu(self.features, (1, 1), act=False)(h)
-            if self.strides == 1 and in_ch == self.features:
-                h = h + x
-            return h
 
     class MobileNetV2(nn.Module):
         @nn.compact

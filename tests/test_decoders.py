@@ -226,3 +226,52 @@ class TestTensorRegionCropLoop:
         pipe.stop()
         crop = np.asarray(out[0].tensors[0])
         assert crop.shape == (1, 10, 10, 3)
+
+
+class TestFontDecoder:
+    def test_text_to_overlay_pipeline(self):
+        out = run_collect(
+            "appsrc name=in caps=other/tensors,format=flexible "
+            "! tensor_decoder mode=font option1=120:40 option2=1 option3=255:0:0 "
+            "! tensor_sink name=out",
+            push=[[np.frombuffer(b"HELLO 42", np.uint8)]],
+        )
+        frame = np.asarray(out[0].tensors[0])
+        assert frame.shape == (40, 120, 4) and frame.dtype == np.uint8
+        # red text on transparent canvas
+        lit = frame[..., 3] > 0
+        assert lit.any()
+        assert np.all(frame[lit][:, 0] == 255) and np.all(frame[lit][:, 1] == 0)
+        assert out[0].meta["text"] == "HELLO 42"
+
+    def test_wrapping_and_unknown_glyphs(self):
+        from nnstreamer_tpu.decoders.font import render_text
+
+        frame = render_text("ABCDEFGH\n~~", 30, 40, scale=1)
+        assert frame[..., 3].any()
+        # second row used (wrap at 5 glyphs/30px) and newline row too
+        assert frame[8:16, :, 3].any() and frame[16:24, :, 3].any()
+
+
+class TestPythonConverter:
+    def test_user_py_converter(self, tmp_path):
+        conv = tmp_path / "conv.py"
+        conv.write_text(
+            "import numpy as np\n"
+            "from nnstreamer_tpu.core import Buffer, TensorsInfo\n"
+            "from nnstreamer_tpu.core.tensors import TensorSpec\n"
+            "class Converter:\n"
+            "    def get_out_info(self, in_caps):\n"
+            "        return TensorsInfo.of(TensorSpec((4,), 'float32'))\n"
+            "    def convert(self, buf):\n"
+            "        raw = np.asarray(buf.tensors[0]).view(np.uint8)\n"
+            "        return Buffer([raw[:4].astype(np.float32)])\n"
+        )
+        out = run_collect(
+            "appsrc name=in caps=application/octet-stream "
+            f"! tensor_converter subplugin=python3 subplugin-option={conv} "
+            "! tensor_sink name=out",
+            push=[[np.arange(8, dtype=np.uint8)]],
+        )
+        t = np.asarray(out[0].tensors[0])
+        assert t.dtype == np.float32 and t.tolist() == [0.0, 1.0, 2.0, 3.0]

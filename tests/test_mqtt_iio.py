@@ -1,0 +1,198 @@
+"""MQTT elements + mini-broker + tensor_src_iio tests.
+
+Reference analogs: tests/nnstreamer_mqtt/ (skipped without a broker — ours
+embeds one), gst/mqtt unit tests with mocked paho, and the src_iio mock-
+sysfs tests.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.query import mqtt
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+class TestMqttTransport:
+    def test_pub_sub_roundtrip(self):
+        broker = mqtt.MiniBroker()
+        try:
+            got = []
+            ev = threading.Event()
+            sub = mqtt.MqttClient(broker.host, broker.port)
+            sub.subscribe("a/b", lambda t, b: (got.append((t, b)), ev.set()))
+            pub = mqtt.MqttClient(broker.host, broker.port)
+            pub.publish("a/b", b"hello")
+            assert ev.wait(5)
+            assert got == [("a/b", b"hello")]
+            sub.close()
+            pub.close()
+        finally:
+            broker.stop()
+
+    def test_retained_message_reaches_late_subscriber(self):
+        broker = mqtt.MiniBroker()
+        try:
+            pub = mqtt.MqttClient(broker.host, broker.port)
+            pub.publish("caps/topic", b"retained-caps", retain=True)
+            time.sleep(0.1)
+            got = []
+            ev = threading.Event()
+            sub = mqtt.MqttClient(broker.host, broker.port)
+            sub.subscribe("caps/#", lambda t, b: (got.append(b), ev.set()))
+            assert ev.wait(5)
+            assert got == [b"retained-caps"]
+            sub.close()
+            pub.close()
+        finally:
+            broker.stop()
+
+    def test_wildcard_matching(self):
+        m = mqtt.topic_matches
+        assert m("a/#", "a/b/c") and m("a/#", "a")
+        assert m("a/+/c", "a/b/c") and not m("a/+/c", "a/b/d")
+        assert not m("a/b", "a") and m("a/b", "a/b")
+
+
+class TestMqttElements:
+    def test_stream_over_embedded_broker(self):
+        broker = mqtt.get_embedded_broker(0)
+        port = broker.port
+        try:
+            # publisher pipeline: appsrc-driven so we control send timing
+            pub = parse_launch(
+                "appsrc name=in caps=other/tensors,format=static,"
+                "dimensions=4,types=float32 "
+                f"! mqttsink broker=embedded host=127.0.0.1 port={port} "
+                "pub-topic=nns/stream"
+            )
+            pub.play()
+            time.sleep(0.2)  # let retained caps land
+
+            got = []
+            sub = parse_launch(
+                f"mqttsrc host=127.0.0.1 port={port} sub-topic=nns/stream "
+                "num-buffers=3 ! tensor_sink name=out"
+            )
+            sub.get("out").connect(lambda b: got.append(b.as_numpy().tensors[0]))
+            sub.play()
+            # wait until the subscriber's negotiation completed (caps pulled)
+            deadline = time.time() + 10
+            while time.time() < deadline and sub.get("out").sinkpad.caps is None:
+                time.sleep(0.05)
+
+            src = pub.get("in")
+            for i in range(3):
+                src.push_buffer([np.full(4, float(i), np.float32)])
+            src.end_of_stream()
+            sub.wait(timeout=15)
+            sub.stop()
+            pub.wait(timeout=5)
+            pub.stop()
+            assert len(got) == 3
+            assert [t[0] for t in got] == [0.0, 1.0, 2.0]
+        finally:
+            mqtt.release_embedded_broker(broker)
+
+    def test_mqttsrc_timeout_without_publisher(self):
+        broker = mqtt.MiniBroker()
+        try:
+            from nnstreamer_tpu.core import MessageType
+
+            pipe = parse_launch(
+                f"mqttsrc host=127.0.0.1 port={broker.port} sub-topic=ghost "
+                "timeout=0.5 ! tensor_sink name=out"
+            )
+            pipe.play()
+            msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+            assert msg is not None and "no retained caps" in str(msg.data)
+            pipe.stop()
+        finally:
+            broker.stop()
+
+
+def _fake_iio(tmp_path, n_dev=1):
+    base = tmp_path / "iio"
+    d = base / "iio:device0"
+    scan = d / "scan_elements"
+    scan.mkdir(parents=True)
+    (d / "name").write_text("fake_accel\n")
+    (d / "in_scale").write_text("0.5\n")
+    (d / "in_offset").write_text("1.0\n")
+    for i, ch in enumerate(("in_accel_x", "in_accel_y", "in_accel_z")):
+        (scan / f"{ch}_en").write_text("1\n")
+        (scan / f"{ch}_index").write_text(f"{i}\n")
+        (scan / f"{ch}_type").write_text("le:s16/16>>0\n")
+        (d / f"{ch}_raw").write_text(f"{10 * (i + 1)}\n")
+    # a disabled channel must be skipped
+    (scan / "in_temp_en").write_text("0\n")
+    (scan / "in_temp_index").write_text("9\n")
+    (scan / "in_temp_type").write_text("le:s16/16>>0\n")
+    return base
+
+
+class TestSrcIIO:
+    def test_polled_scan_to_tensors(self, tmp_path):
+        base = _fake_iio(tmp_path)
+        got = []
+        pipe = parse_launch(
+            f"tensor_src_iio device=fake_accel base-dir={base} frequency=500 "
+            "num-buffers=2 ! tensor_sink name=out"
+        )
+        pipe.get("out").connect(lambda b: got.append(b.as_numpy().tensors[0]))
+        pipe.run(timeout=20)
+        assert len(got) == 2
+        # (raw + offset) * scale with offset=1.0 scale=0.5
+        np.testing.assert_allclose(got[0], [(10 + 1) * 0.5, (20 + 1) * 0.5,
+                                            (30 + 1) * 0.5])
+        assert got[0].dtype == np.float32
+
+    def test_raw_mode_and_device_number(self, tmp_path):
+        base = _fake_iio(tmp_path)
+        got = []
+        pipe = parse_launch(
+            f"tensor_src_iio device-number=0 base-dir={base} frequency=500 "
+            "raw=true num-buffers=1 ! tensor_sink name=out"
+        )
+        pipe.get("out").connect(lambda b: got.append(b.as_numpy().tensors[0]))
+        pipe.run(timeout=20)
+        assert got[0].dtype == np.int32
+        assert got[0].tolist() == [10, 20, 30]
+
+    def test_missing_device_errors(self, tmp_path):
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            f"tensor_src_iio device=ghost base-dir={tmp_path} ! tensor_sink name=out"
+        )
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+        assert msg is not None
+        pipe.stop()
+
+    def test_type_string_parsing(self):
+        from nnstreamer_tpu.elements.iio import _Channel
+
+        c = _Channel("x", 0, "le:s12/16>>4")
+        assert c.decode(b"\xf0\x7f") == 2047   # 0x7FF0>>4 = 0x7FF max positive
+        assert c.decode(b"\x00\x80") == -2048  # 0x8000>>4 = sign bit set
+        c2 = _Channel("y", 1, "be:u8/8>>0")
+        assert c2.decode(b"\xff") == 255
+
+    def test_buffered_scan_layout_alignment(self):
+        """Kernel IIO scan layout: elements align to their own storage size
+        (3x s16 + s64 timestamp -> offsets 0,2,4,8; total 16, not 14)."""
+        from nnstreamer_tpu.elements.iio import TensorSrcIIO, _Channel
+
+        el = TensorSrcIIO()
+        el._channels = [
+            _Channel("x", 0, "le:s16/16>>0"),
+            _Channel("y", 1, "le:s16/16>>0"),
+            _Channel("z", 2, "le:s16/16>>0"),
+            _Channel("ts", 3, "le:s64/64>>0"),
+        ]
+        offsets, total = el._scan_layout()
+        assert offsets == [0, 2, 4, 8]
+        assert total == 16
