@@ -15,8 +15,6 @@ decoder's ``tflite-deeplab`` mode consumes (argmax → palette).
 """
 from __future__ import annotations
 
-from typing import Tuple
-
 _NUM_CLASSES = 21  # PASCAL-VOC, like the reference's deeplab demo
 
 

@@ -13,8 +13,6 @@ importable without a tflite parser); throughput/latency are weight-agnostic.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence, Tuple
-
 import numpy as np
 
 # (expansion t, output channels c, repeats n, stride s) — the standard

@@ -10,8 +10,6 @@ the ``pose_estimation`` decoder (host) or can be fused on device via
 """
 from __future__ import annotations
 
-from typing import Tuple
-
 _NUM_KEYPOINTS = 17
 
 

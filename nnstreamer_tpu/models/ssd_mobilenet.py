@@ -21,7 +21,7 @@ same rationale as models/mobilenet_v2.py).
 """
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Sequence
 
 import numpy as np
 

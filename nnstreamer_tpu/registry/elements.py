@@ -80,3 +80,13 @@ def make_element(factory_name: str, name=None, **props) -> Element:
 def element_factories() -> List[str]:
     load_standard_elements()
     return sorted(_FACTORIES)
+
+
+def get_factory(factory_name: str) -> Type[Element]:
+    """The element class for a factory name (no instantiation)."""
+    load_standard_elements()
+    if factory_name not in _FACTORIES:
+        raise ValueError(
+            f"no such element '{factory_name}' (known: {sorted(_FACTORIES)})"
+        )
+    return _FACTORIES[factory_name]

@@ -14,7 +14,10 @@ import threading
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Optional
 
+from time import monotonic as _monotonic
+
 from ..core import Buffer, Caps, Event, EventType
+from ..utils import trace
 
 if TYPE_CHECKING:
     from .element import Element
@@ -84,6 +87,11 @@ class Pad:
         peer = self.peer
         if peer is None:
             return  # unlinked src pad silently drops (reference: not-linked flow)
+        if trace.ACTIVE:  # zero-cost when tracing is off (GstShark analog)
+            t0 = _monotonic()
+            peer.element._chain_guarded(peer, buf)
+            trace.notify_flow(self, buf, _monotonic() - t0)
+            return
         peer.element._chain_guarded(peer, buf)
 
     def push_event(self, event: Event) -> None:

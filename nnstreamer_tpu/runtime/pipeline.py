@@ -83,6 +83,10 @@ class Pipeline:
     def play(self) -> "Pipeline":
         if self._playing:
             return self
+        from ..utils import trace
+
+        trace.install_from_env()   # NNS_TRACERS (GST_TRACERS analog)
+        trace.dump_dot(self)       # NNS_DOT_DIR (GST_DEBUG_DUMP_DOT_DIR)
         self._validate_links()
         self._playing = True
         self._eos_sinks.clear()
