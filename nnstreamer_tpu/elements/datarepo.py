@@ -80,6 +80,10 @@ class DataRepoSrc(SourceElement):
         "start_sample_index": Prop(0, int),
         "stop_sample_index": Prop(-1, int, "-1 = last"),
         "epochs": Prop(1, int),
+        "start_epoch": Prop(0, int,
+                            "resume: skip the first K epochs while keeping "
+                            "the seeded shuffle stream aligned (trainer "
+                            "checkpoint meta's data_epoch)"),
         "is_shuffle": Prop(False, prop_bool, "shuffle sample order per epoch"),
         "seed": Prop(0, int, "shuffle RNG seed (reproducibility)"),
         "use_native": Prop(True, prop_bool,
@@ -93,6 +97,7 @@ class DataRepoSrc(SourceElement):
         self._order: List[int] = []
         self._pos = 0
         self._epoch = 0
+        self._epochs = 1
         self._rng = np.random.default_rng(self.props["seed"])
         self._native_reader = None
 
@@ -110,7 +115,18 @@ class DataRepoSrc(SourceElement):
             raise ElementError(f"{self.describe()}: start {start} > stop {stop}")
         self._indices = list(range(start, stop + 1))
         self._data = np.memmap(self.props["location"], dtype=np.uint8, mode="r")
-        self._begin_epoch()
+        # epochs<=0 behaves as one epoch on both paths (native clamps the same)
+        self._epochs = max(self.props["epochs"], 1)
+        resume = min(max(self.props["start_epoch"], 0), self._epochs)
+        # advance the shuffle stream past the completed epochs so the resumed
+        # order continues exactly where the interrupted run left off
+        for _ in range(resume):
+            self._begin_epoch()
+        self._epoch = resume
+        if self._epoch >= self._epochs:
+            self._order = []
+        else:
+            self._begin_epoch()
         if self.props["use_native"]:
             self._open_native()
         return caps
@@ -130,16 +146,20 @@ class DataRepoSrc(SourceElement):
         if not native.available():
             return
         epochs = max(self.props["epochs"], 1)
-        if epochs * len(self._indices) > self._NATIVE_MAX_ORDER:
+        resume = min(max(self.props["start_epoch"], 0), epochs)
+        if (epochs - resume) * len(self._indices) > self._NATIVE_MAX_ORDER:
             return
         idx = np.asarray(self._indices, np.uint64)
         rng = np.random.default_rng(self.props["seed"])
         parts = []
-        for _ in range(epochs):
+        for n in range(epochs):
             e = idx.copy()
             if self.props["is_shuffle"]:
                 rng.shuffle(e)  # same Generator draws as the python path
-            parts.append(e)
+            if n >= resume:  # skipped epochs still consume the rng stream
+                parts.append(e)
+        if not parts:
+            return
         full_order = np.concatenate(parts) if len(parts) > 1 else parts[0]
         try:
             self._native_reader = native.RepoReader(
@@ -171,7 +191,7 @@ class DataRepoSrc(SourceElement):
             return self._create_native(reader)
         if self._pos >= len(self._order):
             self._epoch += 1
-            if self._epoch >= self.props["epochs"]:
+            if self._epoch >= self._epochs:
                 return None
             self._begin_epoch()
         idx = self._order[self._pos]

@@ -72,7 +72,10 @@ class TensorTrainer(SinkElement):
             self.backend.end_of_data()
             done = self.backend.wait_complete(timeout=self.PROPERTIES_EOS_TIMEOUT_S)
             s = self.backend.stats
-            saved = self.props["model_save_path"] or None
+            # report the path the backend actually wrote, not the requested
+            # one — a zero-batch run (e.g. fully-resumed) saves nothing
+            saved = getattr(self.backend, "last_saved_path",
+                            self.props["model_save_path"] or None)
             self.post_message(
                 MessageType.ELEMENT,
                 event="training-complete" if done else "training-timeout",

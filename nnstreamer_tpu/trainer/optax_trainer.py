@@ -10,7 +10,11 @@ The ``model_config`` file is a python file defining:
   * ``init(rng, example_inputs) -> params`` — parameter pytree init;
   * ``loss_fn(params, inputs, labels) -> loss`` or ``(loss, metrics)`` where
     metrics may contain "accuracy" — jax-traceable.
-Custom options: ``batch:<N>,lr:<f>,optimizer:<adam|sgd|adamw>``.
+Custom options: ``batch:<N>,lr:<f>,optimizer:<adam|sgd|adamw>,
+ckpt_dir:<dir>,ckpt_every:<epochs>`` — ``ckpt_dir`` enables full
+training-state checkpoints (params + optimizer state + epoch + histories,
+trainer/checkpoint.py) with automatic resume from the latest step; the
+reference's model-load-path only restores weights (SURVEY.md §5.4).
 """
 from __future__ import annotations
 
@@ -40,6 +44,8 @@ class OptaxTrainer(TrainerBackend):
         self._train_step = None
         self.losses: List[float] = []
         self.accuracies: List[float] = []
+        self.last_saved_path: Optional[str] = None
+        self._state_restored = False
 
     # -- config -------------------------------------------------------------
     def configure(self, props: TrainerProperties) -> None:
@@ -61,6 +67,26 @@ class OptaxTrainer(TrainerBackend):
         if name not in makers:
             raise ValueError(f"unknown optimizer '{name}' (have {sorted(makers)})")
         self._tx = makers[name](lr)
+        self._ckpt = None
+        self._ckpt_every = max(int(opts.get("ckpt_every", 1)), 1)
+        ckpt_dir = opts.get("ckpt_dir")
+        if ckpt_dir:
+            from .checkpoint import CheckpointManager
+
+            self._ckpt = CheckpointManager(ckpt_dir)
+            # restore progress meta eagerly so even a zero-data resumed run
+            # (source already past its epochs) reports true progress; the
+            # heavy state restore stays lazy in _build
+            latest = self._ckpt.latest_step()
+            if latest is not None:
+                meta = self._ckpt.read_meta(latest)
+                self.stats.epoch_count = int(meta.get("epoch_count", 0))
+                self.losses = list(meta.get("losses", []))
+                self.accuracies = list(meta.get("accuracies", []))
+                if self.losses:
+                    self.stats.training_loss = self.losses[-1]
+                if self.accuracies:
+                    self.stats.training_accuracy = self.accuracies[-1]
 
     # -- training thread ----------------------------------------------------
     def start(self) -> None:
@@ -120,6 +146,8 @@ class OptaxTrainer(TrainerBackend):
         if self.props.model_load_path and os.path.exists(self.props.model_load_path):
             self._load(self.props.model_load_path)
         self._opt_state = self._tx.init(self.params)
+        if self._ckpt is not None and self._ckpt.latest_step() is not None:
+            self._resume_from_checkpoint()
 
         loss_fn = self._loss_fn
         tx = self._tx
@@ -186,6 +214,8 @@ class OptaxTrainer(TrainerBackend):
                 self.accuracies.append(self.stats.training_accuracy)
             self.stats.epoch_count += 1
             epoch_losses, epoch_accs, seen = [], [], 0
+            if self.stats.epoch_count % self._ckpt_every == 0:
+                self.save_checkpoint()  # no-op without ckpt_dir/params
 
         while self._running.is_set():
             kind, inputs, labels = self._q.get()
@@ -209,6 +239,35 @@ class OptaxTrainer(TrainerBackend):
             self.save(props.model_save_path)
 
     # -- checkpointing ------------------------------------------------------
+    def save_checkpoint(self) -> Optional[str]:
+        """Full training state → ckpt_dir/step_<epoch> (params, opt state,
+        epoch counter, loss/accuracy history, data-iterator epoch)."""
+        if self._ckpt is None or self.params is None:
+            return None
+        meta = {
+            "epoch_count": self.stats.epoch_count,
+            "losses": self.losses,
+            "accuracies": self.accuracies,
+            # datareposrc resumes with start-epoch=<data_epoch> (same seed
+            # → identical shuffle stream continuation)
+            "data_epoch": self.stats.epoch_count,
+        }
+        return self._ckpt.save(
+            self.stats.epoch_count,
+            {"params": self.params, "opt_state": self._opt_state}, meta)
+
+    def _resume_from_checkpoint(self) -> None:
+        state, meta = self._ckpt.restore(
+            target={"params": self.params, "opt_state": self._opt_state})
+        self.params = state["params"]
+        self._opt_state = state["opt_state"]
+        self.stats.epoch_count = int(meta.get("epoch_count", 0))
+        self.losses = list(meta.get("losses", []))
+        self.accuracies = list(meta.get("accuracies", []))
+        self._state_restored = True
+        logger.info("trainer resumed at epoch %d from %s",
+                    self.stats.epoch_count, self._ckpt.directory)
+
     def save(self, path: Optional[str] = None) -> Optional[str]:
         from flax import serialization
 
@@ -217,6 +276,7 @@ class OptaxTrainer(TrainerBackend):
             return None
         with open(path, "wb") as fh:
             fh.write(serialization.to_bytes(self.params))
+        self.last_saved_path = path
         logger.info("trainer saved model to %s", path)
         return path
 

@@ -1,0 +1,176 @@
+"""Backend conformance suite: every FilterBackend must honor the vtable
+contract (open/close lifecycle, shape negotiation, invoke semantics,
+shared-model table).
+
+Reference analog: ``tests/nnstreamer_filter_extensions_common/`` — a
+per-framework conformance template instantiated for all 23 backends by
+meson loops. Each backend here gets a tiny "times two" model in its own
+native format, then runs the identical assertions.
+"""
+import textwrap
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends import custom_easy  # noqa: F401 - registration
+from nnstreamer_tpu.backends.base import (
+    Accelerator,
+    BackendEvent,
+    FilterProperties,
+    acquire_backend,
+    release_backend,
+)
+from nnstreamer_tpu.core import DataType, TensorsInfo
+from nnstreamer_tpu.core.tensors import TensorSpec
+from nnstreamer_tpu.registry.subplugin import SubpluginKind, get as get_subplugin
+
+IN_INFO = TensorsInfo.of(TensorSpec((2, 3), DataType.FLOAT32))
+
+
+def _jax_model(tmp_path):
+    return "builtin://scaler?factor=2"
+
+
+def _python_model(tmp_path):
+    p = tmp_path / "pyfilter.py"
+    p.write_text(textwrap.dedent("""
+        import numpy as np
+
+        class Filter:
+            def invoke(self, inputs):
+                return [np.asarray(x) * 2 for x in inputs]
+    """))
+    return str(p)
+
+
+def _torch_model(tmp_path):
+    torch = pytest.importorskip("torch")
+
+    class Doubler(torch.nn.Module):
+        def forward(self, x):
+            return x * 2
+
+    path = tmp_path / "doubler.pt"
+    torch.jit.script(Doubler()).save(str(path))
+    return str(path)
+
+
+def _stablehlo_model(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax import export
+
+    exported = export.export(jax.jit(lambda x: (x * 2,)))(
+        jax.ShapeDtypeStruct((2, 3), jnp.float32))
+    path = tmp_path / "doubler.jaxexport"
+    path.write_bytes(exported.serialize())
+    return str(path)
+
+
+def _custom_easy_model(tmp_path):
+    from nnstreamer_tpu.backends.custom_easy import register_custom_easy
+
+    def doubler(inputs):
+        return [np.asarray(x) * 2 for x in inputs]
+
+    try:
+        register_custom_easy("conf_doubler", doubler)
+    except ValueError:
+        pass  # already registered from a previous parametrization
+    return "conf_doubler"
+
+
+BACKENDS = {
+    "jax": _jax_model,
+    "python": _python_model,
+    "torch": _torch_model,
+    "stablehlo": _stablehlo_model,
+    "custom-easy": _custom_easy_model,
+}
+
+
+@pytest.fixture(params=sorted(BACKENDS))
+def opened_backend(request, tmp_path):
+    name = request.param
+    model = BACKENDS[name](tmp_path)
+    cls = get_subplugin(SubpluginKind.FILTER, name)
+    backend = cls()
+    backend.open(FilterProperties(model=model, input_info=IN_INFO))
+    yield name, backend
+    backend.close()
+
+
+class TestConformance:
+    def test_open_sets_props_close_clears(self, opened_backend):
+        name, b = opened_backend
+        assert b.props is not None and b.props.model
+        model = b.props.model
+        b.close()
+        assert b.props is None
+        # reopen works after close (lifecycle is restartable)
+        b.open(FilterProperties(model=model, input_info=IN_INFO))
+        assert b.props is not None
+        out = b.invoke([np.ones((2, 3), np.float32)])
+        np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+
+    def test_invoke_doubles(self, opened_backend):
+        _, b = opened_backend
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = b.invoke([x])
+        assert len(out) == 1
+        np.testing.assert_allclose(np.asarray(out[0]), x * 2)
+
+    def test_shape_negotiation(self, opened_backend):
+        name, b = opened_backend
+        in_info, out_info = b.get_model_info()
+        if out_info is None:
+            out_info = b.set_input_info(IN_INFO)
+        assert tuple(out_info.specs[0].shape) == (2, 3)
+        assert out_info.specs[0].dtype is DataType.FLOAT32
+
+    def test_repeated_invokes_consistent(self, opened_backend):
+        _, b = opened_backend
+        x = np.ones((2, 3), np.float32)
+        first = np.asarray(b.invoke([x])[0])
+        for _ in range(3):
+            np.testing.assert_allclose(np.asarray(b.invoke([x])[0]), first)
+
+    def test_declared_accelerators_nonempty(self, opened_backend):
+        _, b = opened_backend
+        assert len(b.ACCELERATORS) >= 1
+        assert all(isinstance(a, Accelerator) for a in b.ACCELERATORS)
+
+    def test_reload_event_tolerated(self, opened_backend):
+        """RELOAD_MODEL must either work or be a no-op — never corrupt the
+        opened state (reference eventHandler contract)."""
+        _, b = opened_backend
+        try:
+            b.handle_event(BackendEvent.RELOAD_MODEL)
+        except Exception:
+            pytest.fail("RELOAD_MODEL raised")
+        x = np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(np.asarray(b.invoke([x])[0]), 2.0)
+
+
+class TestSharedModelTable:
+    def test_share_key_reuses_instance(self, tmp_path):
+        props = FilterProperties(model="builtin://scaler?factor=2")
+        a = acquire_backend("jax", props, share_key="conf-k1")
+        b = acquire_backend("jax", props, share_key="conf-k1")
+        assert a is b
+        release_backend(a, "conf-k1")
+        # still open for the second holder
+        out = b.invoke([np.ones((1,), np.float32)])
+        np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+        release_backend(b, "conf-k1")
+        assert b.props is None  # last release closed it
+
+    def test_share_key_rejects_different_model(self):
+        a = acquire_backend(
+            "jax", FilterProperties(model="builtin://scaler?factor=2"),
+            share_key="conf-k2")
+        with pytest.raises(ValueError, match="already bound"):
+            acquire_backend(
+                "jax", FilterProperties(model="builtin://add?value=1"),
+                share_key="conf-k2")
+        release_backend(a, "conf-k2")

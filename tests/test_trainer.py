@@ -114,3 +114,144 @@ class TestTrainer:
         msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
         pipe.stop()
         assert msg is not None and "expected 1 inputs" in msg.data["error"]
+
+
+class TestCheckpointManager:
+    def _state(self, v: float):
+        import jax.numpy as jnp
+
+        return {"w": jnp.full((2, 2), v), "b": jnp.full((1,), v * 10)}
+
+    @pytest.mark.parametrize("use_orbax", [False, True])
+    def test_save_restore_roundtrip(self, tmp_path, use_orbax):
+        from nnstreamer_tpu.trainer.checkpoint import CheckpointManager
+
+        if use_orbax and not CheckpointManager._orbax_usable():
+            pytest.skip("orbax unavailable")
+        mgr = CheckpointManager(str(tmp_path / "ck"), use_orbax=use_orbax)
+        mgr.save(1, self._state(1.0), {"epoch_count": 1})
+        mgr.save(2, self._state(2.0), {"epoch_count": 2, "losses": [0.5, 0.25]})
+        assert mgr.steps() == [1, 2]
+        state, meta = mgr.restore(target=self._state(0.0))
+        assert meta["epoch_count"] == 2 and meta["losses"] == [0.5, 0.25]
+        np.testing.assert_allclose(np.asarray(state["w"]), 2.0)
+        # explicit older step
+        state1, meta1 = mgr.restore(step=1, target=self._state(0.0))
+        np.testing.assert_allclose(np.asarray(state1["b"]), 10.0)
+
+    def test_retention(self, tmp_path):
+        from nnstreamer_tpu.trainer.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2,
+                                use_orbax=False)
+        for s in (1, 2, 3, 4):
+            mgr.save(s, self._state(float(s)), {})
+        assert mgr.steps() == [3, 4]
+
+    def test_partial_write_ignored(self, tmp_path):
+        from nnstreamer_tpu.trainer.checkpoint import CheckpointManager
+        import os
+
+        mgr = CheckpointManager(str(tmp_path / "ck"), use_orbax=False)
+        mgr.save(1, self._state(1.0), {})
+        # simulate a crashed write: step dir without meta.json
+        os.makedirs(str(tmp_path / "ck" / "step_9"))
+        assert mgr.latest_step() == 1
+
+
+class TestTrainingResume:
+    def _train(self, tmp_path, model_config, data, meta, epochs,
+               start_epoch=0, ckpt_dir=None):
+        ckpt_dir = ckpt_dir or str(tmp_path / "ckpts")
+        pipe = parse_launch(
+            f"datareposrc location={data} json={meta} epochs={epochs} "
+            f"start-epoch={start_epoch} is-shuffle=true seed=3 "
+            f"! tensor_trainer framework=optax model-config={model_config} "
+            f"num-training-samples=64 epochs={epochs} "
+            f"custom=batch:16,lr:0.05,ckpt_dir:{ckpt_dir} name=t"
+        )
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ELEMENT, MessageType.ERROR),
+                                timeout=120)
+        assert msg is not None and msg.type is MessageType.ELEMENT, msg
+        backend = pipe.get("t").backend
+        stats = (backend.stats.epoch_count, list(backend.losses))
+        pipe.stop()
+        return stats, ckpt_dir
+
+    def test_checkpoint_resume_continues_training(self, tmp_path, model_config):
+        data, meta = make_dataset(tmp_path)
+        # phase 1: train 2 epochs, checkpointing each
+        (epochs_done, losses1), ckpt_dir = self._train(
+            tmp_path, model_config, data, meta, epochs=2)
+        assert epochs_done == 2 and len(losses1) == 2
+
+        from nnstreamer_tpu.trainer.checkpoint import CheckpointManager
+
+        assert CheckpointManager(ckpt_dir).latest_step() == 2
+
+        # phase 2: same ckpt dir, target 4 epochs -> resumes at 2, trains 2 more
+        (epochs_done2, losses2), _ = self._train(
+            tmp_path, model_config, data, meta, epochs=4, start_epoch=2,
+            ckpt_dir=ckpt_dir)
+        assert epochs_done2 == 4
+        assert losses2[:2] == losses1  # history restored
+        assert len(losses2) == 4
+        # resumed training kept improving on the restored params
+        assert losses2[-1] < losses1[-1]
+
+
+class TestDataRepoStartEpoch:
+    def test_start_epoch_continues_shuffle_stream(self, tmp_path):
+        data, meta = make_dataset(tmp_path, n=8)
+
+        def collect(epochs, start_epoch):
+            got = []
+            pipe = parse_launch(
+                f"datareposrc location={data} json={meta} epochs={epochs} "
+                f"start-epoch={start_epoch} is-shuffle=true seed=7 "
+                "use-native=false ! tensor_sink name=out"
+            )
+            pipe.get("out").connect(lambda b: got.append(b.offset))
+            pipe.run(timeout=30)
+            return got
+
+        full = collect(3, 0)
+        tail = collect(3, 1)
+        assert tail == full[8:]  # epochs 1-2 replay identically
+
+    def test_start_epoch_native_matches_python(self, tmp_path):
+        from nnstreamer_tpu import native
+
+        if not native.available():
+            pytest.skip("native runtime unavailable")
+        data, meta = make_dataset(tmp_path, n=8)
+
+        def collect(use_native):
+            got = []
+            pipe = parse_launch(
+                f"datareposrc location={data} json={meta} epochs=3 "
+                f"start-epoch=1 is-shuffle=true seed=7 "
+                f"use-native={str(use_native).lower()} ! tensor_sink name=out"
+            )
+            pipe.get("out").connect(lambda b: got.append(b.offset))
+            pipe.run(timeout=30)
+            return got
+
+        assert collect(True) == collect(False)
+
+    def test_epochs_zero_emits_one_epoch_both_paths(self, tmp_path):
+        data, meta = make_dataset(tmp_path, n=4)
+
+        def collect(use_native):
+            got = []
+            pipe = parse_launch(
+                f"datareposrc location={data} json={meta} epochs=0 "
+                f"use-native={str(use_native).lower()} ! tensor_sink name=out"
+            )
+            pipe.get("out").connect(lambda b: got.append(b.offset))
+            pipe.run(timeout=30)
+            return got
+
+        assert collect(False) == [0, 1, 2, 3]  # one clamped epoch
+        assert collect(True) == [0, 1, 2, 3]
