@@ -71,12 +71,24 @@ class ImageLabeling(Decoder):
         return Caps.new(TEXT_MIME)
 
     def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
-        scores = np.asarray(buf.tensors[0]).reshape(-1)
-        idx = int(np.argmax(scores))
-        label = self.labels[idx] if idx < len(self.labels) else str(idx)
-        out = Buffer([np.frombuffer(label.encode(), np.uint8)])
-        out.meta["label_index"] = idx
-        out.meta["label"] = label
+        scores = np.asarray(buf.tensors[0])
+        # batched input (aggregator upstream): one label per leading-dim frame;
+        # the reference only ever sees batch=1 (tensordec-imagelabel.c argmax).
+        # Only treat the leading axis as batch when the remaining axes hold
+        # the class scores — a (C,1) single-frame layout must not split.
+        if scores.ndim >= 2 and scores.shape[0] > 1 and np.prod(scores.shape[1:]) > 1:
+            idxs = [int(i) for i in scores.reshape(scores.shape[0], -1).argmax(-1)]
+        else:
+            idxs = [int(np.argmax(scores.reshape(-1)))]
+        labels = [
+            self.labels[i] if i < len(self.labels) else str(i) for i in idxs
+        ]
+        text = "\n".join(labels)
+        out = Buffer([np.frombuffer(text.encode(), np.uint8)])
+        out.meta["label_index"] = idxs[0]
+        out.meta["label"] = labels[0]
+        out.meta["label_indices"] = idxs
+        out.meta["labels"] = labels
         return out
 
 
