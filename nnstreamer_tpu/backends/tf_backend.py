@@ -1,0 +1,117 @@
+"""TensorFlow SavedModel filter backend (L4).
+
+Reference analog: ``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow.cc``
+(804 LoC — TF-C API session/graph-def load). TF2 redesign: load a SavedModel
+and invoke one of its serving signatures; graph-def era ``.pb`` files are out
+of scope (the reference itself migrated its tests to SavedModel/tflite).
+
+Custom options:
+  ``signature:<key>`` — signature to serve (default: ``[tensorflow] signature``
+  config key, then ``serving_default``).
+  ``inputs:<name;name2>`` — explicit positional→name binding for multi-input
+  signatures.
+
+Restored signatures canonicalize their kwargs, so declaration order is lost;
+inputs therefore bind to the signature's input names **sorted
+alphabetically** unless ``inputs:`` overrides the order. Outputs come back
+sorted by output name (deterministic across processes).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import DataType, TensorsInfo
+from ..core.tensors import TensorSpec
+from ..utils.log import logger
+from .base import Accelerator, FilterBackend, FilterProperties, register_backend
+
+
+@register_backend
+class TensorFlowBackend(FilterBackend):
+    NAME = "tensorflow"
+    ALIASES = ("tf", "tensorflow2")
+    ACCELERATORS = (Accelerator.CPU,)
+
+    def __init__(self):
+        super().__init__()
+        self._fn = None
+        self._input_names: List[str] = []
+        self._output_names: List[str] = []
+
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        import tensorflow as tf
+
+        from ..registry.config import get_config
+
+        opts = props.custom_dict()
+        sig_key = opts.get("signature") or get_config().get(
+            "tensorflow", "signature", "serving_default"
+        )
+        loaded = tf.saved_model.load(props.model)
+        try:
+            self._fn = loaded.signatures[sig_key]
+        except KeyError:
+            raise ValueError(
+                f"SavedModel {props.model} has no signature '{sig_key}' "
+                f"(available: {list(loaded.signatures)})"
+            )
+        self._loaded = loaded  # keep the object alive (owns the variables)
+        _, kwargs_sig = self._fn.structured_input_signature
+        self._input_names = sorted(kwargs_sig)
+        order = opts.get("inputs")
+        if order:
+            names = [n.strip() for n in order.split(";") if n.strip()]
+            if sorted(names) != self._input_names:
+                raise ValueError(
+                    f"custom inputs:{order} does not match signature inputs "
+                    f"{self._input_names}"
+                )
+            self._input_names = names
+        self._output_names = sorted(self._fn.structured_outputs)
+        logger.info(
+            "tensorflow backend loaded %s sig=%s in=%s out=%s",
+            props.model, sig_key, self._input_names, self._output_names,
+        )
+
+    def close(self) -> None:
+        self._fn = None
+        self._loaded = None
+        super().close()
+
+    def _spec_of(self, tensor_spec) -> Optional[TensorSpec]:
+        shape = tensor_spec.shape
+        if shape.rank is None or any(d is None for d in shape.as_list()):
+            return None
+        return TensorSpec(
+            tuple(int(d) for d in shape.as_list()),
+            DataType.from_any(tensor_spec.dtype.as_numpy_dtype),
+        )
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        _, kwargs_sig = self._fn.structured_input_signature
+        ins = [self._spec_of(kwargs_sig[n]) for n in self._input_names]
+        outs = [self._spec_of(self._fn.structured_outputs[n])
+                for n in self._output_names]
+        in_info = TensorsInfo.of(*ins) if all(s is not None for s in ins) else None
+        out_info = TensorsInfo.of(*outs) if all(s is not None for s in outs) else None
+        return in_info, out_info
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        import tensorflow as tf
+
+        if self._fn is None:
+            raise RuntimeError("tensorflow backend: invoke before open")
+        if len(inputs) != len(self._input_names):
+            raise ValueError(
+                f"signature takes {len(self._input_names)} inputs "
+                f"({self._input_names}), got {len(inputs)}"
+            )
+        feed = {
+            name: tf.constant(np.asarray(x))
+            for name, x in zip(self._input_names, inputs)
+        }
+        out = self._fn(**feed)
+        return [out[n].numpy() for n in self._output_names]

@@ -38,6 +38,12 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         "framework_priority_pt2": "torch",
         "framework_priority_msgpack": "flax",
         "framework_priority_ckpt": "flax",
+        "framework_priority_tflite": "tflite",
+        # model path that is a directory containing saved_model.pb
+        "framework_priority_savedmodel": "tensorflow",
+    },
+    "tensorflow": {
+        "signature": "serving_default",
     },
     "jax": {
         "default_device": "auto",   # auto | tpu | cpu
@@ -83,6 +89,10 @@ class Config:
     def framework_priority(self, model_path: str) -> List[str]:
         """Backend candidates for a model file, by extension (reference
         ``gst_tensor_filter_detect_framework``, tensor_filter_common.c:1218)."""
+        if os.path.isdir(model_path) and os.path.exists(
+            os.path.join(model_path, "saved_model.pb")
+        ):
+            return self.get_list("filter", "framework_priority_savedmodel")
         ext = os.path.splitext(model_path)[1].lstrip(".").lower()
         if not ext:
             return []

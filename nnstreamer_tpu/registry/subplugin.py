@@ -38,6 +38,8 @@ _BUILTIN_MODULES: Dict[SubpluginKind, tuple] = {
         "nnstreamer_tpu.backends.torch_backend",
         "nnstreamer_tpu.backends.python_backend",
         "nnstreamer_tpu.backends.custom_easy",
+        "nnstreamer_tpu.backends.tflite_backend",
+        "nnstreamer_tpu.backends.tf_backend",
     ),
     SubpluginKind.DECODER: ("nnstreamer_tpu.decoders",),
     SubpluginKind.CONVERTER: ("nnstreamer_tpu.converters",),

@@ -80,12 +80,41 @@ def _custom_easy_model(tmp_path):
     return "conf_doubler"
 
 
+def _tflite_model(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    @tf.function(input_signature=[tf.TensorSpec([2, 3], tf.float32)])
+    def doubler(x):
+        return x * 2
+
+    conv = tf.lite.TFLiteConverter.from_concrete_functions(
+        [doubler.get_concrete_function()])
+    path = tmp_path / "doubler.tflite"
+    path.write_bytes(conv.convert())
+    return str(path)
+
+
+def _tensorflow_model(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    class Doubler(tf.Module):
+        @tf.function(input_signature=[tf.TensorSpec([2, 3], tf.float32)])
+        def __call__(self, x):
+            return x * 2
+
+    path = tmp_path / "doubler_saved"
+    tf.saved_model.save(Doubler(), str(path))
+    return str(path)
+
+
 BACKENDS = {
     "jax": _jax_model,
     "python": _python_model,
     "torch": _torch_model,
     "stablehlo": _stablehlo_model,
     "custom-easy": _custom_easy_model,
+    "tflite": _tflite_model,
+    "tensorflow": _tensorflow_model,
 }
 
 

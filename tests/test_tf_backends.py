@@ -1,0 +1,99 @@
+"""tflite / tensorflow backend integration: auto-detection by model
+extension and end-to-end pipeline runs.
+
+Reference analog: tests/nnstreamer_filter_tensorflow2_lite/runTest.sh —
+gst-launch pipelines through the tflite subplugin with golden compare, and
+the framework auto-detection cases from unittest_filter_single.
+"""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from nnstreamer_tpu.registry.config import get_config
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+@pytest.fixture(scope="module")
+def tflite_model(tmp_path_factory):
+    @tf.function(input_signature=[tf.TensorSpec([1, 4], tf.float32)])
+    def affine(x):
+        return x * 3 + 1
+
+    conv = tf.lite.TFLiteConverter.from_concrete_functions(
+        [affine.get_concrete_function()])
+    path = tmp_path_factory.mktemp("models") / "affine.tflite"
+    path.write_bytes(conv.convert())
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    class Affine(tf.Module):
+        @tf.function(input_signature=[tf.TensorSpec([1, 4], tf.float32)])
+        def __call__(self, x):
+            return x * 3 + 1
+
+    path = tmp_path_factory.mktemp("models") / "affine_saved"
+    tf.saved_model.save(Affine(), str(path))
+    return str(path)
+
+
+def test_auto_detect_tflite_extension(tflite_model):
+    assert get_config().framework_priority(tflite_model) == ["tflite"]
+
+
+def test_auto_detect_saved_model_dir(saved_model):
+    assert get_config().framework_priority(saved_model) == ["tensorflow"]
+
+
+def _run_pipeline(model, framework="auto"):
+    pipe = parse_launch(
+        "tensor_src num-buffers=3 dimensions=4:1 types=float32 pattern=counter "
+        f"! tensor_filter framework={framework} model={model} "
+        "! tensor_sink name=out max-stored=8"
+    )
+    outs = []
+    pipe.get("out").connect(lambda b: outs.append(np.asarray(b.tensors[0])))
+    pipe.play()
+    pipe.wait(timeout=60)
+    pipe.stop()
+    return outs
+
+
+def test_tflite_pipeline_auto(tflite_model):
+    outs = _run_pipeline(tflite_model)
+    assert len(outs) == 3
+    for o in outs:
+        assert o.shape == (1, 4)
+    # counter pattern: frame k is filled with value k -> k*3+1
+    np.testing.assert_allclose(outs[1], np.full((1, 4), 1 * 3 + 1, np.float32))
+
+
+def test_saved_model_pipeline_auto(saved_model):
+    outs = _run_pipeline(saved_model)
+    assert len(outs) == 3
+    np.testing.assert_allclose(outs[2], np.full((1, 4), 2 * 3 + 1, np.float32))
+
+
+def test_tflite_dynamic_batch_resize(tmp_path):
+    """Interpreter must resize when the pipeline ships a different batch than
+    the model's declared shape (reference ResizeInputTensor path)."""
+    @tf.function(input_signature=[tf.TensorSpec([1, 4], tf.float32)])
+    def doubler(x):
+        return x * 2
+
+    conv = tf.lite.TFLiteConverter.from_concrete_functions(
+        [doubler.get_concrete_function()])
+    path = tmp_path / "doubler.tflite"
+    path.write_bytes(conv.convert())
+
+    from nnstreamer_tpu.backends.tflite_backend import TFLiteBackend
+    from nnstreamer_tpu.backends.base import FilterProperties
+
+    b = TFLiteBackend()
+    b.open(FilterProperties(model=str(path)))
+    x = np.ones((5, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(b.invoke([x])[0]), 2.0)
+    assert b.invoke([x])[0].shape == (5, 4)
+    b.close()
