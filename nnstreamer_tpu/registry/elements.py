@@ -48,6 +48,7 @@ _STANDARD_MODULES = (
     "nnstreamer_tpu.elements.mqtt",
     "nnstreamer_tpu.elements.iio",
     "nnstreamer_tpu.query.elements",
+    "nnstreamer_tpu.query.grpc_io",
 )
 
 _loaded = False
