@@ -1,0 +1,71 @@
+/* Example custom filter: elementwise scaler (float32) / passthrough.
+ *
+ * Reference analog: tests/nnstreamer_example/custom_example_scaler — the
+ * deterministic fake-model plugin the reference uses throughout its golden
+ * tests. Build:
+ *
+ *   g++ -O2 -std=c++17 -fPIC -shared -I <repo>/nnstreamer_tpu/native/csrc \
+ *       -o libscaler.so scaler.cc
+ *
+ * Use:  tensor_filter framework=custom model=libscaler.so custom=factor:2
+ */
+#include "nns_custom_filter.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+namespace {
+
+struct Ctx {
+  double factor = 1.0;
+  nns_tensors_spec in_spec{};  /* negotiated; dtype drives invoke */
+};
+
+}  // namespace
+
+extern "C" {
+
+int32_t nns_custom_abi_version(void) { return NNS_CUSTOM_ABI_VERSION; }
+
+void *nns_custom_open(const char *options) {
+  Ctx *c = new (std::nothrow) Ctx();
+  if (c == nullptr) return nullptr;
+  if (options != nullptr) {
+    const char *p = std::strstr(options, "factor:");
+    if (p != nullptr) c->factor = std::atof(p + 7);
+  }
+  return c;
+}
+
+void nns_custom_close(void *handle) { delete static_cast<Ctx *>(handle); }
+
+/* shape-preserving: output spec == input spec */
+int nns_custom_set_input(void *handle, const nns_tensors_spec *in_spec,
+                         nns_tensors_spec *out_spec) {
+  Ctx *c = static_cast<Ctx *>(handle);
+  if (in_spec->num == 0 || in_spec->num > NNS_MAX_TENSORS) return -1;
+  c->in_spec = *in_spec;
+  *out_spec = *in_spec;
+  return 0;
+}
+
+int nns_custom_invoke(void *handle, const nns_tensor_view *in, uint32_t n_in,
+                      nns_tensor_view *out, uint32_t n_out) {
+  Ctx *c = static_cast<Ctx *>(handle);
+  if (n_in != n_out || n_in != c->in_spec.num) return -1;
+  for (uint32_t i = 0; i < n_in; ++i) {
+    if (in[i].size != out[i].size) return -2;
+    if (c->in_spec.spec[i].dtype == NNS_FLOAT32) {
+      const float *src = static_cast<const float *>(in[i].data);
+      float *dst = static_cast<float *>(out[i].data);
+      const uint64_t n = in[i].size / sizeof(float);
+      for (uint64_t j = 0; j < n; ++j) dst[j] = src[j] * c->factor;
+    } else {
+      std::memcpy(out[i].data, in[i].data, in[i].size);
+    }
+  }
+  return 0;
+}
+
+}  /* extern "C" */

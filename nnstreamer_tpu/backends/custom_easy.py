@@ -45,7 +45,9 @@ def unregister_custom_easy(name: str) -> bool:
 @register_backend
 class CustomEasyBackend(FilterBackend):
     NAME = "custom-easy"
-    ALIASES = ("custom_easy", "custom")
+    # NOTE: bare "custom" names the C-ABI .so backend (custom_c.py), matching
+    # the reference's split between tensor_filter_custom and _custom_easy
+    ALIASES = ("custom_easy",)
     ACCELERATORS = (Accelerator.CPU, Accelerator.TPU)
     REENTRANT = True
 

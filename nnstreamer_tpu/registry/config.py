@@ -39,6 +39,7 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         "framework_priority_msgpack": "flax",
         "framework_priority_ckpt": "flax",
         "framework_priority_tflite": "tflite",
+        "framework_priority_so": "custom",
         # model path that is a directory containing saved_model.pb
         "framework_priority_savedmodel": "tensorflow",
     },

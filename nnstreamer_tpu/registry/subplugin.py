@@ -40,6 +40,7 @@ _BUILTIN_MODULES: Dict[SubpluginKind, tuple] = {
         "nnstreamer_tpu.backends.custom_easy",
         "nnstreamer_tpu.backends.tflite_backend",
         "nnstreamer_tpu.backends.tf_backend",
+        "nnstreamer_tpu.backends.custom_c",
     ),
     SubpluginKind.DECODER: ("nnstreamer_tpu.decoders",),
     SubpluginKind.CONVERTER: ("nnstreamer_tpu.converters",),

@@ -1,0 +1,35 @@
+"""Shared helper: compile C-ABI custom-filter plugins for tests."""
+import os
+import shutil
+import subprocess
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+INCLUDE = os.path.join(REPO, "nnstreamer_tpu", "native", "csrc")
+
+_cache = {}
+
+
+def compile_plugin(source: str, name: str) -> str:
+    """Compile a plugin .cc (path or inline source text) to a cached .so."""
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    key = (source, name)
+    if key in _cache:
+        return _cache[key]
+    out_dir = tempfile.mkdtemp(prefix="nns_custom_")
+    if os.path.exists(source):
+        src_path = source
+    else:
+        src_path = os.path.join(out_dir, f"{name}.cc")
+        with open(src_path, "w") as fh:
+            fh.write(source)
+    so = os.path.join(out_dir, f"lib{name}.so")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-I", INCLUDE,
+         "-o", so, src_path],
+        check=True, capture_output=True)
+    _cache[key] = so
+    return so

@@ -107,6 +107,31 @@ def _tensorflow_model(tmp_path):
     return str(path)
 
 
+def _custom_c_model(tmp_path):
+    from custom_c_util import compile_plugin
+
+    return compile_plugin(textwrap.dedent("""
+        #include "nns_custom_filter.h"
+        extern "C" {
+        int32_t nns_custom_abi_version(void) { return NNS_CUSTOM_ABI_VERSION; }
+        void *nns_custom_open(const char *) { static int h; return &h; }
+        void nns_custom_close(void *) {}
+        int nns_custom_set_input(void *, const nns_tensors_spec *in,
+                                 nns_tensors_spec *out) { *out = *in; return 0; }
+        int nns_custom_invoke(void *, const nns_tensor_view *in, uint32_t n_in,
+                              nns_tensor_view *out, uint32_t n_out) {
+          if (n_in != n_out) return -1;
+          for (uint32_t i = 0; i < n_in; ++i) {
+            const float *s = (const float *) in[i].data;
+            float *d = (float *) out[i].data;
+            for (uint64_t j = 0; j < in[i].size / 4; ++j) d[j] = s[j] * 2;
+          }
+          return 0;
+        }
+        }
+    """), "conf_doubler")
+
+
 BACKENDS = {
     "jax": _jax_model,
     "python": _python_model,
@@ -115,6 +140,7 @@ BACKENDS = {
     "custom-easy": _custom_easy_model,
     "tflite": _tflite_model,
     "tensorflow": _tensorflow_model,
+    "custom": _custom_c_model,
 }
 
 

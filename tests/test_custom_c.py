@@ -1,0 +1,113 @@
+"""C-ABI custom filter backend tests: compile the example scaler plugin with
+g++ and drive it through the backend vtable and a full pipeline.
+
+Reference analog: tests/nnstreamer_example/custom_example_scaler + the
+tensor_filter_custom unit tests (user .so loaded by dlopen).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from custom_c_util import REPO, compile_plugin
+from nnstreamer_tpu.backends.base import FilterProperties
+from nnstreamer_tpu.core import DataType, TensorsInfo
+from nnstreamer_tpu.core.tensors import TensorSpec
+from nnstreamer_tpu.registry.config import get_config
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+SRC = os.path.join(REPO, "examples", "custom_filters", "scaler.cc")
+
+
+@pytest.fixture(scope="module")
+def scaler_so():
+    return compile_plugin(SRC, "scaler")
+
+
+def test_auto_detect_so_extension(scaler_so):
+    assert get_config().framework_priority(scaler_so) == ["custom"]
+
+
+def test_vtable_lifecycle_and_invoke(scaler_so):
+    from nnstreamer_tpu.backends.custom_c import CustomCBackend
+
+    b = CustomCBackend()
+    b.open(FilterProperties(model=scaler_so, custom="factor:2"))
+    out_info = b.set_input_info(
+        TensorsInfo.of(TensorSpec((2, 3), DataType.FLOAT32)))
+    assert tuple(out_info.specs[0].shape) == (2, 3)
+    assert out_info.specs[0].dtype is DataType.FLOAT32
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_allclose(np.asarray(b.invoke([x])[0]), x * 2)
+    b.close()
+    assert b.props is None
+
+
+def test_non_float_passthrough(scaler_so):
+    from nnstreamer_tpu.backends.custom_c import CustomCBackend
+
+    b = CustomCBackend()
+    b.open(FilterProperties(model=scaler_so, custom="factor:3"))
+    b.set_input_info(TensorsInfo.of(TensorSpec((4,), DataType.INT32)))
+    x = np.array([1, 2, 3, 4], np.int32)
+    np.testing.assert_array_equal(np.asarray(b.invoke([x])[0]), x)
+    b.close()
+
+
+def test_pipeline_auto_detect(scaler_so):
+    pipe = parse_launch(
+        "tensor_src num-buffers=3 dimensions=4 types=float32 pattern=counter "
+        f"! tensor_filter framework=auto model={scaler_so} custom=factor:10 "
+        "! tensor_sink name=out max-stored=8")
+    outs = []
+    pipe.get("out").connect(lambda b: outs.append(np.asarray(b.tensors[0])))
+    pipe.play()
+    pipe.wait(timeout=30)
+    pipe.stop()
+    assert len(outs) == 3
+    np.testing.assert_allclose(outs[2], np.full(4, 20.0, np.float32))
+
+
+def test_abi_mismatch_rejected():
+    so = compile_plugin(
+        '#include <cstdint>\n'
+        'extern "C" {\n'
+        'int32_t nns_custom_abi_version() { return 999; }\n'
+        'void* nns_custom_open(const char*) { return nullptr; }\n'
+        'void nns_custom_close(void*) {}\n'
+        'int nns_custom_invoke(void*, const void*, uint32_t, void*, uint32_t)'
+        ' { return -1; }\n'
+        'int nns_custom_get_info(void*, void*, void*) { return -1; }\n'
+        '}\n', "bad_abi")
+    from nnstreamer_tpu.backends.custom_c import CustomCBackend
+
+    b = CustomCBackend()
+    with pytest.raises(RuntimeError, match="ABI"):
+        b.open(FilterProperties(model=so))
+
+
+def test_non_plugin_so_clear_error():
+    """Any ordinary .so routed here by framework_priority_so must produce a
+    diagnostic, not a raw ctypes AttributeError."""
+    so = compile_plugin('extern "C" { int not_a_plugin() { return 0; } }\n',
+                        "not_a_plugin")
+    from nnstreamer_tpu.backends.custom_c import CustomCBackend
+
+    b = CustomCBackend()
+    with pytest.raises(RuntimeError, match="missing symbols"):
+        b.open(FilterProperties(model=so))
+
+
+def test_lifecycle_guard_after_close(scaler_so):
+    """vtable calls after close() must raise, never pass NULL to the plugin."""
+    from nnstreamer_tpu.backends.custom_c import CustomCBackend
+
+    b = CustomCBackend()
+    b.open(FilterProperties(model=scaler_so, custom="factor:2"))
+    b.close()
+    with pytest.raises(RuntimeError, match="not open"):
+        b.set_input_info(TensorsInfo.of(TensorSpec((2,), DataType.FLOAT32)))
+    with pytest.raises(RuntimeError, match="not open"):
+        b.get_model_info()
+    with pytest.raises(RuntimeError, match="not open"):
+        b.invoke([np.zeros(2, np.float32)])
