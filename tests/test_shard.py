@@ -1,0 +1,123 @@
+"""tensor_shard / tensor_unshard: round-robin scatter + ordered re-join.
+
+The multi-host stream-sharding topology of SURVEY.md §5.8/§7 — tested
+loopback like the reference tests its distributed layer (§4): branches are
+real worker pipelines behind tensor_query, plus pure-local branches with
+artificial latency skew to force out-of-order arrival.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+def _collect(pipe, name="out", n=None, timeout=20.0):
+    out = []
+    pipe.get(name).connect(out.append)
+    pipe.play()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if n is not None and len(out) >= n:
+            break
+        try:
+            pipe.wait(timeout=0.1)
+            break  # EOS/ERROR reached
+        except TimeoutError:
+            continue
+    pipe.stop()
+    return out
+
+
+class TestShardLocal:
+    def test_round_robin_exclusive(self):
+        """Each frame goes to exactly one branch (tee would duplicate)."""
+        pipe = parse_launch(
+            "tensor_src num-buffers=6 dimensions=1 types=float32 pattern=counter "
+            "! tensor_shard name=s "
+            "s.src_0 ! tensor_sink name=a max-stored=16 "
+            "s.src_1 ! tensor_sink name=b max-stored=16"
+        )
+        a, b = [], []
+        pipe.get("a").connect(a.append)
+        pipe.get("b").connect(b.append)
+        pipe.play(); pipe.wait(timeout=20); pipe.stop()
+        assert len(a) == 3 and len(b) == 3
+        assert [float(np.asarray(x.tensors[0])[0]) for x in a] == [0, 2, 4]
+        assert [float(np.asarray(x.tensors[0])[0]) for x in b] == [1, 3, 5]
+        assert [x.meta["shard_seq"] for x in a] == [0, 2, 4]
+
+    def test_rejoin_restores_order_with_latency_skew(self):
+        """Branch 0 is slow: its frames arrive late; unshard must reorder."""
+        from nnstreamer_tpu.backends.custom_easy import register_custom_easy
+
+        def slow(inputs):
+            time.sleep(0.05)
+            return [np.asarray(x) for x in inputs]
+
+        try:
+            register_custom_easy("shard_slow", slow)
+        except ValueError:
+            pass
+        pipe = parse_launch(
+            "tensor_src num-buffers=8 dimensions=1 types=float32 pattern=counter "
+            "! tensor_shard name=s "
+            "s.src_0 ! queue ! tensor_filter framework=custom-easy model=shard_slow ! u.sink_0 "
+            "s.src_1 ! queue ! u.sink_1 "
+            "tensor_unshard name=u ! tensor_sink name=out max-stored=32"
+        )
+        out = _collect(pipe, n=8)
+        vals = [float(np.asarray(b.tensors[0])[0]) for b in out]
+        assert vals == [0, 1, 2, 3, 4, 5, 6, 7]
+
+    def test_gap_declared_lost_when_buffer_full(self):
+        """A branch that drops every frame must not stall the join forever."""
+        from nnstreamer_tpu.backends.custom_easy import register_custom_easy
+        pipe = parse_launch(
+            "tensor_src num-buffers=8 dimensions=1 types=float32 pattern=counter "
+            "! tensor_shard name=s "
+            "s.src_0 ! queue ! tensor_if compared-value=a-value compared-value-option=0:0 "
+            "operator=lt supplied-value=-1 then=passthrough else=skip ! u.sink_0 "
+            "s.src_1 ! queue ! u.sink_1 "
+            "tensor_unshard name=u max-buffered=2 ! tensor_sink name=out max-stored=32"
+        )
+        out = _collect(pipe, n=4)
+        vals = [float(np.asarray(b.tensors[0])[0]) for b in out]
+        # branch 0 (even frames) all dropped; odd frames come through in order
+        assert vals == [1, 3, 5, 7]
+
+
+class TestShardDistributed:
+    def test_shard_across_query_workers(self):
+        """North-star topology: shard a stream across remote worker
+        pipelines and re-join ordered (SURVEY.md §5.8)."""
+        workers, ports = [], []
+        for wid in (10, 11):
+            w = parse_launch(
+                f"tensor_query_serversrc name=ssrc id={wid} port=0 "
+                "caps=other/tensors,format=static,dimensions=1,types=float32 "
+                "! tensor_filter framework=jax model=builtin://scaler?factor=100 "
+                f"! tensor_query_serversink id={wid}"
+            )
+            w.play()
+            deadline = time.monotonic() + 5
+            while w.get("ssrc").bound_port == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            workers.append(w)
+            ports.append(w.get("ssrc").bound_port)
+        try:
+            pipe = parse_launch(
+                "tensor_src num-buffers=8 dimensions=1 types=float32 pattern=counter "
+                "! tensor_shard name=s "
+                f"s.src_0 ! queue ! tensor_query_client port={ports[0]} ! u.sink_0 "
+                f"s.src_1 ! queue ! tensor_query_client port={ports[1]} ! u.sink_1 "
+                "tensor_unshard name=u ! tensor_sink name=out max-stored=32"
+            )
+            out = _collect(pipe, n=8, timeout=30)
+            vals = [float(np.asarray(b.tensors[0])[0]) for b in out]
+            assert vals == [v * 100 for v in range(8)]
+        finally:
+            for w in workers:
+                w.stop()
