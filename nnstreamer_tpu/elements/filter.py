@@ -103,11 +103,19 @@ class TensorFilter(TransformElement):
         self._last_invoke_ts = 0.0
 
     # -- lifecycle ----------------------------------------------------------
-    def _detect_framework(self) -> str:
+    def _resolve_model(self) -> tuple:
+        """(path, framework_hint): expands registry:// URIs (reference
+        mlagent:// resolution, gst/nnstreamer/ml_agent.c)."""
+        from ..registry.models import resolve
+
+        return resolve(self.props["model"])
+
+    def _detect_framework(self, model: str, hint: Optional[str]) -> str:
         fw = self.props["framework"]
-        model = self.props["model"]
         if fw != "auto":
             return fw
+        if hint:
+            return hint
         if model.startswith("builtin://"):
             return "jax"
         candidates = get_config().framework_priority(model)
@@ -123,9 +131,12 @@ class TensorFilter(TransformElement):
     def _open_backend(self) -> None:
         if self.backend is not None:
             return
-        fw = self._detect_framework()
+        # resolve ONCE: path and framework hint must describe the same
+        # registry version even if the registry file changes concurrently
+        model_path, hint = self._resolve_model()
+        fw = self._detect_framework(model_path, hint)
         fprops = FilterProperties(
-            model=self.props["model"],
+            model=model_path,
             custom=self.props["custom"],
             accelerator=Accelerator(self.props["accelerator"]),
         )
@@ -225,6 +236,7 @@ class TensorFilter(TransformElement):
         if new_model:
             self.props["model"] = new_model
             if self.backend is not None and self.backend.props is not None:
-                self.backend.props.model = new_model
+                # registry:// URIs resolve to the concrete path, same as open
+                self.backend.props.model, _ = self._resolve_model()
         if self.backend is not None:
             self.backend.handle_event(BackendEvent.RELOAD_MODEL)

@@ -70,11 +70,30 @@ def load_standard_elements() -> None:
             raise
 
 
+def _allowed(factory_name: str) -> bool:
+    """Element restriction allowlist (reference: meson
+    ``enable-element-restriction`` + ``restricted-elements`` — products ship
+    pipelines limited to a vetted element set, nnstreamer_conf's
+    element-restriction check). Config key: ``[common] restricted_elements``
+    = comma-separated allowlist; empty/absent = everything allowed."""
+    from .config import get_config
+
+    allow = get_config().get("common", "restricted_elements", "")
+    if not allow.strip():
+        return True
+    return factory_name in {e.strip() for e in allow.split(",") if e.strip()}
+
+
 def make_element(factory_name: str, name=None, **props) -> Element:
     load_standard_elements()
     if factory_name not in _FACTORIES:
         raise ValueError(
             f"no such element '{factory_name}' (known: {sorted(_FACTORIES)})"
+        )
+    if not _allowed(factory_name):
+        raise PermissionError(
+            f"element '{factory_name}' is not in the configured "
+            "restricted_elements allowlist"
         )
     return _FACTORIES[factory_name](name=name, **props)
 

@@ -170,3 +170,20 @@ class TestPoseNet:
         ys, xs = np.unravel_index(idx, (hh, ww))
         np.testing.assert_allclose(kps_dev[:, 0], xs / (ww - 1), atol=1e-6)
         np.testing.assert_allclose(kps_dev[:, 1], ys / (hh - 1), atol=1e-6)
+
+
+def test_bf16_compute_label_stable():
+    """The TPU path's bfloat16 compute must yield the same labels as the
+    float32 build with identical weights (the bf16↔f32 leg of parity)."""
+    import jax
+    import numpy as np
+
+    from nnstreamer_tpu.models.mobilenet_v2 import build_mobilenet_v2
+
+    f32_fn, f32_params = build_mobilenet_v2(compute_dtype="float32")
+    bf_fn, bf_params = build_mobilenet_v2(compute_dtype="bfloat16")
+    rng = np.random.default_rng(3)
+    x = rng.random((4, 224, 224, 3), np.float32) * 2 - 1
+    a = np.asarray(jax.jit(lambda v: f32_fn(f32_params, v))(x)).argmax(-1)
+    b = np.asarray(jax.jit(lambda v: bf_fn(bf_params, v))(x)).argmax(-1)
+    np.testing.assert_array_equal(a, b)

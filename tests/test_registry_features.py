@@ -1,0 +1,94 @@
+"""Model-registry URI resolution + element restriction allowlist.
+
+Reference analogs: ml_agent.c (mlagent:// model URIs) and the
+element-restriction product feature (meson enable-element-restriction).
+"""
+import json
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.registry.models import resolve
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+@pytest.fixture
+def registry(tmp_path, monkeypatch):
+    reg = {
+        "plain": {"path": "/models/a.tflite", "framework": "tflite"},
+        "versioned": {
+            "active": "2",
+            "framework": "custom",
+            "versions": {"1": {"path": "/m/v1.so"},
+                         "2": {"path": "/m/v2.so"}},
+        },
+        "scaler": {"path": "builtin://scaler?factor=4", "framework": "jax"},
+    }
+    p = tmp_path / "models.json"
+    p.write_text(json.dumps(reg))
+    monkeypatch.setenv("NNS_TPU_MODEL_REGISTRY", str(p))
+    return p
+
+
+class TestModelRegistry:
+    def test_plain_entry(self, registry):
+        assert resolve("registry://plain") == ("/models/a.tflite", "tflite")
+
+    def test_versioned_active_and_pinned(self, registry):
+        assert resolve("registry://versioned") == ("/m/v2.so", "custom")
+        assert resolve("registry://versioned@1") == ("/m/v1.so", "custom")
+
+    def test_non_uri_passthrough(self, registry):
+        assert resolve("/direct/path.pt") == ("/direct/path.pt", None)
+
+    def test_string_shorthand_entry(self, tmp_path, monkeypatch):
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps({"short": "/models/short.tflite"}))
+        monkeypatch.setenv("NNS_TPU_MODEL_REGISTRY", str(p))
+        assert resolve("registry://short") == ("/models/short.tflite", None)
+
+    def test_malformed_entry_clear_error(self, tmp_path, monkeypatch):
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps({"bad": 42}))
+        monkeypatch.setenv("NNS_TPU_MODEL_REGISTRY", str(p))
+        with pytest.raises(ValueError, match="path string or an object"):
+            resolve("registry://bad")
+
+    def test_unknown_name(self, registry):
+        with pytest.raises(KeyError, match="not in registry"):
+            resolve("registry://nope")
+
+    def test_unknown_version(self, registry):
+        with pytest.raises(KeyError, match="no version"):
+            resolve("registry://versioned@9")
+
+    def test_missing_registry_file(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("NNS_TPU_MODEL_REGISTRY", str(tmp_path / "no.json"))
+        with pytest.raises(FileNotFoundError):
+            resolve("registry://x")
+
+    def test_pipeline_uses_registry_model(self, registry):
+        """framework=auto + registry URI: hint picks the backend, path feeds
+        the model (end-to-end through tensor_filter)."""
+        pipe = parse_launch(
+            "tensor_src num-buffers=2 dimensions=4 types=float32 pattern=ones "
+            "! tensor_filter framework=auto model=registry://scaler "
+            "! tensor_sink name=out max-stored=4")
+        out = []
+        pipe.get("out").connect(out.append)
+        pipe.play(); pipe.wait(timeout=30); pipe.stop()
+        assert len(out) == 2
+        np.testing.assert_allclose(np.asarray(out[0].tensors[0]), 4.0)
+
+
+class TestElementRestriction:
+    def test_allowlist_blocks_unlisted(self, monkeypatch):
+        monkeypatch.setenv("NNS_TPU_COMMON_RESTRICTED_ELEMENTS",
+                           "tensor_src,tensor_sink")
+        with pytest.raises(PermissionError, match="restricted_elements"):
+            parse_launch("tensor_src num-buffers=1 dimensions=1 "
+                         "types=float32 ! tensor_transform mode=typecast "
+                         "option=float64 ! tensor_sink")
+        # allowed elements still construct
+        parse_launch("tensor_src num-buffers=1 dimensions=1 types=float32 "
+                     "! tensor_sink")
