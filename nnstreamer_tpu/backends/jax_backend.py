@@ -119,11 +119,30 @@ class JaxBackend(FilterBackend):
     def _select_device(self, props: FilterProperties) -> None:
         import jax
 
+        devices = jax.devices()
+        # explicit stage placement: custom=device:N pins this filter to chip
+        # N — consecutive pinned stages + queues = pipeline parallelism
+        # (each stage's compute and HBM live on its own chip; inter-stage
+        # buffers move device-to-device, never through host)
+        idx = props.custom_dict().get("device")
+        if idx is not None:
+            try:
+                i = int(idx)
+            except ValueError:
+                raise ValueError(
+                    f"custom=device:{idx!r} is not a device index "
+                    f"(expected 0..{len(devices) - 1})"
+                )
+            if not 0 <= i < len(devices):
+                raise ValueError(
+                    f"custom=device:{i} out of range ({len(devices)} devices)"
+                )
+            self._device = devices[i]
+            return
         accel = props.accelerator
         want = get_config().get("jax", "default_device", "auto")
         if accel is not Accelerator.AUTO:
             want = accel.value
-        devices = jax.devices()
         if want in ("auto", ""):
             self._device = devices[0]
             return
@@ -131,6 +150,11 @@ class JaxBackend(FilterBackend):
         self._device = matching[0] if matching else devices[0]
         if not matching:
             logger.warning("no %s device; falling back to %s", want, self._device)
+
+    @property
+    def device(self):
+        """The chip this backend instance is pinned to."""
+        return self._device
 
     def set_model_callable(self, fn: Callable,
                            in_info: Optional[TensorsInfo] = None,
@@ -212,10 +236,20 @@ class JaxBackend(FilterBackend):
 
         if self._fn is None:
             raise RuntimeError("jax backend: invoke before open")
-        device_inputs = [
-            x if hasattr(x, "addressable_shards") else jax.device_put(x, self._device)
-            for x in inputs
-        ]
+        device_inputs = []
+        for x in inputs:
+            if hasattr(x, "addressable_shards"):
+                # device-resident already; move single-device arrays that sit
+                # on the WRONG chip (upstream pinned stage) onto ours —
+                # device-to-device (ICI on TPU), never through host. Sharded
+                # multi-device arrays pass through untouched (pjit stages).
+                devs = x.devices()
+                if (self._device is not None and len(devs) == 1
+                        and devs != {self._device}):
+                    x = jax.device_put(x, self._device)
+            else:
+                x = jax.device_put(x, self._device)
+            device_inputs.append(x)
         out = self._jitted()(*device_inputs)
         return list(out)
 

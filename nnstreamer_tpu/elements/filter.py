@@ -230,6 +230,11 @@ class TensorFilter(TransformElement):
         return out
 
     # -- runtime model control ----------------------------------------------
+    @property
+    def backend_device(self):
+        """The device the opened backend is pinned to (jax backends)."""
+        return getattr(self.backend, "device", None)
+
     def reload_model(self, new_model: Optional[str] = None) -> None:
         """Hot model swap without pipeline restart (reference ``is-updatable``
         + RELOAD_MODEL event, nnstreamer_plugin_api_filter.h:378-384)."""

@@ -1,0 +1,71 @@
+"""Pipeline parallelism: consecutive filter stages pinned to different
+devices, with queues giving each stage its own thread.
+
+Reference analog: SURVEY.md §2.9 PP row — the reference's whole framework is
+a software pipeline (queue elements = per-stage threads, multi-model
+pipelines are stage-parallel across frames by construction). TPU extension:
+``custom=device:N`` pins each stage's compute + HBM to chip N (tested here
+on the 8-device virtual CPU mesh from conftest.py).
+"""
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+def test_two_stage_device_placement():
+    pipe = parse_launch(
+        "tensor_src num-buffers=4 dimensions=8 types=float32 pattern=counter "
+        "! tensor_filter framework=jax model=builtin://scaler?factor=2 "
+        "  custom=device:0 name=f0 "
+        "! queue "
+        "! tensor_filter framework=jax model=builtin://scaler?factor=5 "
+        "  custom=device:1 name=f1 "
+        "! tensor_sink name=out max-stored=8")
+    out = []
+    pipe.get("out").connect(out.append)
+    pipe.play(); pipe.wait(timeout=30)
+    d0 = pipe.get("f0").backend_device   # read before stop() releases backends
+    d1 = pipe.get("f1").backend_device
+    pipe.stop()
+    assert len(out) == 4
+    np.testing.assert_allclose(np.asarray(out[3].tensors[0]), 3 * 10.0)
+    assert d0 is not None and d1 is not None and d0 != d1
+    # the handoff moved the frame onto stage 1's chip (device-to-device)
+    (final_dev,) = out[0].tensors[0].devices()
+    assert final_dev == d1
+
+
+def test_device_index_out_of_range():
+    import jax
+
+    from nnstreamer_tpu.core import MessageType
+
+    n = len(jax.devices())
+    pipe = parse_launch(
+        "tensor_src num-buffers=1 dimensions=2 types=float32 "
+        f"! tensor_filter framework=jax model=builtin://passthrough custom=device:{n} "
+        "! tensor_sink name=out")
+    pipe.play()
+    msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+    pipe.stop()
+    assert msg is not None and "out of range" in str(msg.data)
+
+
+def test_stage_output_stays_on_assigned_device():
+    """The inter-stage buffer must already live on stage 0's device (no
+    host bounce between jitted stages)."""
+    pipe = parse_launch(
+        "tensor_src num-buffers=2 dimensions=4 types=float32 pattern=ones "
+        "! tensor_filter framework=jax model=builtin://scaler?factor=3 "
+        "  custom=device:2 name=f "
+        "! tensor_sink name=out max-stored=4")
+    out = []
+    pipe.get("out").connect(out.append)
+    pipe.play(); pipe.wait(timeout=30)
+    dev_assigned = pipe.get("f").backend_device
+    pipe.stop()
+    t = out[0].tensors[0]
+    assert hasattr(t, "devices"), "filter output left the device"
+    (dev,) = t.devices()
+    assert dev == dev_assigned
