@@ -40,6 +40,10 @@ def _log(msg: str) -> None:
     print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+def _raise_pipeline_error(msg) -> None:
+    raise RuntimeError(f"pipeline ERROR from {msg.source}: {msg.data.get('error')}")
+
+
 def main() -> None:
     import numpy as np
 
@@ -51,7 +55,18 @@ def main() -> None:
     _log("initializing jax backend (TPU init can take minutes on this rig)")
     import jax
 
-    devices = jax.devices()
+    tpu_error = None
+    try:
+        devices = jax.devices()
+    except RuntimeError as e:
+        # TPU tunnel down (observed: 'Unable to initialize backend axon:
+        # UNAVAILABLE'). A CPU number with the true cause attached beats
+        # no number at all.
+        tpu_error = str(e)
+        _log(f"default backend init FAILED: {tpu_error}")
+        _log("falling back to CPU")
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
     platform = devices[0].platform
     _log(f"backend up: {len(devices)} x {platform}")
 
@@ -120,9 +135,7 @@ def main() -> None:
             msg = pipe.bus.pop(timeout=0.05)
             if msg is not None and msg.type is MessageType.ERROR:
                 pipe.stop()
-                raise RuntimeError(
-                    f"pipeline ERROR from {msg.source}: {msg.data.get('error')}"
-                )
+                _raise_pipeline_error(msg)
             if msg is not None and msg.type is MessageType.EOS:
                 # stream finished with fewer batches than expected (dropped
                 # frames); don't idle out the deadline waiting for more
@@ -140,9 +153,7 @@ def main() -> None:
                 if msg is None:
                     break
                 if msg.type is MessageType.ERROR:
-                    raise RuntimeError(
-                        f"pipeline ERROR from {msg.source}: {msg.data.get('error')}"
-                    )
+                    _raise_pipeline_error(msg)
         if len(times) <= WARMUP_BATCHES + 1:
             raise RuntimeError(
                 f"bench produced only {len(times)} batches "
@@ -189,6 +200,8 @@ def main() -> None:
         result["batches_measured"] = n_measured
     if early_eos:
         result["early_eos"] = True
+    if tpu_error:
+        result["tpu_error"] = tpu_error
     print(json.dumps(result))
 
 

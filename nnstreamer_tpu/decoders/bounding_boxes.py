@@ -11,10 +11,26 @@ Reference analog: ``ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c``
     scores, anchors decoded on host via models.ssd_mobilenet.decode_boxes_np;
   * ``yolov5``: (N, 5+C) rows [cx,cy,w,h,obj,cls...] (pixels or normalized);
   * ``yolov8``: (4+C, N) or (N, 4+C) rows [cx,cy,w,h,cls...];
+  * ``ov-person-detection`` / ``ov-face-detection``: one tensor of
+    (N, 7) rows [image_id, label, conf, xmin, ymin, xmax, ymax]
+    (normalized); rows end at the first negative image_id; confidence
+    threshold 0.8, no NMS (the model already applies it) — reference
+    ``_get_persons_ov`` (tensordec-boundingbox.c:1675) and the caps check
+    [7, 200] (:1172-1188);
+  * ``mp-palm-detection``: tensors [boxes (N,18), scores (N,)] against
+    SSD-style anchors generated for the 192×192 palm model (reference
+    ``_mp_palm_detection_generate_anchors`` :673-755); sigmoid scores
+    clamped to ±100, anchor-relative decode, NMS IoU 0.05
+    (:1726-1770, :2160);
   * ``custom``: a registered python callback (register_bbox_parser).
 
 Options (reference option2..): option2 = "W:H" output video size;
-option3 = labels file; option4 = score threshold; option5 = IoU threshold.
+option3 = labels file; option4 = score threshold; option5 = IoU threshold
+(both default per mode: 0.25/0.5 generally, 0.8/none for ov-*, 0.5/0.05
+for mp-palm); option8 = "W:H" model input size (palm decode scale,
+default 192:192); option9 = palm anchor params
+"layers:min_scale:max_scale:offset_x:offset_y:stride0:stride1:..."
+(reference option3 tail for mp-palm-detection).
 Output: RGBA video frame with box rectangles drawn (transparent background,
 to be alpha-blended over the source video — the reference's ``compositor``
 pattern); decoded detections also ride in ``buf.meta["detections"]``.
@@ -53,8 +69,21 @@ class BoundingBoxes(Decoder):
         if path:
             with open(path) as fh:
                 self.labels = [ln.strip() for ln in fh if ln.strip()]
-        self.score_threshold = float(self.option(4, "0.25"))
-        self.iou_threshold = float(self.option(5, "0.5"))
+        # per-mode reference defaults: ov-* uses a fixed 0.8 confidence gate
+        # and no NMS (OV_PERSON_DETECTION_CONF_THRESHOLD); mp-palm uses
+        # sigmoid-score 0.5 and a tight 0.05 IoU NMS (tensordec-boundingbox.c)
+        if self.fmt in ("ov-person-detection", "ov-face-detection"):
+            default_score, default_iou, self.use_nms = "0.8", "0.5", False
+        elif self.fmt == "mp-palm-detection":
+            default_score, default_iou, self.use_nms = "0.5", "0.05", True
+        else:
+            default_score, default_iou, self.use_nms = "0.25", "0.5", True
+        self.score_threshold = float(self.option(4, default_score))
+        self.iou_threshold = float(self.option(5, default_iou))
+        in_wh = self.option(8, "192:192").split(":")
+        self.in_width, self.in_height = int(in_wh[0]), int(in_wh[1])
+        if self.fmt == "mp-palm-detection":
+            self.palm_anchors = _palm_anchors(self.option(9), self.in_width)
         # yolov8 tensor layout: auto | boxes-first ((N,4+C) rows) |
         # coords-first ((4+C,N) columns). auto transposes when the first dim
         # is smaller — right for real heads (84, 8400) but ambiguous when
@@ -64,7 +93,7 @@ class BoundingBoxes(Decoder):
         priors = self.option(7)
         if priors:
             self.anchors = np.load(priors).astype(np.float32)
-        elif self.fmt == "mobilenet-ssd":
+        elif self.fmt in ("mobilenet-ssd", "tflite-ssd"):
             raise ValueError(
                 "bounding_boxes: mobilenet-ssd (raw) needs option7=<priors.npy>")
 
@@ -74,7 +103,7 @@ class BoundingBoxes(Decoder):
     # -- per-format parsing → normalized boxes ------------------------------
     def _parse(self, tensors) -> tuple:
         fmt = self.fmt
-        if fmt == "mobilenet-ssd":
+        if fmt in ("mobilenet-ssd", "tflite-ssd"):  # tflite-ssd = old name
             from ..models.ssd_mobilenet import decode_boxes_np
 
             loc = np.asarray(tensors[0]).reshape(-1, 4).astype(np.float32)
@@ -84,7 +113,39 @@ class BoundingBoxes(Decoder):
             scores = 1.0 / (1.0 + np.exp(-logits))  # sigmoid
             classes = scores.argmax(-1)
             return boxes, scores.max(-1), classes
-        if fmt in ("mobilenet-ssd-postprocess", "tf-ssd", "mp-palm-detection"):
+        if fmt in ("ov-person-detection", "ov-face-detection"):
+            a = np.asarray(tensors[0]).astype(np.float32).reshape(-1, 7)
+            # rows: [image_id, label, conf, xmin, ymin, xmax, ymax]; the
+            # detection list terminates at the first negative image_id
+            end = np.nonzero(a[:, 0] < 0)[0]
+            if end.size:
+                a = a[: end[0]]
+            boxes = a[:, [4, 3, 6, 5]]  # -> [ymin, xmin, ymax, xmax]
+            # class_id = -1 in the reference (no label set for ov modes)
+            classes = np.full(a.shape[0], -1, np.int64)
+            return boxes, a[:, 2], classes
+        if fmt == "mp-palm-detection":
+            anchors = self.palm_anchors  # (A, 4) [x_center, y_center, w, h]
+            raw = np.asarray(tensors[0]).astype(np.float32).reshape(-1, 18)
+            scores = np.asarray(tensors[1]).astype(np.float32).reshape(-1)
+            if len(raw) != len(anchors) or len(scores) != len(anchors):
+                raise ValueError(
+                    f"mp-palm-detection: {len(raw)} box rows / {len(scores)} "
+                    f"scores vs {len(anchors)} anchors — check option8 "
+                    "(model input size) and option9 (anchor params)"
+                )
+            n = len(anchors)
+            anc = anchors
+            clipped = np.clip(scores.astype(np.float64), -100.0, 100.0)
+            scores = (1.0 / (1.0 + np.exp(-clipped))).astype(np.float32)
+            # anchor-relative decode: offsets scaled by the model input size
+            yc = raw[:, 0] / self.in_height * anc[:, 3] + anc[:, 1]
+            xc = raw[:, 1] / self.in_width * anc[:, 2] + anc[:, 0]
+            h = raw[:, 2] / self.in_height * anc[:, 3]
+            w = raw[:, 3] / self.in_width * anc[:, 2]
+            boxes = np.stack([yc - h / 2, xc - w / 2, yc + h / 2, xc + w / 2], axis=1)
+            return boxes, scores, np.zeros(n, np.int64)
+        if fmt in ("mobilenet-ssd-postprocess", "tf-ssd"):
             boxes = np.asarray(tensors[0]).reshape(-1, 4).astype(np.float32)
             scores = np.asarray(tensors[1]).astype(np.float32)
             if scores.ndim > 1:
@@ -133,7 +194,10 @@ class BoundingBoxes(Decoder):
     # -- decode -------------------------------------------------------------
     def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
         boxes, scores, classes = self._parse(buf.tensors)
-        keep = nms_numpy(boxes, scores, self.iou_threshold, self.score_threshold)
+        if self.use_nms:
+            keep = nms_numpy(boxes, scores, self.iou_threshold, self.score_threshold)
+        else:  # ov-*: the model already suppressed; threshold only
+            keep = np.nonzero(scores >= self.score_threshold)[0]
         frame = np.zeros((self.height, self.width, 4), np.uint8)
         detections = []
         for i in keep:
@@ -147,11 +211,67 @@ class BoundingBoxes(Decoder):
                 "box": [x1, y1, x2 - x1, y2 - y1],
                 "score": float(scores[i]),
                 "class": cls,
-                "label": self.labels[cls] if cls < len(self.labels) else str(cls),
+                "label": self.labels[cls] if 0 <= cls < len(self.labels) else str(cls),
             })
         out = Buffer([frame])
         out.meta["detections"] = detections
         return out
+
+
+def _palm_scale(min_scale: float, max_scale: float, idx: int, n: int) -> float:
+    if n == 1:
+        return (min_scale + max_scale) * 0.5
+    return min_scale + (max_scale - min_scale) * idx / (n - 1.0)
+
+
+def _palm_anchors(params: Optional[str], input_size: int = 192) -> np.ndarray:
+    """SSD anchor grid for the mediapipe palm model.
+
+    Layers sharing a stride are folded into one grid with 2 anchors per
+    same-stride layer per cell; defaults (4 layers, strides 8:16:16:16,
+    scales 1.0, 192×192 input) yield 2016 anchors — reference
+    ``_mp_palm_detection_generate_anchors`` (tensordec-boundingbox.c:673;
+    the reference hardcodes 192, here the grid follows the option8 input
+    size so non-192 palm variants decode against a matching grid).
+    Returns (A, 4) float32 [x_center, y_center, w, h], normalized.
+    """
+    num_layers, min_scale, max_scale = 4, 1.0, 1.0
+    offset_x, offset_y = 0.5, 0.5
+    strides = [8, 16, 16, 16]
+    if params:
+        parts = [p for p in str(params).split(":")]
+        vals = [float(p) if p else None for p in parts]
+        if len(vals) > 0 and vals[0] is not None:
+            num_layers = int(vals[0])
+        if len(vals) > 1 and vals[1] is not None:
+            min_scale = vals[1]
+        if len(vals) > 2 and vals[2] is not None:
+            max_scale = vals[2]
+        if len(vals) > 3 and vals[3] is not None:
+            offset_x = vals[3]
+        if len(vals) > 4 and vals[4] is not None:
+            offset_y = vals[4]
+        given = [int(v) for v in vals[5:] if v is not None]
+        if given:
+            strides = given
+    strides = (strides + [strides[-1]] * num_layers)[:num_layers]
+    out = []
+    layer = 0
+    while layer < num_layers:
+        sizes = []  # (w, h) per anchor at each cell
+        last = layer
+        while last < num_layers and strides[last] == strides[layer]:
+            for idx in (last, last + 1):
+                s = _palm_scale(min_scale, max_scale, idx, num_layers)
+                sizes.append((s, s))  # aspect ratio 1.0 twice per layer
+            last += 1
+        fm = int(np.ceil(input_size / strides[layer]))
+        for y in range(fm):
+            for x in range(fm):
+                for w, h in sizes:
+                    out.append(((x + offset_x) / fm, (y + offset_y) / fm, w, h))
+        layer = last
+    return np.asarray(out, np.float32)
 
 
 def _class_color(cls: int) -> np.ndarray:

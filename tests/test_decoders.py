@@ -122,6 +122,92 @@ class TestBoundingBoxes:
         assert dets[0]["box"][2] == 100  # width in pixels
 
 
+class TestOvDetection:
+    def _rows(self):
+        # [image_id, label, conf, xmin, ymin, xmax, ymax]; list terminates
+        # at the first negative image_id (reference _get_persons_ov)
+        a = np.zeros((200, 7), np.float32)
+        a[0] = [0, 1, 0.95, 0.1, 0.2, 0.5, 0.6]
+        a[1] = [0, 1, 0.85, 0.6, 0.6, 0.9, 0.9]
+        a[2] = [0, 1, 0.70, 0.0, 0.0, 0.3, 0.3]  # below the 0.8 gate
+        a[3, 0] = -1
+        a[4] = [0, 1, 0.99, 0.0, 0.0, 1.0, 1.0]  # after terminator: ignored
+        return a
+
+    @pytest.mark.parametrize("fmt", ["ov-person-detection", "ov-face-detection"])
+    def test_rows_terminator_threshold(self, fmt):
+        from nnstreamer_tpu.core import TensorsInfo
+        from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
+
+        dec = BoundingBoxes()
+        dec.init([fmt, "100:100"])
+        out = dec.decode(Buffer([self._rows()]), TensorsInfo())
+        dets = out.meta["detections"]
+        assert len(dets) == 2  # conf 0.95 + 0.85; 0.70 gated; row 4 ignored
+        assert dets[0]["box"] == [10, 20, 40, 40]  # x,y,w,h from normalized
+        assert all(d["class"] == -1 for d in dets)
+
+    def test_overlapping_not_suppressed(self):
+        # ov modes do no NMS — the model output is already suppressed
+        from nnstreamer_tpu.core import TensorsInfo
+        from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
+
+        a = np.zeros((3, 7), np.float32)
+        a[0] = [0, 1, 0.9, 0.1, 0.1, 0.5, 0.5]
+        a[1] = [0, 1, 0.9, 0.11, 0.11, 0.51, 0.51]
+        a[2, 0] = -1
+        dec = BoundingBoxes()
+        dec.init(["ov-person-detection", "100:100"])
+        out = dec.decode(Buffer([a]), TensorsInfo())
+        assert len(out.meta["detections"]) == 2
+
+
+class TestMpPalmDetection:
+    def test_anchor_grid_matches_reference_count(self):
+        from nnstreamer_tpu.decoders.bounding_boxes import _palm_anchors
+
+        anchors = _palm_anchors(None)
+        # reference MP_PALM_DETECTION_DETECTION_MAX: 24*24*2 + 12*12*6 = 2016
+        assert anchors.shape == (2016, 4)
+        # stride-8 grid first: 2 anchors per cell at cell centers
+        assert np.allclose(anchors[0], [0.5 / 24, 0.5 / 24, 1.0, 1.0])
+        assert np.allclose(anchors[1], [0.5 / 24, 0.5 / 24, 1.0, 1.0])
+        # second grid block is the folded stride-16 layers: 6 anchors per cell
+        assert np.allclose(anchors[24 * 24 * 2], [0.5 / 12, 0.5 / 12, 1.0, 1.0])
+
+    def test_anchor_params_option(self):
+        from nnstreamer_tpu.decoders.bounding_boxes import _palm_anchors
+
+        anchors = _palm_anchors("1:0.5:0.5:0.5:0.5:8")
+        # single layer, stride 8: 24*24 cells * 2 anchors
+        assert anchors.shape == (24 * 24 * 2, 4)
+        assert np.allclose(anchors[0, 2:], 0.5)  # w=h=scale
+
+    def test_decode_sigmoid_and_anchor_offsets(self):
+        from nnstreamer_tpu.core import TensorsInfo
+        from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes, _palm_anchors
+
+        anchors = _palm_anchors(None)
+        n = anchors.shape[0]
+        raw = np.zeros((n, 18), np.float32)
+        scores = np.full((n,), -100.0, np.float32)  # sigmoid → ~0
+        k = 2 * (24 * 5 + 5)  # interior cell (5,5) of the stride-8 grid
+        # a box centered exactly on anchor k, 48px square on the 192 input
+        raw[k, :4] = [0.0, 0.0, 48.0, 48.0]
+        scores[k] = 100.0  # sigmoid → ~1
+        dec = BoundingBoxes()
+        dec.init(["mp-palm-detection", "192:192"])
+        out = dec.decode(Buffer([raw, scores]), TensorsInfo())
+        dets = out.meta["detections"]
+        assert len(dets) == 1
+        x, y, w, h = dets[0]["box"]
+        # anchor k center, normalized → pixels on the 192 output canvas
+        cx, cy = anchors[k, 0] * 192, anchors[k, 1] * 192
+        assert abs((x + w / 2) - cx) <= 2 and abs((y + h / 2) - cy) <= 2
+        assert abs(w - 48) <= 2 and abs(h - 48) <= 2
+        assert dets[0]["score"] > 0.99
+
+
 class TestNms:
     def test_iou_and_greedy(self):
         boxes = np.array([[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
