@@ -176,6 +176,14 @@ class JaxBackend(FilterBackend):
                     f"unknown builtin model '{name}' (have: {sorted(builtins)})"
                 )
             return builtins[name](params)
+        if model.endswith(".tflite") and os.path.exists(model):
+            # run a .tflite file on XLA: flatbuffer parsed, weights
+            # dequantized, graph re-emitted as jax (models/tflite_import.py)
+            from ..models.tflite_import import load_tflite
+
+            fn, self._in_info, self._out_info = load_tflite(
+                model, props.custom_dict())
+            return fn
         if model.endswith(".py") and os.path.exists(model):
             ns: Dict[str, Any] = {"__file__": model}
             with open(model) as fh:

@@ -1,0 +1,129 @@
+"""tflite flatbuffer → jax importer tests: the reference's actual model
+files (tests/test_models/models/*.tflite) running on XLA, label-parity
+checked against the tflite interpreter on identical weights (VERDICT r1 #4;
+reference analog: checkLabel.py golden comparisons)."""
+import os
+
+import numpy as np
+import pytest
+
+REF_MODELS = "/root/reference/tests/test_models/models"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF_MODELS), reason="reference models not available")
+
+
+def _interp(path):
+    import tensorflow as tf
+
+    it = tf.lite.Interpreter(model_path=path)
+    it.allocate_tensors()
+    return it
+
+
+def _run_interp(it, *xs):
+    for d, x in zip(it.get_input_details(), xs):
+        it.set_tensor(d["index"], x)
+    it.invoke()
+    return [it.get_tensor(d["index"]) for d in it.get_output_details()]
+
+
+class TestFloatModels:
+    def test_add_exact(self):
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = f"{REF_MODELS}/add.tflite"
+        fn, in_info, out_info = load_tflite(path)
+        x = np.random.rand(*in_info.specs[0].shape).astype(np.float32)
+        ours = np.asarray(fn(x)[0])
+        ref = _run_interp(_interp(path), x)[0]
+        assert np.abs(ours - ref).max() == 0.0
+
+    def test_simple32_chain(self):
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = f"{REF_MODELS}/simple_32_in_32_out.tflite"
+        fn, in_info, _ = load_tflite(path)
+        xs = [np.random.rand(*s.shape).astype(np.float32) for s in in_info.specs]
+        ours = [np.asarray(o) for o in fn(*xs)]
+        ref = _run_interp(_interp(path), *xs)
+        for a, b in zip(ours, ref):
+            assert np.allclose(a, b)
+
+    @pytest.mark.slow
+    def test_deeplab_resize_bilinear(self):
+        import jax
+
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = f"{REF_MODELS}/deeplabv3_257_mv_gpu.tflite"
+        fn, in_info, _ = load_tflite(path)
+        x = np.random.rand(*in_info.specs[0].shape).astype(np.float32)
+        ours = np.asarray(jax.jit(fn)(x)[0])
+        ref = _run_interp(_interp(path), x)[0]
+        assert np.abs(ours - ref).max() < 1e-3
+        assert (ours.argmax(-1) == ref.argmax(-1)).mean() == 1.0
+
+
+class TestQuantizedMobilenet:
+    """The BASELINE.md acceptance: the reference's quantized MobileNet-v2
+    through both executors on identical weights, top-1 parity."""
+
+    @pytest.mark.slow
+    def test_label_parity_vs_interpreter(self):
+        import jax
+
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = f"{REF_MODELS}/mobilenet_v2_1.0_224_quant.tflite"
+        fn, in_info, out_info = load_tflite(path)
+        assert in_info.specs[0].shape == (1, 224, 224, 3)
+        assert out_info.specs[0].shape == (1, 1001)
+        it = _interp(path)
+        jfn = jax.jit(fn)
+        rng = np.random.default_rng(42)
+        agree = 0
+        trials = 6
+        for _ in range(trials):
+            u = rng.random((224, 224, 1)) * rng.random((1, 1, 3))
+            img = np.clip(
+                u * 255 + rng.normal(0, 30, (224, 224, 3)), 0, 255
+            ).astype(np.uint8)[None]
+            ref = _run_interp(it, img)[0][0]
+            ours = np.asarray(jfn(img)[0])[0]
+            # outputs are uint8-requantized: byte distance bounds the error
+            assert np.abs(ref.astype(int) - ours.astype(int)).max() <= 4
+            agree += int(ref.argmax() == ours.argmax())
+        # float simulation of the integer graph: near-total top-1 agreement
+        assert agree >= trials - 2, f"top-1 parity too low: {agree}/{trials}"
+
+    @pytest.mark.slow
+    def test_pipeline_drop_in(self):
+        """framework=jax model=x.tflite is caps-compatible with
+        framework=tflite on the same file (uint8 in, uint8 out)."""
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        path = f"{REF_MODELS}/mobilenet_v2_1.0_224_quant.tflite"
+        results = {}
+        img = np.random.default_rng(7).integers(
+            0, 256, (1, 224, 224, 3)).astype(np.uint8)
+        for fw in ("jax", "tflite"):
+            pipe = parse_launch(
+                "appsrc name=in caps=other/tensors,format=static,"
+                "dimensions=3:224:224:1,types=uint8 "
+                f"! tensor_filter framework={fw} model={path} "
+                "! tensor_sink name=out")
+            got = []
+            pipe.get("out").connect(got.append)
+            pipe.play()
+            pipe.get("in").push_buffer(img)
+            pipe.get("in").end_of_stream()
+            pipe.wait(timeout=120)
+            pipe.stop()
+            out = np.asarray(got[0].tensors[0])
+            assert out.dtype == np.uint8 and out.shape == (1, 1001)
+            results[fw] = out
+        # same contract as test_label_parity_vs_interpreter: byte-level
+        # agreement (exact argmax on one noise image is seed/HW-fragile)
+        diff = np.abs(results["jax"].astype(int) - results["tflite"].astype(int))
+        assert diff.max() <= 4
