@@ -268,7 +268,13 @@ def tensors_any_caps() -> Caps:
     )
 
 
-ALL_MIMES = (TENSORS_MIME, VIDEO_MIME, AUDIO_MIME, TEXT_MIME, OCTET_MIME)
+# IDL byte-stream MIMEs (reference: other/protobuf-tensor caps of
+# ext/nnstreamer/extra/nnstreamer_protobuf.h, flatbuf analog)
+PROTOBUF_MIME = "other/protobuf-tensor"
+FLATBUF_MIME = "other/flatbuf-tensor"
+
+ALL_MIMES = (TENSORS_MIME, VIDEO_MIME, AUDIO_MIME, TEXT_MIME, OCTET_MIME,
+             PROTOBUF_MIME, FLATBUF_MIME)
 
 
 def any_media_caps() -> Caps:

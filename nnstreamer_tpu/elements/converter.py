@@ -35,10 +35,17 @@ from ..registry.subplugin import SubpluginKind, get as get_subplugin
 from ..runtime.element import ElementError, Prop, TransformElement
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 
+from ..core.caps import FLATBUF_MIME, PROTOBUF_MIME
+
+# IDL byte-stream MIMEs → the converter subplugin that parses them
+# (reference: caps-driven subplugin dispatch of ext/nnstreamer/tensor_converter/)
+_IDL_MIMES = {PROTOBUF_MIME: "protobuf", FLATBUF_MIME: "flatbuf"}
+
 _IN_CAPS = Caps(
     tuple(
         Structure.new(m)
-        for m in (VIDEO_MIME, AUDIO_MIME, TEXT_MIME, OCTET_MIME, TENSORS_MIME)
+        for m in (VIDEO_MIME, AUDIO_MIME, TEXT_MIME, OCTET_MIME, TENSORS_MIME,
+                  *_IDL_MIMES)
     )
 )
 
@@ -73,8 +80,11 @@ class TensorConverter(TransformElement):
         s = caps.first
         media = s.media_type
         n = self.props["frames_per_tensor"]
-        if self.props["subplugin"]:
-            cls = get_subplugin(SubpluginKind.CONVERTER, self.props["subplugin"])
+        # IDL streams self-select their converter from the caps MIME, like
+        # the reference's query_caps dispatch; an explicit subplugin= wins
+        subplugin = self.props["subplugin"] or _IDL_MIMES.get(media)
+        if subplugin:
+            cls = get_subplugin(SubpluginKind.CONVERTER, subplugin)
             opt = self.props["subplugin_option"]
             if not isinstance(cls, type):
                 self._ext = cls

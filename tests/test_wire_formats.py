@@ -1,0 +1,224 @@
+"""Cross-ecosystem wire-format parity: the protobuf/flatbuf codecs must
+interoperate with the real protobuf runtime and flatbuffers runtime, not
+just round-trip against themselves (VERDICT r1 #5; reference wire defined
+by ext/nnstreamer/include/nnstreamer.proto / nnstreamer.fbs)."""
+import shutil
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorFormat
+from nnstreamer_tpu.core import wire_flatbuf, wire_protobuf
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+# the reference's message layout, expressed independently for interop tests
+_PROTO_SRC = """
+syntax = "proto3";
+package nnstreamer.protobuf;
+message Tensor {
+  string name = 1;
+  enum Tensor_type {
+    NNS_INT32 = 0; NNS_UINT32 = 1; NNS_INT16 = 2; NNS_UINT16 = 3;
+    NNS_INT8 = 4; NNS_UINT8 = 5; NNS_FLOAT64 = 6; NNS_FLOAT32 = 7;
+    NNS_INT64 = 8; NNS_UINT64 = 9;
+  }
+  Tensor_type type = 2;
+  repeated uint32 dimension = 3;
+  bytes data = 4;
+}
+message Tensors {
+  uint32 num_tensor = 1;
+  message frame_rate { int32 rate_n = 1; int32 rate_d = 2; }
+  frame_rate fr = 2;
+  repeated Tensor tensor = 3;
+  enum Tensor_format { NNS_TENSOR_FORAMT_STATIC = 0;
+    NNS_TENSOR_FORMAT_FLEXIBLE = 1; NNS_TENSOR_FORMAT_SPARSE = 2; }
+  Tensor_format format = 4;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def pb2(tmp_path_factory):
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not available")
+    d = tmp_path_factory.mktemp("proto")
+    (d / "nns_wire.proto").write_text(_PROTO_SRC)
+    subprocess.run(
+        ["protoc", f"--python_out={d}", "-I", str(d), "nns_wire.proto"],
+        check=True)
+    sys.path.insert(0, str(d))
+    try:
+        import nns_wire_pb2
+
+        return nns_wire_pb2
+    finally:
+        sys.path.remove(str(d))
+
+
+def _sample_arrays():
+    rng = np.random.default_rng(3)
+    return [
+        rng.random((2, 3, 4)).astype(np.float32),
+        rng.integers(0, 255, (5,)).astype(np.uint8),
+        rng.integers(-100, 100, (1, 7)).astype(np.int32),
+    ]
+
+
+class TestProtobufWire:
+    def test_roundtrip(self):
+        arrays = _sample_arrays()
+        blob = wire_protobuf.encode_tensors(arrays, ["a", "", "c"],
+                                            rate=(30, 1))
+        out, names, fmt, rate = wire_protobuf.decode_tensors(blob)
+        assert rate == (30, 1) and fmt is TensorFormat.STATIC
+        assert names == ["a", "", "c"]
+        for x, y in zip(arrays, out):
+            assert x.dtype == y.dtype and np.array_equal(x, y)
+
+    def test_bytes_match_protobuf_runtime(self, pb2):
+        """Our encoder's bytes == the real runtime's canonical bytes."""
+        arrays = _sample_arrays()
+        blob = wire_protobuf.encode_tensors(arrays, ["a", "", "c"], rate=(30, 1))
+        msg = pb2.Tensors()
+        msg.num_tensor = len(arrays)
+        msg.fr.rate_n, msg.fr.rate_d = 30, 1
+        for i, a in enumerate(arrays):
+            t = msg.tensor.add()
+            t.name = ["a", "", "c"][i]
+            t.type = wire_protobuf.wire_type_of(
+                wire_protobuf.DataType.from_any(a.dtype))
+            t.dimension.extend(wire_protobuf.dims_of(a.shape))
+            t.data = a.tobytes()
+        assert blob == msg.SerializeToString()
+
+    def test_decode_runtime_bytes(self, pb2):
+        """Bytes produced by the real runtime parse back identically."""
+        a = np.arange(12, dtype=np.int16).reshape(3, 4)
+        msg = pb2.Tensors()
+        msg.num_tensor = 1
+        msg.format = 1  # FLEXIBLE
+        t = msg.tensor.add()
+        t.type = 2  # NNS_INT16
+        t.dimension.extend(wire_protobuf.dims_of(a.shape))
+        t.data = a.tobytes()
+        arrays, names, fmt, rate = wire_protobuf.decode_tensors(
+            msg.SerializeToString())
+        assert fmt is TensorFormat.FLEXIBLE
+        assert np.array_equal(arrays[0], a)
+
+
+class TestFlatbufWire:
+    def test_roundtrip(self):
+        arrays = _sample_arrays()
+        blob = wire_flatbuf.encode_tensors(arrays, ["x", "y", ""],
+                                           fmt=TensorFormat.FLEXIBLE,
+                                           rate=(25, 2))
+        out, names, fmt, rate = wire_flatbuf.decode_tensors(blob)
+        assert fmt is TensorFormat.FLEXIBLE and rate == (25, 2)
+        assert names == ["x", "y", ""]
+        for x, y in zip(arrays, out):
+            assert x.dtype == y.dtype and np.array_equal(x, y)
+
+    def _official_encode(self, arrays, names, fmt_val, rate):
+        """Build the same Tensors buffer with the official flatbuffers
+        runtime (field ids per nnstreamer.fbs declaration order)."""
+        import flatbuffers
+
+        b = flatbuffers.Builder(1024)
+        tensor_offs = []
+        for a, name in zip(arrays, names):
+            name_off = b.CreateString(name)
+            dims = wire_protobuf.dims_of(a.shape)
+            b.StartVector(4, len(dims), 4)
+            for d in reversed(dims):
+                b.PrependUint32(d)
+            dims_off = b.EndVector()
+            data_off = b.CreateByteVector(a.tobytes())
+            b.StartObject(4)
+            b.PrependUOffsetTRelativeSlot(0, name_off, 0)
+            b.PrependInt32Slot(
+                1, wire_protobuf.wire_type_of(
+                    wire_protobuf.DataType.from_any(a.dtype)), 10)
+            b.PrependUOffsetTRelativeSlot(2, dims_off, 0)
+            b.PrependUOffsetTRelativeSlot(3, data_off, 0)
+            tensor_offs.append(b.EndObject())
+        b.StartVector(4, len(tensor_offs), 4)
+        for off in reversed(tensor_offs):
+            b.PrependUOffsetTRelative(off)
+        vec_off = b.EndVector()
+        b.StartObject(4)
+        b.PrependInt32Slot(0, len(arrays), 0)
+        b.Prep(4, 8)  # frame_rate struct inline
+        b.PrependInt32(rate[1])
+        b.PrependInt32(rate[0])
+        b.PrependStructSlot(1, b.Offset(), 0)
+        b.PrependUOffsetTRelativeSlot(2, vec_off, 0)
+        b.PrependInt32Slot(3, fmt_val, 0)
+        root = b.EndObject()
+        b.Finish(root)
+        return bytes(b.Output())
+
+    def test_decode_official_bytes(self):
+        """Buffers built by the official flatbuffers runtime parse back."""
+        arrays = _sample_arrays()
+        blob = self._official_encode(arrays, ["x", "y", ""], 2, (25, 2))
+        out, names, fmt, rate = wire_flatbuf.decode_tensors(blob)
+        assert fmt is TensorFormat.SPARSE and rate == (25, 2)
+        assert names == ["x", "y", ""]
+        for x, y in zip(arrays, out):
+            assert x.dtype == y.dtype and np.array_equal(x, y)
+
+    def test_official_decodes_our_bytes(self):
+        """The official runtime can walk our builder's buffers."""
+        import flatbuffers
+        from flatbuffers import number_types as nt
+
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        blob = wire_flatbuf.encode_tensors([a], ["t0"], rate=(30, 1))
+        buf = bytearray(blob)
+        n = flatbuffers.encode.Get(nt.UOffsetTFlags.packer_type, buf, 0)
+        tab = flatbuffers.table.Table(buf, n)
+        # field 0: num_tensor
+        o = tab.Offset(4)
+        assert tab.Get(nt.Int32Flags, o + tab.Pos) == 1
+        # field 1: frame_rate struct inline
+        o = tab.Offset(6)
+        assert tab.Get(nt.Int32Flags, o + tab.Pos) == 30
+        assert tab.Get(nt.Int32Flags, o + tab.Pos + 4) == 1
+        # field 2: tensor vector → first Tensor table
+        o = tab.Offset(8)
+        vec_start = tab.Vector(o)
+        t = flatbuffers.table.Table(buf, tab.Indirect(vec_start))
+        name_off = t.Offset(4)
+        assert t.String(name_off + t.Pos) == b"t0"
+        # data vector bytes
+        d_off = t.Offset(10)
+        length = t.VectorLen(d_off)
+        start = t.Vector(d_off)
+        assert bytes(buf[start:start + length]) == a.tobytes()
+
+
+class TestPipelineRoundtrip:
+    @pytest.mark.parametrize("idl", ["protobuf", "flatbuf"])
+    def test_decoder_converter_loop(self, idl):
+        """tensors → IDL bytes → tensors through real pipeline elements."""
+        x = np.random.default_rng(5).random((4, 3)).astype(np.float32)
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=3:4,types=float32 "
+            f"! tensor_decoder mode={idl} "
+            "! tensor_converter "  # converter self-selects from the IDL MIME
+            "! tensor_sink name=out")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        pipe.get("in").push_buffer(x)
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=20)
+        pipe.stop()
+        out = np.asarray(got[0].tensors[0])
+        assert out.dtype == np.float32 and np.array_equal(out, x)
