@@ -16,6 +16,16 @@ from ..utils.log import logger
 from .protocol import MsgType, recv_msg, send_msg
 
 
+class Disconnected:
+    """Sentinel queued on connection loss (vs ``None`` = clean server EOS),
+    so consumers can tell a dead link from end-of-stream — the reference
+    distinguishes these via the CONNECTION_CLOSED event
+    (tensor_query_client.c:421-480)."""
+
+
+DISCONNECTED = Disconnected()
+
+
 class QueryClient:
     def __init__(self, host: str, port: int, timeout: float = 10.0):
         self.host, self.port = host, port
@@ -27,6 +37,7 @@ class QueryClient:
         self._reader: Optional[threading.Thread] = None
         self._running = threading.Event()
         self.connected = False
+        self._clean_eos = False
 
     def connect(self, caps: Caps) -> Caps:
         """TCP connect + caps handshake; returns the server's caps
@@ -39,11 +50,17 @@ class QueryClient:
                                         name=f"qclient:{self.host}:{self.port}",
                                         daemon=True)
         self._reader.start()
-        send_msg(self._sock, MsgType.CAPABILITY, str(caps).encode())
-        if not self._caps_event.wait(self.timeout):
-            raise TimeoutError("tensor-query caps handshake timed out")
-        if self.server_caps is None:
-            raise ConnectionError("tensor-query server rejected caps")
+        try:
+            send_msg(self._sock, MsgType.CAPABILITY, str(caps).encode())
+            if not self._caps_event.wait(self.timeout):
+                raise TimeoutError("tensor-query caps handshake timed out")
+            if self.server_caps is None:
+                raise ConnectionError("tensor-query server rejected caps")
+        except Exception:
+            # a failed handshake must not leak the socket + reader thread
+            # (retry loops create one client per attempt)
+            self.close()
+            raise
         self.connected = True
         return self.server_caps
 
@@ -64,12 +81,14 @@ class QueryClient:
                 elif msg_type is MsgType.DATA:
                     self.responses.put(unpack_tensors(payload))
                 elif msg_type is MsgType.EOS:
+                    self._clean_eos = True
                     self.responses.put(None)
         except (ConnectionError, OSError) as e:
             logger.info("tensor-query connection closed: %s", e)
         finally:
             self.connected = False
-            self.responses.put(None)  # unblock any waiter
+            # unblock any waiter: None = clean end, DISCONNECTED = link died
+            self.responses.put(None if self._clean_eos else DISCONNECTED)
 
     def send(self, buf: Buffer) -> None:
         if self._sock is None:

@@ -16,14 +16,19 @@ import queue as _queue
 import threading
 from typing import Optional
 
-from ..core import Buffer, Caps, Event, EventType, parse_caps_string
+from ..core import Buffer, Caps, Event, EventType, clock_now, parse_caps_string
 from ..registry.elements import register_element
 from ..runtime.element import Element, ElementError, Prop, SinkElement, SourceElement, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 from ..utils.log import logger
-from .client import QueryClient
+from .client import DISCONNECTED, QueryClient
 from .edge import PubSubBroker, get_broker, release_broker
-from .server import QueryServer, get_shared_server, release_shared_server
+from .server import (
+    QueryServer,
+    get_shared_server,
+    lookup_shared_server,
+    release_shared_server,
+)
 
 _TENSOR_CAPS = Caps.new("other/tensors")
 
@@ -40,7 +45,18 @@ class TensorQueryClient(Element):
     PROPERTIES = {
         "host": Prop("127.0.0.1", str, "server host (reference dest-host)"),
         "port": Prop(0, int, "server port (reference dest-port)"),
-        "timeout": Prop(10.0, float, "connect/handshake timeout seconds"),
+        "timeout": Prop(10.0, float,
+                        "connect/handshake timeout seconds (reference "
+                        "QUERY_DEFAULT_TIMEOUT_SEC, tensor_query_common.h:28)"),
+        "reconnect": Prop(True, prop_bool,
+                          "on connection loss, retry with backoff instead of "
+                          "ending the stream (reference CONNECTION_CLOSED "
+                          "handling, tensor_query_client.c:421-480)"),
+        "reconnect_window": Prop(30.0, float,
+                                 "give up and end the stream after this many "
+                                 "seconds without a successful reconnect"),
+        "max_reconnect_delay": Prop(2.0, float,
+                                    "backoff cap between reconnect attempts"),
     }
 
     def __init__(self, name=None, **props):
@@ -48,10 +64,18 @@ class TensorQueryClient(Element):
         self.client: Optional[QueryClient] = None
         self._puller: Optional[threading.Thread] = None
         self._running = threading.Event()
+        self._stopping = threading.Event()  # interrupts reconnect backoff
+        self._in_caps: Optional[Caps] = None
+        self._got_input_eos = False
+        self._reconnect_error: Optional[str] = None
+
+    def _new_client(self) -> QueryClient:
+        return QueryClient(self.props["host"], self.props["port"],
+                           self.props["timeout"])
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
-        self.client = QueryClient(self.props["host"], self.props["port"],
-                                  self.props["timeout"])
+        self._in_caps = caps
+        self.client = self._new_client()
         self._server_caps = self.client.connect(caps)
         self._running.set()
         self._puller = threading.Thread(target=self._pull_loop,
@@ -62,12 +86,64 @@ class TensorQueryClient(Element):
         return self._server_caps
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
-        self.client.send(buf)
+        try:
+            self.client.send(buf)
+        except (ConnectionError, OSError):
+            # link is down; drop the frame and keep the stream alive while
+            # the pull loop reconnects in the background (streaming QoS:
+            # same frame-drop semantics as the reference under throttle)
+            logger.warning("%s: frame dropped while disconnected", self.name)
 
     def handle_eos(self) -> None:
+        self._got_input_eos = True
         if self.client is not None:
             self.client.send_eos()
         # EOS forwarded downstream when the response stream drains (pull loop)
+
+    def _reconnect(self) -> bool:
+        """Retry with exponential backoff until success, the reconnect
+        window closes, the server comes back with different caps, or the
+        element stops. Returns True on success; on failure the reason is
+        in ``self._reconnect_error`` (None for a clean stop)."""
+        self._reconnect_error: Optional[str] = None
+        deadline = clock_now() + self.props["reconnect_window"]
+        delay = 0.2
+        while self._running.is_set() and clock_now() < deadline:
+            try:
+                client = self._new_client()
+                new_caps = client.connect(self._in_caps)
+                if not new_caps.can_intersect(self._server_caps):
+                    # downstream already negotiated the old caps; pushing an
+                    # incompatible format would corrupt far from the cause.
+                    # (Intersection, not string equality: the advertised
+                    # string legitimately varies with server-side
+                    # negotiation timing, e.g. num_tensors appearing.)
+                    client.close()
+                    self._reconnect_error = (
+                        f"server at {self.props['host']}:{self.props['port']} "
+                        f"came back with different caps ({new_caps} != "
+                        f"{self._server_caps}); restart the pipeline")
+                    return False
+                self.client = client
+                logger.info("%s: reconnected to %s:%s", self.name,
+                            self.props["host"], self.props["port"])
+                if self._got_input_eos:
+                    # upstream EOS fired while the link was down; the dead
+                    # socket swallowed it — re-send so the new server drains
+                    self.client.send_eos()
+                return True
+            except (ConnectionError, OSError, TimeoutError) as e:
+                logger.info("%s: reconnect failed (%s); retrying in %.1fs",
+                            self.name, e, delay)
+            time_left = deadline - clock_now()
+            self._stopping.wait(min(delay, max(time_left, 0)))
+            delay = min(delay * 2, self.props["max_reconnect_delay"])
+        if self._running.is_set():
+            self._reconnect_error = (
+                f"connection to {self.props['host']}:{self.props['port']} "
+                f"lost and not re-established within "
+                f"{self.props['reconnect_window']}s")
+        return False
 
     def _pull_loop(self) -> None:
         while self._running.is_set():
@@ -75,18 +151,34 @@ class TensorQueryClient(Element):
                 buf = self.client.responses.get(timeout=0.1)
             except _queue.Empty:
                 continue
-            if buf is None:
+            if buf is None:  # clean server EOS
+                self.send_eos()
+                return
+            if buf is DISCONNECTED:
+                if not self._running.is_set() or not self.props["reconnect"]:
+                    self.send_eos()
+                    return
+                if self._reconnect():
+                    continue
+                if self._reconnect_error:  # None = clean stop, no error
+                    self.post_error(self._reconnect_error)
                 self.send_eos()
                 return
             self.srcpad.push(buf)
 
     def stop(self) -> None:
         self._running.clear()
+        self._stopping.set()
         if self.client is not None:
             self.client.close()
         if self._puller is not None and self._puller is not threading.current_thread():
             self._puller.join(timeout=2.0)
             self._puller = None
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._stopping.clear()
+        self._got_input_eos = False
 
 
 @register_element
@@ -152,23 +244,23 @@ class TensorQueryServerSink(SinkElement):
         super().__init__(name, **props)
         self.server: Optional[QueryServer] = None
 
-    def start(self) -> None:
+    def _server(self) -> QueryServer:
+        # lazy lookup of the server the paired serversrc created — never
+        # create here: the sink doesn't know the host/port (creating first
+        # would pin an ephemeral port and void the src's port= property)
         if self.server is None:
-            self.server = get_shared_server(self.props["id"])
-        super().start()
+            self.server = lookup_shared_server(self.props["id"])
+        return self.server
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
-        if self.server is None:
-            self.server = get_shared_server(self.props["id"])
-        self.server.caps = caps  # advertised to clients in the handshake
+        self._server().caps = caps  # advertised to clients in the handshake
 
     def render(self, buf: Buffer) -> None:
         client_id = buf.meta.get("client_id")
         if client_id is None:
             logger.warning("%s: answer without client_id meta dropped", self.name)
             return
-        if self.server is not None:
-            self.server.send(client_id, buf)
+        self._server().send(client_id, buf)
 
     def stop(self) -> None:
         super().stop()

@@ -11,6 +11,7 @@ from __future__ import annotations
 import queue as _queue
 import socket
 import threading
+import time
 from typing import Callable, Dict, Optional
 
 from ..core import Buffer, Caps, parse_caps_string
@@ -165,6 +166,27 @@ def get_shared_server(server_id: int, host: str = "127.0.0.1",
             _server_refs[server_id] = 0
         _server_refs[server_id] += 1
         return srv
+
+
+def lookup_shared_server(server_id: int, timeout: float = 5.0) -> QueryServer:
+    """Acquire the EXISTING server for ``server_id``, waiting for its
+    creator (tensor_query_serversrc) to register it. The serversink must
+    never create the server itself: it doesn't know the host/port, and a
+    sink-first start would pin the listener to an ephemeral port while the
+    src's port= property gets silently ignored (reference: serversink looks
+    up the handle serversrc created, tensor_query_server.c:76-117)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        with _servers_lock:
+            srv = _servers.get(server_id)
+            if srv is not None:
+                _server_refs[server_id] += 1
+                return srv
+        if time.monotonic() >= deadline:
+            raise KeyError(
+                f"no tensor-query server with id {server_id} — is a "
+                "tensor_query_serversrc with the same id running?")
+        time.sleep(0.02)
 
 
 def release_shared_server(server_id: int) -> None:
