@@ -20,3 +20,47 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# Per-test watchdog: one hung test must not stall the whole suite (the
+# reference uses meson test timeouts; pytest-timeout is not in this image,
+# so a SIGALRM in the main thread fails the test with a TimeoutError and a
+# stack trace). Override per test with @pytest.mark.timeout_s(N) or
+# globally with NNS_TEST_TIMEOUT (0 disables).
+import signal
+import threading
+
+import pytest
+
+_DEFAULT_TEST_TIMEOUT = float(os.environ.get("NNS_TEST_TIMEOUT", "180"))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout_s(n): per-test watchdog seconds (default 180)")
+
+
+@pytest.fixture(autouse=True)
+def _test_watchdog(request):
+    marker = request.node.get_closest_marker("timeout_s")
+    limit = float(marker.args[0]) if marker else _DEFAULT_TEST_TIMEOUT
+    use_alarm = (
+        limit > 0
+        and hasattr(signal, "SIGALRM")
+        and threading.current_thread() is threading.main_thread()
+    )
+    if not use_alarm:
+        yield
+        return
+
+    def _on_timeout(signum, frame):
+        raise TimeoutError(
+            f"test exceeded {limit:.0f}s watchdog (NNS_TEST_TIMEOUT)")
+
+    old = signal.signal(signal.SIGALRM, _on_timeout)
+    signal.setitimer(signal.ITIMER_REAL, limit)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)

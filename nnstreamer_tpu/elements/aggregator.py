@@ -81,7 +81,20 @@ class TensorAggregator(TransformElement):
         fo = self.props["frames_out"]
         flush = self.props["frames_flush"] or fo
         dim = self.props["frames_dim"]
-        arrays = [np.asarray(t) for t in buf.as_numpy().tensors]
+        # device residency: jax arrays stay on device (slice/concat are
+        # jitted device ops), so filter→aggregator chains never bounce
+        # through host; plain numpy input stays numpy (host batching path)
+        from ..core.buffer import _is_device_array
+
+        if buf.on_device:
+            import jax.numpy as jnp
+
+            xp = jnp
+            arrays = [t if _is_device_array(t) else jnp.asarray(t)
+                      for t in buf.tensors]
+        else:
+            xp = np
+            arrays = [np.asarray(t) for t in buf.as_numpy().tensors]
         # split the incoming buffer into per-frame slices along frames-dim
         frames = []
         for f in range(fi):
@@ -93,12 +106,12 @@ class TensorAggregator(TransformElement):
             chunk = self._window[:fo]
             if self.props["concat"]:
                 tensors = [
-                    np.concatenate([c[i] for c in chunk], axis=dim)
+                    xp.concatenate([c[i] for c in chunk], axis=dim)
                     for i in range(len(arrays))
                 ]
             else:
                 tensors = [
-                    np.stack([c[i] for c in chunk], axis=0)
+                    xp.stack([c[i] for c in chunk], axis=0)
                     for i in range(len(arrays))
                 ]
             out = Buffer(tensors).copy_metadata_from(buf)
@@ -107,7 +120,7 @@ class TensorAggregator(TransformElement):
         return None  # pushes happen inline above
 
     @staticmethod
-    def _slice_frame(a: np.ndarray, idx: int, total: int, dim: int) -> np.ndarray:
+    def _slice_frame(a, idx: int, total: int, dim: int):
         size = a.shape[dim] // total
         sl = [slice(None)] * a.ndim
         sl[dim] = slice(idx * size, (idx + 1) * size)

@@ -44,7 +44,7 @@ from ..registry.subplugin import SubpluginKind, names as subplugin_names
 from ..runtime.element import ElementError, Prop, TransformElement, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 from ..utils.log import logger
-from ..utils.stats import InvokeStats, Timer
+from ..utils.stats import InvokeStats
 
 
 def _parse_combination(v) -> Optional[List[int]]:
@@ -91,6 +91,10 @@ class TensorFilter(TransformElement):
         "throttle": Prop(True, prop_bool, "honor QoS throttle events from tensor_rate"),
         "sync_invoke": Prop(False, prop_bool,
                             "block until device results are ready (debug/bench)"),
+        "latency_sampling": Prop(10, int,
+                                 "block on every Nth invoke to sample true "
+                                 "device latency (0 = never); dispatch time "
+                                 "is recorded every invoke"),
     }
 
     def __init__(self, name=None, **props):
@@ -210,13 +214,28 @@ class TensorFilter(TransformElement):
         # 1. input combination
         sel = self.props["input_combination"]
         model_inputs = self._select(buf.tensors, sel) if sel else buf.tensors
-        # 2-3. invoke (timed)
-        with Timer(self.stats):
-            outputs = self.backend.invoke(model_inputs)
-            if self.props["sync_invoke"]:
-                for o in outputs:
-                    if hasattr(o, "block_until_ready"):
-                        o.block_until_ready()
+        # 2-3. invoke (timed). Dispatch time is recorded every frame; true
+        # device latency (the reference's synchronous invoke number,
+        # tensor_filter.c:366-510) is sampled every Nth frame by blocking,
+        # so latency_report stays honest without serializing the stream.
+        sampling = self.props["latency_sampling"]
+        # skip the very first invoke (includes XLA compile) so one giant
+        # outlier doesn't own the 10-sample window
+        sample_device = self.props["sync_invoke"] or (
+            sampling > 0
+            and self.stats.total_invokes > 0
+            and self.stats.total_invokes % sampling == 0
+        )
+        t0 = clock_now()
+        outputs = self.backend.invoke(model_inputs)
+        # dispatch channel gets ONLY the host-side call time, even on
+        # sampled frames — blocking time goes to the device channel
+        self.stats.record(clock_now() - t0)
+        if sample_device:
+            for o in outputs:
+                if hasattr(o, "block_until_ready"):
+                    o.block_until_ready()
+            self.stats.record_device(clock_now() - t0)
         # 5. output combination: i<N> passthrough of inputs, o<N>/int = outputs
         out_comb = self.props["output_combination"]
         if out_comb is not None:

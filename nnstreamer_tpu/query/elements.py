@@ -112,6 +112,11 @@ class TensorQueryClient(Element):
             try:
                 client = self._new_client()
                 new_caps = client.connect(self._in_caps)
+                if not self._running.is_set():
+                    # stop() raced the connect: don't leak the fresh
+                    # socket + reader thread past pipeline shutdown
+                    client.close()
+                    return False
                 if not new_caps.can_intersect(self._server_caps):
                     # downstream already negotiated the old caps; pushing an
                     # incompatible format would corrupt far from the cause.
@@ -124,7 +129,9 @@ class TensorQueryClient(Element):
                         f"came back with different caps ({new_caps} != "
                         f"{self._server_caps}); restart the pipeline")
                     return False
-                self.client = client
+                old, self.client = self.client, client
+                if old is not None:
+                    old.close()  # release the dead link's fd + reader
                 logger.info("%s: reconnected to %s:%s", self.name,
                             self.props["host"], self.props["port"])
                 if self._got_input_eos:
@@ -174,6 +181,10 @@ class TensorQueryClient(Element):
         if self._puller is not None and self._puller is not threading.current_thread():
             self._puller.join(timeout=2.0)
             self._puller = None
+        if self.client is not None:
+            # the puller may have installed a fresh client between the close
+            # above and the join; close whatever is current (idempotent)
+            self.client.close()
 
     def reset_flow(self) -> None:
         super().reset_flow()

@@ -18,8 +18,19 @@ STAT_WINDOW = 10  # reference GST_TF_STAT_MAX_RECENT
 
 
 class InvokeStats:
+    """Two latency channels with distinct semantics on an async device:
+
+    * ``record`` — DISPATCH time (host-side call, returns before the device
+      finishes under async execution). Cheap, measured every invoke.
+    * ``record_device`` — DEVICE time (dispatch + block_until_ready). This
+      is the number comparable to the reference's synchronous invoke
+      latency (tensor_filter.c:366-510); sampled, since blocking every
+      frame would serialize the pipeline.
+    """
+
     def __init__(self, window: int = STAT_WINDOW):
         self._recent: Deque[float] = deque(maxlen=window)
+        self._recent_device: Deque[float] = deque(maxlen=window)
         self._lock = threading.Lock()
         self.total_invokes = 0
         self.total_latency_s = 0.0
@@ -35,6 +46,19 @@ class InvokeStats:
             if self._first_ts is None:
                 self._first_ts = now
             self._last_ts = now
+
+    def record_device(self, latency_s: float) -> None:
+        with self._lock:
+            self._recent_device.append(latency_s)
+
+    @property
+    def recent_device_latency_s(self) -> float:
+        """Sliding-window average of sampled device-complete latencies
+        (0.0 until the first sample)."""
+        with self._lock:
+            if not self._recent_device:
+                return 0.0
+            return sum(self._recent_device) / len(self._recent_device)
 
     @property
     def recent_latency_s(self) -> float:
@@ -65,22 +89,27 @@ class InvokeStats:
     def snapshot(self) -> dict:
         return {
             "total_invokes": self.total_invokes,
-            "avg_latency_ms": self.avg_latency_s * 1e3,
-            "recent_latency_ms": self.recent_latency_s * 1e3,
+            "avg_dispatch_latency_ms": self.avg_latency_s * 1e3,
+            "recent_dispatch_latency_ms": self.recent_latency_s * 1e3,
+            # reference-comparable number (synchronous invoke semantics)
+            "recent_device_latency_ms": self.recent_device_latency_s * 1e3,
             "throughput_fps": self.throughput_fps,
         }
 
 
 class Timer:
-    """Context manager recording wall time into an InvokeStats."""
+    """Context manager recording wall time into an InvokeStats; the
+    elapsed time stays readable afterwards (``elapsed_s``)."""
 
     def __init__(self, stats: InvokeStats):
         self.stats = stats
+        self.elapsed_s = 0.0
 
     def __enter__(self):
         self._t0 = time.monotonic()
         return self
 
     def __exit__(self, *exc):
-        self.stats.record(time.monotonic() - self._t0)
+        self.elapsed_s = time.monotonic() - self._t0
+        self.stats.record(self.elapsed_s)
         return False

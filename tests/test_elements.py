@@ -92,6 +92,25 @@ class TestConverter:
 
 
 class TestAggregator:
+    def test_device_arrays_stay_on_device(self):
+        """filter→aggregator chains must not bounce through host: jax-array
+        inputs produce jax-array outputs (VERDICT r1 #10)."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.core import Buffer
+        from nnstreamer_tpu.elements.aggregator import TensorAggregator
+
+        agg = TensorAggregator(frames_out=3, concat=False)
+        outs = []
+        agg.srcpad.push = lambda b: outs.append(b)  # capture without a pad
+        for i in range(3):
+            agg.transform(Buffer([jnp.full((4,), i, jnp.float32)]))
+        assert len(outs) == 1
+        t = outs[0].tensors[0]
+        assert hasattr(t, "addressable_shards"), "output left the device"
+        assert t.shape == (3, 4)
+        assert np.allclose(np.asarray(t)[:, 0], [0, 1, 2])
+
     def test_stack_batch(self):
         bufs = run_collect(
             "tensor_src num-buffers=6 dimensions=4 types=float32 pattern=counter "

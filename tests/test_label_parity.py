@@ -60,6 +60,7 @@ def _labels_through(framework, model, frames):
     return got
 
 
+@pytest.mark.slow
 def test_label_parity_jax_vs_tflite(exported, _entry_module, tmp_path):
     fwd, tflite_path = exported
     rng = np.random.default_rng(7)

@@ -39,6 +39,7 @@ class TestSSD:
         assert a.shape == (3 * (8 * 8 + 4 * 4 + 2 * 2 + 1), 4)
         assert np.all(a[:, :2] >= 0) and np.all(a[:, :2] <= 1)
 
+    @pytest.mark.slow
     def test_device_decode_matches_host_decode(self):
         """On-device box decode (apply_fn) == host decode_boxes_np over the
         raw head — the two decoder paths must agree."""
@@ -172,6 +173,7 @@ class TestPoseNet:
         np.testing.assert_allclose(kps_dev[:, 1], ys / (hh - 1), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_bf16_compute_label_stable():
     """The TPU path's bfloat16 compute must yield the same labels as the
     float32 build with identical weights (the bf16↔f32 leg of parity)."""
