@@ -49,6 +49,7 @@ class TensorAggregator(TransformElement):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._window: List[np.ndarray] = []  # accumulated per-tensor windows
+        self._window_device = False  # latches on first device-resident frame
         self._out_info: Optional[TensorsInfo] = None
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
@@ -83,10 +84,15 @@ class TensorAggregator(TransformElement):
         dim = self.props["frames_dim"]
         # device residency: jax arrays stay on device (slice/concat are
         # jitted device ops), so filter→aggregator chains never bounce
-        # through host; plain numpy input stays numpy (host batching path)
+        # through host; plain numpy input stays numpy (host batching path).
+        # Once any device frame is in the window, the stream stays device-
+        # resident (a stray host frame must not drag buffered device frames
+        # back through a blocking D2H).
         from ..core.buffer import _is_device_array
 
         if buf.on_device:
+            self._window_device = True
+        if self._window_device:
             import jax.numpy as jnp
 
             xp = jnp
@@ -129,6 +135,7 @@ class TensorAggregator(TransformElement):
     def reset_flow(self) -> None:
         super().reset_flow()
         self._window = []
+        self._window_device = False
 
     def handle_eos(self) -> None:
         self._window = []

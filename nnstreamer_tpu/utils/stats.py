@@ -98,18 +98,15 @@ class InvokeStats:
 
 
 class Timer:
-    """Context manager recording wall time into an InvokeStats; the
-    elapsed time stays readable afterwards (``elapsed_s``)."""
+    """Context manager recording wall time into an InvokeStats."""
 
     def __init__(self, stats: InvokeStats):
         self.stats = stats
-        self.elapsed_s = 0.0
 
     def __enter__(self):
         self._t0 = time.monotonic()
         return self
 
     def __exit__(self, *exc):
-        self.elapsed_s = time.monotonic() - self._t0
-        self.stats.record(self.elapsed_s)
+        self.stats.record(time.monotonic() - self._t0)
         return False

@@ -1,0 +1,102 @@
+"""Golden corpus generator: deterministic inputs → decoder output bytes.
+
+Reference analog: the SSAT golden suites (tests/nnstreamer_decoder_*/
+runTest.sh writing multifilesink outputs and byte-comparing with
+``callCompareTest``). Run ``python tests/golden/generate.py`` ONLY when a
+decoder's output is intentionally changed; the checked-in ``*.bin`` files
+are the contract, and test_golden.py byte-compares against them.
+
+Each case is (name, decoder mode, options, input arrays). The golden file
+holds the concatenated raw bytes of every output tensor.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", ".."))
+
+
+def _rng():
+    return np.random.default_rng(20260730)
+
+
+def cases():
+    rng = _rng()
+    boxes = np.array(
+        [[0.10, 0.10, 0.45, 0.50], [0.55, 0.55, 0.90, 0.95],
+         [0.12, 0.11, 0.47, 0.52]], np.float32)
+    scores = np.array([0.9, 0.8, 0.85], np.float32)
+
+    yolo = np.zeros((6, 8), np.float32)  # (4+C rows, N cols) coords-first
+    yolo[:4, 0] = [0.3, 0.3, 0.2, 0.2]
+    yolo[4, 0] = 0.9
+    yolo[:4, 3] = [0.7, 0.7, 0.25, 0.3]
+    yolo[5, 3] = 0.8
+
+    ov = np.zeros((8, 7), np.float32)
+    ov[0] = [0, 1, 0.95, 0.1, 0.2, 0.5, 0.6]
+    ov[1] = [0, 1, 0.85, 0.6, 0.6, 0.9, 0.9]
+    ov[2, 0] = -1
+
+    seg = rng.random((16, 16, 4)).astype(np.float32)
+    heat = rng.random((8, 8, 5)).astype(np.float32)
+    vid = rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
+    vec = rng.random((2, 3)).astype(np.float32)
+    ints = rng.integers(-50, 50, (4,)).astype(np.int32)
+
+    return [
+        ("labeling", "image_labeling", [os.path.join(HERE, "labels.txt")],
+         [np.array([0.1, 0.9, 0.3, 0.2], np.float32)]),
+        ("direct_video", "direct_video", [], [vid]),
+        ("bbox_ssd_pp", "bounding_boxes",
+         ["mobilenet-ssd-postprocess", "64:64"], [boxes, scores]),
+        ("bbox_yolov8", "bounding_boxes",
+         ["yolov8", "64:64", None, "0.3", "0.5", "coords-first"], [yolo]),
+        ("bbox_ov_person", "bounding_boxes",
+         ["ov-person-detection", "64:64"], [ov]),
+        ("segment", "image_segment", [], [seg]),
+        ("pose", "pose_estimation", ["64:64", "8:8"], [heat]),
+        ("font", "font", ["64:32"], [np.frombuffer(b"NNS", np.uint8)]),
+        ("octet", "octet_stream", [], [ints]),
+        ("wire_protobuf", "protobuf", [], [vec, ints]),
+        ("wire_flatbuf", "flatbuf", [], [vec, ints]),
+        ("wire_flexbuf", "flexbuf", [], [vec, ints]),
+    ]
+
+
+def decode_case(mode, options, arrays):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from nnstreamer_tpu.core import Buffer, TensorsInfo
+    from nnstreamer_tpu.core.tensors import DataType, TensorSpec
+    from nnstreamer_tpu.registry.subplugin import SubpluginKind, get as get_subplugin
+    import nnstreamer_tpu.decoders  # noqa: F401 - registers modes
+
+    cls = get_subplugin(SubpluginKind.DECODER, mode)
+    dec = cls() if isinstance(cls, type) else cls
+    dec.init(list(options) + [None] * (9 - len(options)))
+    info = TensorsInfo.of(*(
+        TensorSpec(a.shape, DataType.from_any(a.dtype)) for a in arrays))
+    out = dec.decode(Buffer([np.asarray(a) for a in arrays]), info)
+    return b"".join(np.ascontiguousarray(np.asarray(t)).tobytes()
+                    for t in out.tensors)
+
+
+def main():
+    with open(os.path.join(HERE, "labels.txt"), "w") as fh:
+        fh.write("zero\none\ntwo\nthree\n")
+    for name, mode, options, arrays in cases():
+        blob = decode_case(mode, options, arrays)
+        path = os.path.join(HERE, f"{name}.bin")
+        with open(path, "wb") as fh:
+            fh.write(blob)
+        print(f"{name}: {len(blob)} bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Byte-exact golden tests for decoder outputs (VERDICT r1 #6).
+
+Reference analog: the SSAT suites' ``callCompareTest`` byte comparisons
+(tests/nnstreamer_decoder_image_labeling/runTest.sh, _boundingbox/, _pose/,
+_image_segment/). The checked-in ``tests/golden/*.bin`` files are the
+contract; any unintentional change to a decoder's output bytes fails here.
+Regenerate deliberately with ``python tests/golden/generate.py``.
+"""
+import os
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLDEN = os.path.join(HERE, "golden")
+sys.path.insert(0, GOLDEN)
+
+from generate import cases, decode_case  # noqa: E402
+
+
+@pytest.mark.parametrize(
+    "name,mode,options,arrays", cases(), ids=[c[0] for c in cases()])
+def test_decoder_bytes_match_golden(name, mode, options, arrays):
+    path = os.path.join(GOLDEN, f"{name}.bin")
+    assert os.path.exists(path), (
+        f"golden {name}.bin missing — run python tests/golden/generate.py")
+    blob = decode_case(mode, options, arrays)
+    with open(path, "rb") as fh:
+        want = fh.read()
+    assert blob == want, (
+        f"{name}: decoder output changed ({len(blob)} vs {len(want)} bytes); "
+        "if intentional, regenerate goldens")
