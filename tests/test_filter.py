@@ -142,6 +142,100 @@ class TestJaxBackendPipelines:
         assert np.allclose(np.asarray(b2.tensors[0]), 10.0)
 
 
+class TestPropertyBreadth:
+    """Reference tensor_filter_common.c property parity additions."""
+
+    def test_invoke_dynamic_flexible_caps(self):
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        pipe = parse_launch(
+            "tensor_src num-buffers=2 dimensions=4 types=float32 "
+            "! tensor_filter framework=jax model=builtin://argmax "
+            "invoke-dynamic=true name=f ! tensor_sink name=out")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.run(timeout=30)
+        caps = pipe.get("out").sinkpad.caps
+        assert "flexible" in str(caps)
+        assert len(got) == 2
+
+    def test_suspend_unloads_and_resumes(self):
+        import time as _time
+
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=4,types=float32 "
+            "! tensor_filter framework=jax model=builtin://scaler?factor=2 "
+            "suspend=120 name=f ! tensor_sink name=out")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        f = pipe.get("f")
+        src = pipe.get("in")
+        src.push_buffer(np.ones(4, np.float32))
+        deadline = _time.monotonic() + 5
+        while not got and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert got
+        # idle past the suspend window: framework unloads
+        deadline = _time.monotonic() + 5
+        while f.backend is not None and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert f.backend is None, "framework not suspended while idle"
+        # next buffer transparently reopens
+        src.push_buffer(np.full(4, 3.0, np.float32))
+        deadline = _time.monotonic() + 5
+        while len(got) < 2 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert len(got) == 2
+        assert np.allclose(np.asarray(got[1].tensors[0]), 6.0)
+        src.end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+
+    def test_forced_output_dims(self):
+        """output-dims/types declare model info for opaque backends."""
+        from nnstreamer_tpu.core import TensorsInfo
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        f = TensorFilter(framework="custom-easy", model="noop",
+                         output_dims="4", output_types="float32")
+        forced = f._forced_info(f.props["output_dims"], f.props["output_types"])
+        assert isinstance(forced, TensorsInfo)
+        assert forced.specs[0].shape == (4,)
+
+    def test_config_file_merges_custom(self, tmp_path):
+        from nnstreamer_tpu.elements.filter import TensorFilter
+
+        cfg = tmp_path / "f.conf"
+        cfg.write_text("# comment\nfactor:5\n")
+        f = TensorFilter(framework="jax", model="builtin://scaler",
+                         custom="device:0", config_file=str(cfg))
+        assert f._custom_with_config_file() == "device:0,factor:5"
+
+    def test_is_updatable_false_refuses_reload(self):
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.runtime.element import ElementError
+
+        f = TensorFilter(framework="jax", model="builtin://scaler",
+                         is_updatable=False)
+        with pytest.raises(ElementError):
+            f.reload_model("builtin://add")
+
+    def test_readonly_latency_throughput_props(self):
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        pipe = parse_launch(
+            "tensor_src num-buffers=8 dimensions=4 types=float32 "
+            "! tensor_filter framework=jax model=builtin://scaler?factor=2 "
+            "sync-invoke=true name=f ! tensor_sink name=out")
+        pipe.run(timeout=30)
+        f = pipe.get("f")
+        assert f.get_property("latency") > 0
+        assert f.get_property("throughput") > 0
+
+
 class TestInvokeStats:
     def test_device_latency_sampled_separately(self):
         """Dispatch time is recorded per invoke; true device-complete
