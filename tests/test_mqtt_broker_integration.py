@@ -1,0 +1,85 @@
+"""MQTT integration against a REAL broker (mosquitto), skipped when no
+broker binary is installed — the reference's tests/check_broker.sh
+pattern. Protocol conformance of our own MQTT 3.1.1 client is asserted
+elsewhere (test_mqtt_iio.py uses the in-process MiniBroker); this file
+proves wire interop with an independent implementation.
+"""
+import shutil
+import socket
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+MOSQUITTO = shutil.which("mosquitto")
+
+pytestmark = pytest.mark.skipif(
+    MOSQUITTO is None, reason="mosquitto broker not installed")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def broker(tmp_path):
+    port = _free_port()
+    conf = tmp_path / "mosquitto.conf"
+    conf.write_text(f"listener {port} 127.0.0.1\nallow_anonymous true\n")
+    proc = subprocess.Popen(
+        [MOSQUITTO, "-c", str(conf)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    # wait for the listener
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        proc.terminate()
+        proc.wait(timeout=5)
+        pytest.skip("mosquitto did not start")
+    yield port
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=5)
+
+
+class TestRealBroker:
+    def test_pub_sub_roundtrip(self, broker):
+        """mqttsink → mosquitto → mqttsrc: frames and caps survive an
+        independent broker implementation."""
+        port = broker
+        sub = parse_launch(
+            f"mqttsrc host=127.0.0.1 port={port} sub-topic=nns/t0 "
+            "num-buffers=3 timeout=15 ! tensor_sink name=out")
+        got = []
+        sub.get("out").connect(got.append)
+
+        pub = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=4,types=float32 "
+            f"! mqttsink host=127.0.0.1 port={port} pub-topic=nns/t0 broker=external")
+        pub.play()
+        sub.play()
+        src = pub.get("in")
+        deadline = time.monotonic() + 15
+        i = 0
+        while len(got) < 3 and time.monotonic() < deadline:
+            src.push_buffer(np.full(4, float(i), np.float32))
+            i += 1
+            time.sleep(0.05)
+        sub.stop()
+        pub.stop()
+        assert len(got) >= 3, f"only {len(got)} frames through mosquitto"
+        a = np.asarray(got[0].tensors[0])
+        assert a.dtype == np.float32 and a.shape == (4,)

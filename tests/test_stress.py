@@ -1,0 +1,148 @@
+"""Concurrency stress + determinism tests (SURVEY §5.2).
+
+The reference leans on GLib primitives and documents its threading bugs
+per release (CHANGES:44-46); we do better: these tests hammer the
+runtime's thread boundaries (queues, mux sync, shared backends, repo
+feedback loops) and assert deterministic, loss-free behavior.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+N_FRAMES = 200
+
+
+def _collect(pipe, name="out", timeout=30.0):
+    got = []
+    pipe.get(name).connect(got.append)
+    pipe.run(timeout=timeout)
+    return got
+
+
+class TestQueueStress:
+    def test_no_loss_no_reorder_through_queue_chain(self):
+        """Blocking bounded queues must deliver every frame in order even
+        when producer and consumer run at different speeds."""
+        got = _collect(parse_launch(
+            f"tensor_src num-buffers={N_FRAMES} dimensions=1 types=float32 "
+            "pattern=counter "
+            "! queue max-size-buffers=2 ! queue max-size-buffers=7 "
+            "! queue max-size-buffers=3 ! tensor_sink name=out max-stored=0"))
+        assert len(got) == N_FRAMES
+        vals = [float(np.asarray(b.tensors[0])[0]) for b in got]
+        assert vals == sorted(vals), "reordering through queue chain"
+        assert vals[0] != vals[-1]
+
+    def test_tee_branches_each_see_every_frame(self):
+        got_a, got_b = [], []
+        pipe = parse_launch(
+            f"tensor_src num-buffers={N_FRAMES} dimensions=1 types=float32 "
+            "pattern=counter ! tee name=t "
+            "t. ! queue ! tensor_sink name=a max-stored=0 "
+            "t. ! queue ! tensor_sink name=b max-stored=0")
+        pipe.get("a").connect(got_a.append)
+        pipe.get("b").connect(got_b.append)
+        pipe.run(timeout=30)
+        assert len(got_a) == N_FRAMES and len(got_b) == N_FRAMES
+
+
+class TestSharedBackendStress:
+    def test_concurrent_invokes_one_backend(self):
+        """REENTRANT jitted executables under many threads: results must
+        be correct for every caller (shared-model table semantics)."""
+        from nnstreamer_tpu.single import SingleShot
+
+        with SingleShot("jax", "builtin://scaler?factor=3",
+                        share_key="stress") as warm:
+            warm.invoke(np.zeros((4,), np.float32))  # compile once
+            errors = []
+
+            def worker(tid):
+                try:
+                    with SingleShot("jax", "builtin://scaler?factor=3",
+                                    share_key="stress") as s:
+                        for i in range(50):
+                            x = np.full(4, tid * 100 + i, np.float32)
+                            (out,) = s.invoke(x)
+                            if not np.allclose(np.asarray(out), x * 3):
+                                errors.append((tid, i))
+                                return
+                except Exception as e:  # noqa: BLE001
+                    errors.append((tid, repr(e)))
+
+            threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+                       for t in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            hung = [t.name for t in threads if t.is_alive()]
+            assert not hung, f"workers deadlocked: {hung}"
+            assert not errors, errors[:3]
+
+
+class TestRepoLoopStress:
+    def test_feedback_loop_many_iterations(self):
+        """reposink/reposrc feedback (RNN-style loop) stays consistent
+        over many cycles: each pass adds 1 to the value, seeded once."""
+        from nnstreamer_tpu.elements.repo import REPO
+
+        REPO.reset()
+        pipe = parse_launch(
+            "tensor_repo_src slot-index=7 "
+            "caps=other/tensors,format=static,dimensions=1,types=float32 "
+            "! tensor_filter framework=jax model=builtin://add?value=1 "
+            "! tee name=t "
+            "t. ! queue ! tensor_repo_sink slot-index=7 "
+            "t. ! queue ! tensor_sink name=out max-stored=0")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        REPO.slot(7).push(Buffer([np.zeros(1, np.float32)]))  # seed frame
+        deadline = time.monotonic() + 40
+        while len(got) < 100 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        pipe.stop()
+        assert len(got) >= 100
+        vals = [float(np.asarray(b.tensors[0])[0]) for b in got[:100]]
+        assert vals == [float(i + 1) for i in range(100)]
+
+
+class TestDeterminism:
+    def test_same_pipeline_same_bytes_twice(self):
+        """A seeded pipeline run twice yields byte-identical output —
+        replay determinism (checkpoint/resume relies on this)."""
+        launch = (
+            "tensor_src num-buffers=20 dimensions=3:8 types=float32 "
+            "pattern=random seed=42 "
+            "! tensor_transform mode=arithmetic option=mul:2.5,add:1 "
+            "! tensor_aggregator frames-out=5 concat=false "
+            "! tensor_sink name=out max-stored=0")
+        runs = []
+        for _ in range(2):
+            got = _collect(parse_launch(launch))
+            runs.append(b"".join(
+                np.ascontiguousarray(np.asarray(t)).tobytes()
+                for b in got for t in b.tensors))
+        assert runs[0] == runs[1]
+
+    def test_mux_slowest_sync_deterministic_pairing(self):
+        """Two sources at different speeds through mux sync=slowest: every
+        output frame must hold a consistent (a, b) pair, repeatably."""
+        launch = (
+            "tensor_src num-buffers=30 dimensions=1 types=float32 "
+            "pattern=counter name=sa ! queue ! m.sink_0 "
+            "tensor_src num-buffers=30 dimensions=1 types=float32 "
+            "pattern=counter name=sb ! queue ! m.sink_1 "
+            "tensor_mux name=m sync-mode=slowest ! tensor_sink name=out max-stored=0")
+        for _ in range(2):
+            got = _collect(parse_launch(launch), timeout=30)
+            assert len(got) >= 25
+            for b in got:
+                a, c = (float(np.asarray(t)[0]) for t in b.tensors)
+                assert a == c, f"unpaired frames muxed: {a} vs {c}"

@@ -114,3 +114,30 @@ class TestEnvActivation:
                  "NNS_TRACERS": "proctime;framerate"},
         )
         assert "ENV_OK" in r.stdout, r.stderr
+
+
+class TestHwAccelProbe:
+    """Reference hw_accel.c analog: runtime capability check that cannot
+    hang the calling process (subprocess + timeout)."""
+
+    def test_cpu_always_available(self):
+        from nnstreamer_tpu.utils.hw_accel import accel_available
+
+        assert accel_available("cpu") is True
+
+    def test_bogus_platform_unavailable(self):
+        from nnstreamer_tpu.utils.hw_accel import accel_available
+
+        # False normally; None is legal if a loaded machine blows the
+        # probe timeout — only True would be wrong
+        assert accel_available("nonexistent_accel", timeout_s=60) is not True
+
+    def test_cache_hit_no_subprocess(self):
+        import subprocess as sp
+        from unittest import mock
+
+        from nnstreamer_tpu.utils.hw_accel import accel_available
+
+        primed = accel_available("nonexistent_accel")  # primes the cache
+        with mock.patch.object(sp, "run", side_effect=AssertionError):
+            assert accel_available("nonexistent_accel") is primed
