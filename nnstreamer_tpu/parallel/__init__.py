@@ -5,6 +5,7 @@ TPU-native counterpart of the reference's pipeline-topology parallelism
 via ring attention and Ulysses (`context.py`).
 """
 from .mesh import AXES, factor_devices, make_mesh
+from .multihost import global_mesh, init_multihost, process_info
 from .shard import ShardedRunner
 from .context import (
     make_context_attention,
@@ -17,6 +18,9 @@ __all__ = [
     "factor_devices",
     "make_mesh",
     "ShardedRunner",
+    "global_mesh",
+    "init_multihost",
+    "process_info",
     "make_context_attention",
     "ring_attention",
     "ulysses_attention",

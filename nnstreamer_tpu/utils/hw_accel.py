@@ -77,5 +77,18 @@ def accel_available(platform: str, timeout_s: float = 15.0,
 
 def available_accelerators(timeout_s: float = 15.0) -> Dict[str, Optional[bool]]:
     """Probe the platforms this build cares about (cpu always; tpu/axon
-    for the device path)."""
-    return {p: accel_available(p, timeout_s) for p in ("cpu", "tpu", "axon")}
+    for the device path). Probes run concurrently so the worst case is
+    ~one timeout, not the sum."""
+    platforms = ("cpu", "tpu", "axon")
+    results: Dict[str, Optional[bool]] = {}
+    threads = []
+    for p in platforms:
+        t = threading.Thread(
+            target=lambda name=p: results.__setitem__(
+                name, accel_available(name, timeout_s)),
+            daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout_s + 10)
+    return {p: results.get(p) for p in platforms}

@@ -1,5 +1,6 @@
 """Sharding/parallelism tests on the 8-device virtual CPU mesh (SURVEY.md §4:
 "multi-node without a cluster")."""
+import os
 import numpy as np
 import pytest
 
@@ -98,3 +99,76 @@ class TestGraftEntry:
         fn, args = __graft_entry__.entry()
         out = jax.jit(fn)(*args)
         assert out.shape == (1, 1001)
+
+
+class TestMultihost:
+    """DCN-tier integration (SURVEY §5.8): single-process no-op path in
+    this process, real 2-process jax.distributed bootstrap via loopback
+    subprocesses (the reference's loopback distributed-test approach)."""
+
+    def test_single_process_noop(self, monkeypatch):
+        from nnstreamer_tpu.parallel import global_mesh, init_multihost, process_info
+
+        monkeypatch.delenv("NNS_COORD", raising=False)
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+        assert init_multihost() is False  # nothing to wire up
+        info = process_info()
+        assert info["process_count"] == 1
+        mesh = global_mesh()
+        assert mesh.devices.size == info["global_devices"]
+
+    @pytest.mark.slow
+    def test_two_process_loopback_bootstrap(self, tmp_path):
+        """Two local processes form one jax.distributed runtime; each must
+        see the GLOBAL device count (2) and run a psum over DCN."""
+        import socket
+        import subprocess
+        import sys
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        prog = tmp_path / "worker.py"
+        prog.write_text(
+            "import os, sys\n"
+            f"sys.path.insert(0, {repo_root!r})\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from nnstreamer_tpu.parallel import init_multihost, process_info\n"
+            "ok = init_multihost()\n"
+            "assert ok, 'expected multi-process init'\n"
+            "info = process_info()\n"
+            "assert info['process_count'] == 2, info\n"
+            "assert info['global_devices'] == 2, info\n"
+            "import jax.numpy as jnp\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+            "mesh = Mesh(jax.devices(), ('dp',))\n"
+            "sh = NamedSharding(mesh, P('dp'))\n"
+            "x = jax.device_put(jnp.arange(2, dtype=jnp.float32), sh)\n"
+            "total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)\n"
+            "assert float(total) == 1.0, float(total)\n"
+            "print('proc', info['process_index'], 'devices', info['global_devices'], 'psum ok')\n"
+        )
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            NNS_COORD=f"127.0.0.1:{port}", NNS_NUM_PROCS="2")
+        procs = []
+        try:
+            for pid in range(2):
+                e = dict(env, NNS_PROC_ID=str(pid))
+                procs.append(subprocess.Popen(
+                    [sys.executable, str(prog)], env=e,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+            outs = []
+            for p in procs:
+                out, _ = p.communicate(timeout=120)
+                outs.append(out.decode())
+        finally:
+            for p in procs:  # a worker stuck at the barrier must not orphan
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+        assert all(p.returncode == 0 for p in procs), "\n".join(outs)
+        assert "devices 2" in outs[0]
