@@ -69,6 +69,16 @@ def main() -> None:
         devices = jax.devices()
     platform = devices[0].platform
     _log(f"backend up: {len(devices)} x {platform}")
+    global BATCH, MEASURE_BATCHES
+    if platform == "cpu":
+        # CPU fallback: shrink the workload so a COMPLETE measurement fits
+        # the deadline (a full small number + the recorded tpu_error beats
+        # a partial large-batch one); explicit env requests are honored
+        if "BENCH_BATCH" not in os.environ:
+            BATCH = 16
+        if "BENCH_BATCHES" not in os.environ:
+            MEASURE_BATCHES = min(MEASURE_BATCHES, 10)
+        _log(f"cpu workload: batch={BATCH} batches={MEASURE_BATCHES}")
 
     from nnstreamer_tpu.core import MessageType
     from nnstreamer_tpu.runtime.parse import parse_launch
@@ -207,3 +217,10 @@ def main() -> None:
 
 if __name__ == "__main__":
     main()
+    # the result line is out; skip interpreter/native teardown, which can
+    # abort (observed: the failed axon TPU plugin throws during teardown —
+    # 'FATAL: exception not rethrown' — turning a successful bench into a
+    # nonzero exit)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
