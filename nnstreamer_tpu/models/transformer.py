@@ -30,6 +30,11 @@ class TransformerConfig:
     # collectives), "ring" (ppermute ring attention over sp), "ulysses"
     # (all_to_all head/seq reshard over sp) — see parallel/context.py
     attn_impl: str = "gspmd"
+    # expert parallelism: >0 replaces the dense FFN with a switch-routed
+    # MoE of this many experts, sharded over the tp axis (parallel/moe.py)
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # switch-transformer load-balance coeff
 
     @property
     def head_dim(self) -> int:
@@ -55,14 +60,20 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
     f = cfg.dim * cfg.mlp_mult
     for i in range(cfg.layers):
         kk = jax.random.split(k[2 + i], 6)
-        params["blocks"].append({
+        block = {
             "ln1": jnp.ones((cfg.dim,), jnp.float32),
             "wqkv": dense(kk[0], (cfg.dim, 3 * cfg.dim)),
             "wo": dense(kk[1], (cfg.dim, cfg.dim)),
             "ln2": jnp.ones((cfg.dim,), jnp.float32),
-            "w1": dense(kk[2], (cfg.dim, f)),
-            "w2": dense(kk[3], (f, cfg.dim)),
-        })
+        }
+        if cfg.moe_experts > 0:
+            from ..parallel.moe import init_moe_params
+
+            block["moe"] = init_moe_params(kk[2], cfg.dim, f, cfg.moe_experts)
+        else:
+            block["w1"] = dense(kk[2], (cfg.dim, f))
+            block["w2"] = dense(kk[3], (f, cfg.dim))
+        params["blocks"].append(block)
     return params
 
 
@@ -76,9 +87,16 @@ def param_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
         "wqkv": P(None, "tp"),
         "wo": P("tp", None),
         "ln2": P(None),
-        "w1": P(None, "tp"),
-        "w2": P("tp", None),
     }
+    if cfg.moe_experts > 0:
+        # expert parallelism rides the tp axis: each tp shard holds
+        # moe_experts/tp experts (parallel/moe.py)
+        from ..parallel.moe import moe_pspecs
+
+        block["moe"] = moe_pspecs(ep_axis="tp")
+    else:
+        block["w1"] = P(None, "tp")
+        block["w2"] = P("tp", None)
     return {
         "embed": P(None, None),
         "pos": P(None, None),
@@ -93,9 +111,12 @@ def _rmsnorm(x, g):
     return x * g / jnp.sqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
 
 
-def forward(cfg: TransformerConfig, params, tokens, mesh=None):
-    """tokens (B, S) int32 -> logits (B, S, V). With ``mesh``, activations are
-    constrained to P("dp", "sp", None) so GSPMD keeps sequence sharded."""
+def forward(cfg: TransformerConfig, params, tokens, mesh=None,
+            return_aux: bool = False):
+    """tokens (B, S) int32 -> logits (B, S, V), or (logits, aux_loss) with
+    ``return_aux`` (MoE load-balance term, 0 for dense). With ``mesh``,
+    activations are constrained to P("dp", "sp", None) so GSPMD keeps
+    sequence sharded."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -117,6 +138,7 @@ def forward(cfg: TransformerConfig, params, tokens, mesh=None):
     x = params["embed"][tokens] + params["pos"][:S][None, :, :]
     x = constrain(x, "dp", "sp", None)
     mask = jnp.tril(jnp.ones((S, S), bool))
+    aux_total = jnp.zeros((), jnp.float32)
     for blk in params["blocks"]:
         h = _rmsnorm(x, blk["ln1"])
         qkv = h @ blk["wqkv"]                      # (B,S,3D) — tp-sharded cols
@@ -137,22 +159,35 @@ def forward(cfg: TransformerConfig, params, tokens, mesh=None):
         x = x + o @ blk["wo"]
         x = constrain(x, "dp", "sp", None)
         h = _rmsnorm(x, blk["ln2"])
-        x = x + jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
+        if "moe" in blk:
+            from ..parallel.moe import moe_ffn
+
+            y, aux = moe_ffn(blk["moe"], h, mesh, ep_axis="tp",
+                             capacity_factor=cfg.moe_capacity_factor,
+                             return_aux=True)
+            x = x + y
+            aux_total = aux_total + aux
+        else:
+            x = x + jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
         x = constrain(x, "dp", "sp", None)
     x = _rmsnorm(x, params["out_norm"])
-    return x @ params["embed"].T                   # tied un-embedding
+    logits = x @ params["embed"].T                 # tied un-embedding
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def loss_fn(cfg: TransformerConfig, params, tokens, mesh=None):
-    """Next-token cross entropy."""
+    """Next-token cross entropy (+ MoE load-balance auxiliary term — the
+    switch router collapses onto one expert without it)."""
     import jax
     import jax.numpy as jnp
 
-    logits = forward(cfg, params, tokens[:, :-1], mesh)
+    logits, aux = forward(cfg, params, tokens[:, :-1], mesh, return_aux=True)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + cfg.moe_aux_weight * aux
 
 
 def make_train_step(cfg: TransformerConfig, mesh, lr: float = 1e-2):

@@ -54,11 +54,17 @@ def make_mesh(devices: Optional[Sequence] = None,
 
     devices = list(devices if devices is not None else jax.devices())
     sizes = axis_sizes or factor_devices(len(devices))
-    shape = tuple(sizes[a] for a in AXES)
+    # canonical ordering: known axes keep the dp-outermost convention
+    # (dp spans hosts/DCN, tp/sp stay inner on ICI — multihost layout
+    # depends on this) regardless of the caller's dict order; custom axes
+    # ("ep", ...) follow in insertion order after the known ones
+    axes = tuple([a for a in AXES if a in sizes]
+                 + [a for a in sizes if a not in AXES])
+    shape = tuple(sizes[a] for a in axes)
     if int(np.prod(shape)) != len(devices):
         raise ValueError(f"mesh {sizes} does not cover {len(devices)} devices")
     dev_array = np.array(devices).reshape(shape)
-    return Mesh(dev_array, AXES)
+    return Mesh(dev_array, axes)
 
 
 def named_sharding(mesh, *spec):
