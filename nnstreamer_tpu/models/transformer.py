@@ -196,6 +196,12 @@ def make_train_step(cfg: TransformerConfig, mesh, lr: float = 1e-2):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if cfg.moe_experts > 0:
+        tp_size = dict(mesh.shape).get("tp", 1)
+        if cfg.moe_experts % tp_size:
+            raise ValueError(
+                f"moe_experts={cfg.moe_experts} must be divisible by the "
+                f"tp axis size {tp_size} (experts are sharded over tp)")
     pspecs = param_pspecs(cfg)
     param_shardings = jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec), pspecs,

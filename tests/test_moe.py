@@ -110,8 +110,7 @@ class TestMeshOrderingInvariant:
         spans hosts over DCN; tp/sp stay inner on ICI)."""
         mesh = make_mesh(jax.devices()[:4], {"tp": 2, "dp": 2, "sp": 1})
         assert mesh.axis_names == ("dp", "tp", "sp")
-        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
-            "dp": 2, "tp": 2, "sp": 1}
+        assert dict(mesh.shape) == {"dp": 2, "tp": 2, "sp": 1}
 
     def test_custom_axes_follow_known(self):
         mesh = make_mesh(jax.devices(), {"ep": 4, "dp": 2})
