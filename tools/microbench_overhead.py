@@ -1,0 +1,49 @@
+"""Per-element overhead microbenchmark.
+
+The reference's headline quantitative claim (papers linked from its
+README) is low per-element overhead vs raw framework invocation; this
+measures ours: frames/second through passthrough chains of increasing
+length, reporting the marginal cost of one element hop (pad push →
+chain → transform → push).
+
+Usage: python tools/microbench_overhead.py [n_frames]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from nnstreamer_tpu.runtime.parse import parse_launch  # noqa: E402
+
+
+def measure(n_elems: int, n_bufs: int) -> float:
+    chain = " ! ".join(["tensor_debug output-mode=none"] * n_elems)
+    pipe = parse_launch(
+        f"tensor_src num-buffers={n_bufs} dimensions=16 types=float32 "
+        f"! {chain} ! tensor_sink name=out max-stored=1")
+    t0 = time.perf_counter()
+    pipe.run(timeout=180)
+    return (time.perf_counter() - t0) / n_bufs
+
+
+def main() -> None:
+    n_bufs = int(sys.argv[1]) if len(sys.argv) > 1 else 4000
+    prev = None
+    for n in (1, 2, 4, 8, 16, 32):
+        per_buf = measure(n, n_bufs)
+        marginal = (per_buf - prev) / (n / 2) if prev is not None else float("nan")
+        print(f"chain={n:3d}: {per_buf * 1e6:8.1f} us/frame"
+              + (f"   ~{marginal * 1e6:5.2f} us/element marginal"
+                 if prev is not None else ""))
+        prev = per_buf
+
+
+if __name__ == "__main__":
+    main()
