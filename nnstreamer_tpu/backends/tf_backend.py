@@ -1,15 +1,20 @@
-"""TensorFlow SavedModel filter backend (L4).
+"""TensorFlow filter backend: SavedModel + frozen GraphDef (L4).
 
 Reference analog: ``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow.cc``
-(804 LoC — TF-C API session/graph-def load). TF2 redesign: load a SavedModel
-and invoke one of its serving signatures; graph-def era ``.pb`` files are out
-of scope (the reference itself migrated its tests to SavedModel/tflite).
+(804 LoC — TF-C API session/graph-def load). TF2 redesign: a SavedModel
+directory serves one of its signatures; a frozen ``.pb`` GraphDef (the
+reference's native format — its test models mnist.pb /
+conv_actions_frozen.pb are frozen graphs) is imported via
+``wrap_function`` and pruned to a concrete feeds→fetches function.
+Graph endpoints auto-detect (Placeholder ops → inputs, consumer-less
+non-Const ops → outputs) unless named explicitly.
 
 Custom options:
-  ``signature:<key>`` — signature to serve (default: ``[tensorflow] signature``
-  config key, then ``serving_default``).
-  ``inputs:<name;name2>`` — explicit positional→name binding for multi-input
-  signatures.
+  ``signature:<key>`` — SavedModel signature to serve (default:
+  ``[tensorflow] signature`` config key, then ``serving_default``).
+  ``inputs:<name;name2>`` — explicit positional→name binding (SavedModel
+  signature kwargs, or GraphDef tensor names like ``input:0``).
+  ``outputs:<name;name2>`` — GraphDef fetch tensor names.
 
 Restored signatures canonicalize their kwargs, so declaration order is lost;
 inputs therefore bind to the signature's input names **sorted
@@ -39,14 +44,20 @@ class TensorFlowBackend(FilterBackend):
         self._fn = None
         self._input_names: List[str] = []
         self._output_names: List[str] = []
+        self._pruned = None  # set only for frozen-GraphDef models
 
     def open(self, props: FilterProperties) -> None:
         super().open(props)
+        import os
+
         import tensorflow as tf
 
         from ..registry.config import get_config
 
         opts = props.custom_dict()
+        if os.path.isfile(props.model) and props.model.endswith(".pb"):
+            self._open_graphdef(props.model, opts)
+            return
         sig_key = opts.get("signature") or get_config().get(
             "tensorflow", "signature", "serving_default"
         )
@@ -76,9 +87,68 @@ class TensorFlowBackend(FilterBackend):
             props.model, sig_key, self._input_names, self._output_names,
         )
 
+    def _open_graphdef(self, path: str, opts) -> None:
+        """Frozen GraphDef → pruned concrete function (reference: TF-C API
+        session over an imported graph-def)."""
+        import tensorflow as tf
+
+        gd = tf.compat.v1.GraphDef()
+        with open(path, "rb") as fh:
+            gd.ParseFromString(fh.read())
+
+        def _tensor_names(key, default):
+            given = opts.get(key)
+            if given:
+                return [n.strip() if ":" in n else f"{n.strip()}:0"
+                        for n in given.split(";") if n.strip()]
+            return default
+
+        placeholders = [n.name for n in gd.node if n.op == "Placeholder"]
+        consumed = set()
+        for n in gd.node:
+            for i in n.input:
+                consumed.add(i.split(":")[0].lstrip("^"))
+        sinks = [n.name for n in gd.node
+                 if n.name not in consumed
+                 and n.op not in ("Const", "Placeholder", "NoOp", "Assert")]
+        wrapped = tf.compat.v1.wrap_function(
+            lambda: tf.compat.v1.import_graph_def(gd, name=""), [])
+
+        def _resolve(names, auto):
+            """Map names → graph tensors; auto-detected candidates that
+            yield no tensor (stray zero-output sinks) are skipped instead
+            of crashing the load."""
+            out_names, tensors = [], []
+            for n in names:
+                try:
+                    tensors.append(wrapped.graph.get_tensor_by_name(n))
+                    out_names.append(n)
+                except (KeyError, ValueError):
+                    if not auto:
+                        raise
+                    logger.debug("skipping non-tensor graph endpoint %s", n)
+            return out_names, tensors
+
+        feeds = _tensor_names("inputs", [f"{p}:0" for p in placeholders])
+        fetches = _tensor_names("outputs", [f"{s}:0" for s in sinks])
+        feeds, feed_tensors = _resolve(feeds, auto="inputs" not in opts)
+        fetches, fetch_tensors = _resolve(fetches, auto="outputs" not in opts)
+        if not feeds or not fetches:
+            raise ValueError(
+                f"{path}: cannot determine graph endpoints (feeds={feeds}, "
+                f"fetches={fetches}) — pass custom=inputs:...,outputs:...")
+        self._pruned = wrapped.prune(feeds=feed_tensors, fetches=fetch_tensors)
+        self._fn = self._pruned
+        self._loaded = wrapped
+        self._input_names = feeds
+        self._output_names = fetches
+        logger.info("tensorflow backend loaded frozen graph %s in=%s out=%s",
+                    path, feeds, fetches)
+
     def close(self) -> None:
         self._fn = None
         self._loaded = None
+        self._pruned = None
         super().close()
 
     def _spec_of(self, tensor_spec) -> Optional[TensorSpec]:
@@ -90,11 +160,22 @@ class TensorFlowBackend(FilterBackend):
             DataType.from_any(tensor_spec.dtype.as_numpy_dtype),
         )
 
+    def _tf_spec(self, t) -> Optional[TensorSpec]:
+        shape = t.shape
+        if shape.rank is None or any(d is None or d < 0 for d in shape.as_list()):
+            return None
+        return TensorSpec(tuple(int(d) for d in shape.as_list()),
+                          DataType.from_any(t.dtype.as_numpy_dtype))
+
     def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
-        _, kwargs_sig = self._fn.structured_input_signature
-        ins = [self._spec_of(kwargs_sig[n]) for n in self._input_names]
-        outs = [self._spec_of(self._fn.structured_outputs[n])
-                for n in self._output_names]
+        if self._pruned is not None:
+            ins = [self._tf_spec(t) for t in self._pruned.inputs]
+            outs = [self._tf_spec(t) for t in self._pruned.outputs]
+        else:
+            _, kwargs_sig = self._fn.structured_input_signature
+            ins = [self._spec_of(kwargs_sig[n]) for n in self._input_names]
+            outs = [self._spec_of(self._fn.structured_outputs[n])
+                    for n in self._output_names]
         in_info = TensorsInfo.of(*ins) if all(s is not None for s in ins) else None
         out_info = TensorsInfo.of(*outs) if all(s is not None for s in outs) else None
         return in_info, out_info
@@ -109,6 +190,9 @@ class TensorFlowBackend(FilterBackend):
                 f"signature takes {len(self._input_names)} inputs "
                 f"({self._input_names}), got {len(inputs)}"
             )
+        if self._pruned is not None:
+            out = self._pruned(*(tf.constant(np.asarray(x)) for x in inputs))
+            return [o.numpy() for o in out]
         feed = {
             name: tf.constant(np.asarray(x))
             for name, x in zip(self._input_names, inputs)

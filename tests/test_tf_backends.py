@@ -5,6 +5,8 @@ Reference analog: tests/nnstreamer_filter_tensorflow2_lite/runTest.sh —
 gst-launch pipelines through the tflite subplugin with golden compare, and
 the framework auto-detection cases from unittest_filter_single.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -97,3 +99,40 @@ def test_tflite_dynamic_batch_resize(tmp_path):
     np.testing.assert_allclose(np.asarray(b.invoke([x])[0]), 2.0)
     assert b.invoke([x])[0].shape == (5, 4)
     b.close()
+
+
+class TestFrozenGraphDef:
+    """Frozen .pb graphs — the reference TF subplugin's native format
+    (tests/test_models/models/mnist.pb)."""
+
+    MNIST = "/root/reference/tests/test_models/models/mnist.pb"
+
+    @pytest.mark.skipif(not os.path.exists(MNIST), reason="reference models absent")
+    def test_mnist_pb_autodetect_endpoints(self):
+        from nnstreamer_tpu.single import SingleShot
+
+        with SingleShot("tensorflow", self.MNIST) as s:
+            x = np.random.rand(1, 784).astype(np.float32)
+            (out,) = s.invoke(x)
+            assert out.shape == (1, 10)
+            assert np.allclose(out.sum(), 1.0, atol=1e-4)  # softmax head
+
+    @pytest.mark.skipif(not os.path.exists(MNIST), reason="reference models absent")
+    def test_mnist_pb_pipeline_with_explicit_names(self):
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=784:1,types=float32 "
+            f"! tensor_filter framework=tensorflow model={self.MNIST} "
+            "custom=inputs:input,outputs:softmax "
+            "! tensor_decoder mode=image_labeling "
+            "! tensor_sink name=out")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        pipe.get("in").push_buffer(np.random.rand(1, 784).astype(np.float32))
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert got and got[0].meta["label"].isdigit()
