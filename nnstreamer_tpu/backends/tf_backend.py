@@ -56,8 +56,17 @@ class TensorFlowBackend(FilterBackend):
 
         opts = props.custom_dict()
         if os.path.isfile(props.model) and props.model.endswith(".pb"):
-            self._open_graphdef(props.model, opts)
-            return
+            if os.path.basename(props.model) == "saved_model.pb":
+                # common mistake: pointing at the file inside a SavedModel
+                # dir — that .pb is a SavedModel proto, not a GraphDef
+                logger.info("model points at saved_model.pb; loading the "
+                            "SavedModel directory instead")
+                props = FilterProperties(
+                    model=os.path.dirname(props.model), custom=props.custom,
+                    accelerator=props.accelerator)
+            else:
+                self._open_graphdef(props.model, opts)
+                return
         sig_key = opts.get("signature") or get_config().get(
             "tensorflow", "signature", "serving_default"
         )
@@ -81,7 +90,18 @@ class TensorFlowBackend(FilterBackend):
                     f"{self._input_names}"
                 )
             self._input_names = names
-        self._output_names = sorted(self._fn.structured_outputs)
+        out_sel = opts.get("outputs")
+        if out_sel:
+            names = [n.strip() for n in out_sel.split(";") if n.strip()]
+            unknown = set(names) - set(self._fn.structured_outputs)
+            if unknown:
+                raise ValueError(
+                    f"custom outputs:{out_sel} names unknown signature "
+                    f"outputs {sorted(unknown)} (available: "
+                    f"{sorted(self._fn.structured_outputs)})")
+            self._output_names = names
+        else:
+            self._output_names = sorted(self._fn.structured_outputs)
         logger.info(
             "tensorflow backend loaded %s sig=%s in=%s out=%s",
             props.model, sig_key, self._input_names, self._output_names,
@@ -97,11 +117,14 @@ class TensorFlowBackend(FilterBackend):
             gd.ParseFromString(fh.read())
 
         def _tensor_names(key, default):
+            """(names, used_auto): explicit custom names, else the
+            auto-detected defaults."""
             given = opts.get(key)
-            if given:
-                return [n.strip() if ":" in n else f"{n.strip()}:0"
-                        for n in given.split(";") if n.strip()]
-            return default
+            names = [n.strip() if ":" in n else f"{n.strip()}:0"
+                     for n in (given or "").split(";") if n.strip()]
+            if names:
+                return names, False
+            return default, True
 
         placeholders = [n.name for n in gd.node if n.op == "Placeholder"]
         consumed = set()
@@ -129,10 +152,10 @@ class TensorFlowBackend(FilterBackend):
                     logger.debug("skipping non-tensor graph endpoint %s", n)
             return out_names, tensors
 
-        feeds = _tensor_names("inputs", [f"{p}:0" for p in placeholders])
-        fetches = _tensor_names("outputs", [f"{s}:0" for s in sinks])
-        feeds, feed_tensors = _resolve(feeds, auto="inputs" not in opts)
-        fetches, fetch_tensors = _resolve(fetches, auto="outputs" not in opts)
+        feeds, feeds_auto = _tensor_names("inputs", [f"{p}:0" for p in placeholders])
+        fetches, fetches_auto = _tensor_names("outputs", [f"{s}:0" for s in sinks])
+        feeds, feed_tensors = _resolve(feeds, auto=feeds_auto)
+        fetches, fetch_tensors = _resolve(fetches, auto=fetches_auto)
         if not feeds or not fetches:
             raise ValueError(
                 f"{path}: cannot determine graph endpoints (feeds={feeds}, "
@@ -160,17 +183,11 @@ class TensorFlowBackend(FilterBackend):
             DataType.from_any(tensor_spec.dtype.as_numpy_dtype),
         )
 
-    def _tf_spec(self, t) -> Optional[TensorSpec]:
-        shape = t.shape
-        if shape.rank is None or any(d is None or d < 0 for d in shape.as_list()):
-            return None
-        return TensorSpec(tuple(int(d) for d in shape.as_list()),
-                          DataType.from_any(t.dtype.as_numpy_dtype))
-
     def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
         if self._pruned is not None:
-            ins = [self._tf_spec(t) for t in self._pruned.inputs]
-            outs = [self._tf_spec(t) for t in self._pruned.outputs]
+            # graph Tensors expose the same .shape/.dtype API _spec_of reads
+            ins = [self._spec_of(t) for t in self._pruned.inputs]
+            outs = [self._spec_of(t) for t in self._pruned.outputs]
         else:
             _, kwargs_sig = self._fn.structured_input_signature
             ins = [self._spec_of(kwargs_sig[n]) for n in self._input_names]
