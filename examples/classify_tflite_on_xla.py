@@ -26,7 +26,11 @@ DEFAULT = "/root/reference/tests/test_models/models/mobilenet_v2_1.0_224_quant.t
 def main() -> None:
     model = sys.argv[1] if len(sys.argv) > 1 else DEFAULT
     if not os.path.exists(model):
-        raise SystemExit(f"model not found: {model}")
+        raise SystemExit(
+            f"model not found: {model}\n"
+            "usage: python examples/classify_tflite_on_xla.py <model.tflite>\n"
+            "(the no-argument default expects the reference checkout at "
+            "/root/reference)")
     pipe = parse_launch(
         "tensor_src num-buffers=4 dimensions=3:224:224:1 types=uint8 pattern=random "
         f"! tensor_filter framework=jax model={model} "

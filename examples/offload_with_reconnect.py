@@ -48,9 +48,12 @@ def main() -> None:
     client.play()
     src = client.get("in")
 
-    while len(out) < 5:
+    deadline = time.monotonic() + 20
+    while len(out) < 5 and time.monotonic() < deadline:
         src.push_buffer(np.ones(4, np.float32))
         time.sleep(0.03)
+    if len(out) < 5:
+        raise SystemExit("worker never answered — check the logs above")
     print(f"worker x2 answered {len(out)} frames: {out[-3:]}")
 
     print("killing worker ...")

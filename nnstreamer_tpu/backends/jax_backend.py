@@ -104,6 +104,9 @@ class JaxBackend(FilterBackend):
         self._out_info: Optional[TensorsInfo] = None
         self._jit: Optional[Callable] = None
         self._device = None
+        self._signatures: set = set()  # (shape, dtype) tuples seen
+        self._max_signatures = 32
+        self._sig_warned = False
 
     # -- open/close ---------------------------------------------------------
     def open(self, props: FilterProperties) -> None:
@@ -114,6 +117,12 @@ class JaxBackend(FilterBackend):
         model = props.model
         if self._fn is None:  # may be preset via set_model_callable
             self._fn = self._load_model(model, props)
+        max_sig = props.custom_dict().get("max_signatures", "32")
+        try:
+            self._max_signatures = int(max_sig)
+        except ValueError:
+            raise ValueError(
+                f"custom=max_signatures:{max_sig!r} is not an integer")
         logger.info("jax backend opened model=%s device=%s", model, self._device)
 
     def _select_device(self, props: FilterProperties) -> None:
@@ -239,11 +248,39 @@ class JaxBackend(FilterBackend):
             self._jit = jax.jit(lambda *xs: _as_tuple(self._fn(*xs)))
         return self._jit
 
+    def compile_cache_info(self) -> dict:
+        """Shape-bucketing introspection (SURVEY §7 'hard parts': flexible
+        streams recompile per signature; this makes that visible)."""
+        return {
+            "signatures": len(self._signatures),
+            "max_signatures": self._max_signatures,
+        }
+
+    def _track_signature(self, inputs: List[Any]) -> None:
+        sig = tuple((tuple(getattr(x, "shape", ())),
+                     str(getattr(x, "dtype", type(x))))
+                    for x in inputs)
+        if sig in self._signatures:
+            return
+        self._signatures.add(sig)
+        n = len(self._signatures)
+        # >= with a once-flag: concurrent invokes on this REENTRANT backend
+        # could jump past an exact-equality check and never warn
+        if n >= self._max_signatures and not self._sig_warned:
+            self._sig_warned = True
+            logger.warning(
+                "jax backend model=%s hit %d distinct input signatures — a "
+                "flexible stream is forcing XLA recompiles per shape; "
+                "bucket shapes upstream (tensor_aggregator / pad) or raise "
+                "custom=max_signatures:N to silence",
+                self.props.model if self.props else "?", n)
+
     def invoke(self, inputs: List[Any]) -> List[Any]:
         import jax
 
         if self._fn is None:
             raise RuntimeError("jax backend: invoke before open")
+        self._track_signature(inputs)
         device_inputs = []
         for x in inputs:
             if hasattr(x, "addressable_shards"):

@@ -61,16 +61,16 @@ class TensorFlowBackend(FilterBackend):
                 # dir — that .pb is a SavedModel proto, not a GraphDef
                 logger.info("model points at saved_model.pb; loading the "
                             "SavedModel directory instead")
-                props = FilterProperties(
-                    model=os.path.dirname(props.model), custom=props.custom,
-                    accelerator=props.accelerator)
+                model_path = os.path.dirname(props.model) or "."
             else:
                 self._open_graphdef(props.model, opts)
                 return
+        else:
+            model_path = props.model
         sig_key = opts.get("signature") or get_config().get(
             "tensorflow", "signature", "serving_default"
         )
-        loaded = tf.saved_model.load(props.model)
+        loaded = tf.saved_model.load(model_path)
         try:
             self._fn = loaded.signatures[sig_key]
         except KeyError:

@@ -380,3 +380,22 @@ class TestSingleShot:
             info = s.set_input_info(TensorsInfo.of(TensorSpec((2, 2), "float32")))
             assert info.specs[0].shape == (2, 2)
         assert s.stats.total_invokes == 1
+
+
+class TestShapeBucketing:
+    def test_signature_tracking_and_warning(self, caplog):
+        """Flexible streams recompile per shape; the backend surfaces it
+        (SURVEY §7 hard part: shape dynamism vs XLA)."""
+        import logging
+
+        from nnstreamer_tpu.single import SingleShot
+
+        with SingleShot("jax", "builtin://scaler?factor=2",
+                        custom="max_signatures:3") as s:
+            with caplog.at_level(logging.WARNING, logger="nnstreamer_tpu"):
+                for n in (1, 2, 3, 4):
+                    s.invoke(np.zeros((n, 2), np.float32))
+            info = s.backend.compile_cache_info()
+            assert info["signatures"] == 4
+            assert any("distinct input signatures" in r.message
+                       for r in caplog.records)

@@ -127,3 +127,34 @@ class TestQuantizedMobilenet:
         # agreement (exact argmax on one noise image is seed/HW-fragile)
         diff = np.abs(results["jax"].astype(int) - results["tflite"].astype(int))
         assert diff.max() <= 4
+
+
+class TestSynthesizedOps:
+    """Ops not exercised by the reference model zoo (FULLY_CONNECTED,
+    MAX_POOL_2D, PAD, SOFTMAX, MEAN) — a keras model converted to tflite
+    in-test, run through both executors."""
+
+    @pytest.mark.slow
+    def test_dense_pool_pad_softmax(self, tmp_path):
+        import tensorflow as tf
+
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        inp = tf.keras.Input((8, 8, 3))
+        x = tf.keras.layers.ZeroPadding2D(1)(inp)
+        x = tf.keras.layers.MaxPool2D(2)(x)
+        x = tf.keras.layers.GlobalAveragePooling2D()(x)  # MEAN
+        x = tf.keras.layers.Dense(10)(x)                 # FULLY_CONNECTED
+        out = tf.keras.layers.Softmax()(x)
+        model = tf.keras.Model(inp, out)
+        conv = tf.lite.TFLiteConverter.from_keras_model(model)
+        blob = conv.convert()
+        path = tmp_path / "synth.tflite"
+        path.write_bytes(blob)
+
+        fn, in_info, _ = load_tflite(str(path))
+        x_in = np.random.rand(1, 8, 8, 3).astype(np.float32)
+        ours = np.asarray(fn(x_in)[0])
+        ref = _run_interp(_interp(str(path)), x_in)[0]
+        assert np.abs(ours - ref).max() < 1e-5
+        assert np.allclose(ours.sum(), 1.0, atol=1e-5)
