@@ -1,0 +1,17 @@
+"""nnstreamer_tpu — TPU-native streaming ML pipeline framework.
+
+Capability parity with NNStreamer (reference at /root/reference): typed tensor
+streams flowing through a declarative pipeline of converter / filter / decoder
+/ routing / batching elements, with pluggable NN backends and among-device
+offload — re-designed on jax/XLA/pallas/pjit. See SURVEY.md for the layer map.
+"""
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401
+    Buffer,
+    Caps,
+    DataType,
+    TensorFormat,
+    TensorSpec,
+    TensorsInfo,
+)

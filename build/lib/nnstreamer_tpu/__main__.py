@@ -1,0 +1,191 @@
+"""Command-line tools (L6/L7).
+
+Reference analogs: ``gst-launch-1.0`` (run a text pipeline), ``gst-inspect``
+(list elements / show properties), ``tools/development/parser`` (pbtxt ↔
+launch conversion), ``tools/development/nnstreamerCodeGenCustomFilter.py``
+(custom-filter skeleton codegen)::
+
+    python -m nnstreamer_tpu launch "tensor_src num-buffers=3 ... ! tensor_sink"
+    python -m nnstreamer_tpu inspect                # all elements
+    python -m nnstreamer_tpu inspect tensor_filter  # one element's props
+    python -m nnstreamer_tpu convert pipe.json      # description -> launch
+    python -m nnstreamer_tpu convert "a ! b"        # launch -> description
+    python -m nnstreamer_tpu codegen filter my_filter.py
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_launch(args) -> int:
+    from .core import MessageType
+    from .runtime.describe import load_pipeline_file
+    from .runtime.parse import parse_launch
+
+    text = args.pipeline
+    if text.endswith(".json") or text.endswith(".launch"):
+        pipe = load_pipeline_file(text)
+    else:
+        pipe = parse_launch(text)
+    pipe.play()
+    # no --timeout means "wait for the stream to finish" (bounded at a day
+    # so a wedged pipeline still exits nonzero instead of hanging forever)
+    timeout = args.timeout if args.timeout is not None else 86400.0
+    msg = pipe.bus.wait_for((MessageType.EOS, MessageType.ERROR),
+                            timeout=timeout)
+    pipe.stop()
+    if msg is None:
+        print("timeout waiting for EOS", file=sys.stderr)
+        return 2
+    if msg.type is MessageType.ERROR:
+        print(f"ERROR from {msg.source}: {msg.data}", file=sys.stderr)
+        return 1
+    print("pipeline finished (EOS)")
+    return 0
+
+
+def _cmd_inspect(args) -> int:
+    from .registry.elements import element_factories, get_factory
+
+    if not args.element:
+        for name in element_factories():
+            print(name)
+        return 0
+    cls = get_factory(args.element)
+    print(f"{args.element}  ({cls.__module__}.{cls.__name__})")
+    doc = (cls.__doc__ or "").strip().splitlines()
+    if doc:
+        print(f"  {doc[0]}")
+    print("  pads:")
+    for t in cls.SINK_TEMPLATES:
+        print(f"    sink  {t.name_template}: {t.caps}")
+    for t in cls.SRC_TEMPLATES:
+        print(f"    src   {t.name_template}: {t.caps}")
+    if cls.PROPERTIES:
+        print("  properties:")
+        for k, p in cls.PROPERTIES.items():
+            detail = f" — {p.doc}" if getattr(p, "doc", None) else ""
+            print(f"    {k.replace('_', '-')}: default={p.default!r}{detail}")
+    return 0
+
+
+def _cmd_convert(args) -> int:
+    from .runtime.describe import description_to_launch, launch_to_description
+
+    text = args.input
+    if text.endswith(".json"):
+        with open(text) as fh:
+            print(description_to_launch(json.load(fh)))
+    elif text.lstrip().startswith("{"):
+        print(description_to_launch(json.loads(text)))
+    else:
+        if text.endswith(".launch"):
+            with open(text) as fh:
+                text = fh.read().strip()
+        print(json.dumps(launch_to_description(text), indent=2))
+    return 0
+
+
+_FILTER_SKELETON = '''"""Custom tensor_filter model (generated skeleton).
+
+Use:  tensor_filter framework=jax model={path}
+"""
+import jax.numpy as jnp
+
+# optional: declare static shapes so negotiation completes before data flows
+# from nnstreamer_tpu.core import TensorsInfo
+# from nnstreamer_tpu.core.tensors import TensorSpec
+# IN_INFO = TensorsInfo.of(TensorSpec((1, 224, 224, 3), "float32"))
+# OUT_INFO = TensorsInfo.of(TensorSpec((1, 1001), "float32"))
+
+
+def model(*tensors):
+    """jax-traceable: gets input tensors, returns output tensor(s)."""
+    x = tensors[0]
+    return x  # TODO: your computation (runs under jax.jit)
+'''
+
+_DECODER_SKELETON = '''"""Custom tensor_decoder (generated skeleton).
+
+Use:  tensor_decoder mode=python3 option1={path}
+"""
+from nnstreamer_tpu.core import Buffer, Caps
+
+
+class Decoder:
+    def init(self, options):
+        """options[0] is your option2, etc."""
+
+    def get_out_caps(self, in_info):
+        return Caps.new("text/plain")
+
+    def decode(self, buf, in_info):
+        # TODO: turn buf.tensors into a media Buffer
+        return buf
+'''
+
+_CONVERTER_SKELETON = '''"""Custom tensor_converter (generated skeleton).
+
+Use:  tensor_converter subplugin=python3 subplugin-option={path}
+"""
+import numpy as np
+
+from nnstreamer_tpu.core import Buffer, TensorsInfo
+from nnstreamer_tpu.core.tensors import TensorSpec
+
+
+class Converter:
+    def get_out_info(self, in_caps):
+        return TensorsInfo.of(TensorSpec((1,), "float32"))
+
+    def convert(self, buf):
+        raw = np.asarray(buf.tensors[0])
+        # TODO: parse your media bytes into tensors
+        return Buffer([raw.astype(np.float32)[:1]])
+'''
+
+_SKELETONS = {
+    "filter": _FILTER_SKELETON,
+    "decoder": _DECODER_SKELETON,
+    "converter": _CONVERTER_SKELETON,
+}
+
+
+def _cmd_codegen(args) -> int:
+    skel = _SKELETONS[args.kind]
+    with open(args.output, "w") as fh:
+        fh.write(skel.format(path=args.output))
+    print(f"wrote {args.kind} skeleton to {args.output}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nnstreamer_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("launch", help="run a pipeline (gst-launch analog)")
+    p.add_argument("pipeline", help="launch text, .json, or .launch file")
+    p.add_argument("--timeout", type=float, default=None)
+    p.set_defaults(fn=_cmd_launch)
+
+    p = sub.add_parser("inspect", help="list elements / show one (gst-inspect)")
+    p.add_argument("element", nargs="?", default=None)
+    p.set_defaults(fn=_cmd_inspect)
+
+    p = sub.add_parser("convert", help="launch text <-> JSON description")
+    p.add_argument("input", help="launch string, JSON string, or file path")
+    p.set_defaults(fn=_cmd_convert)
+
+    p = sub.add_parser("codegen", help="generate subplugin skeletons")
+    p.add_argument("kind", choices=sorted(_SKELETONS))
+    p.add_argument("output", help="output .py path")
+    p.set_defaults(fn=_cmd_codegen)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

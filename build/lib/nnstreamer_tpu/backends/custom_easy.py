@@ -1,0 +1,80 @@
+"""Custom-easy filter backend (L4/L2).
+
+Reference analog: ``tensor_filter_custom_easy``
+(gst/nnstreamer/tensor_filter/tensor_filter_custom_easy.c:355 —
+``NNS_custom_easy_register`` installs a single C function + in/out info under
+a name, callable as ``framework=custom-easy model=<name>``). Here apps call
+``register_custom_easy(name, fn, in_info, out_info)`` with a python/jax
+callable; the registered entry is resolved by the ``model`` property.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core import TensorsInfo
+from .base import Accelerator, FilterBackend, FilterProperties, register_backend
+
+
+@dataclass
+class _CustomEntry:
+    fn: Callable
+    in_info: Optional[TensorsInfo]
+    out_info: Optional[TensorsInfo]
+
+
+_custom: Dict[str, _CustomEntry] = {}
+_lock = threading.Lock()
+
+
+def register_custom_easy(name: str, fn: Callable,
+                         in_info: Optional[TensorsInfo] = None,
+                         out_info: Optional[TensorsInfo] = None) -> None:
+    """Install ``fn(inputs: list) -> list`` as ``framework=custom-easy
+    model=<name>`` (reference ``NNS_custom_easy_register``)."""
+    with _lock:
+        _custom[name] = _CustomEntry(fn, in_info, out_info)
+
+
+def unregister_custom_easy(name: str) -> bool:
+    with _lock:
+        return _custom.pop(name, None) is not None
+
+
+@register_backend
+class CustomEasyBackend(FilterBackend):
+    NAME = "custom-easy"
+    # NOTE: bare "custom" names the C-ABI .so backend (custom_c.py), matching
+    # the reference's split between tensor_filter_custom and _custom_easy
+    ALIASES = ("custom_easy",)
+    ACCELERATORS = (Accelerator.CPU, Accelerator.TPU)
+    REENTRANT = True
+
+    def __init__(self):
+        super().__init__()
+        self._entry: Optional[_CustomEntry] = None
+
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        with _lock:
+            entry = _custom.get(props.model)
+        if entry is None:
+            raise ValueError(
+                f"no custom-easy filter '{props.model}' registered "
+                f"(known: {sorted(_custom)})"
+            )
+        self._entry = entry
+
+    def close(self) -> None:
+        self._entry = None
+        super().close()
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        return self._entry.in_info, self._entry.out_info
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        if self._entry is None:
+            raise RuntimeError("custom-easy backend: invoke before open")
+        out = self._entry.fn(inputs)
+        return list(out) if isinstance(out, (list, tuple)) else [out]

@@ -1,0 +1,307 @@
+"""First-class JAX/XLA filter backend (L4).
+
+This plays the role of the reference's *entire* backend family
+(ext/nnstreamer/tensor_filter/ — tflite/TF/torch/TensorRT/EdgeTPU/... each
+wrapping another runtime): here the pipeline's execution engine *is* XLA.
+Models are jax-traceable callables; each distinct input signature is jit
+compiled once and cached (shape-bucketed compile cache — the redesign of the
+reference's per-frame dynamic dispatch), inputs are async ``device_put``, and
+outputs remain device-resident jax Arrays so downstream jitted stages never
+bounce through host memory (the reference's per-frame map/copy cost,
+tensor_filter.c:702-816, is the overhead we delete).
+
+Model sources accepted by the ``model`` property:
+  * ``builtin://<name>[?k=v...]`` — deterministic fake models mirroring the
+    reference's test fixtures (tests/nnstreamer_example/custom_example_*):
+    passthrough, scaler (factor=), add (value=), average, argmax, matmul.
+  * ``<path>.py`` — a python file defining ``model(*tensors)`` (jax-traceable)
+    and optionally ``IN_INFO``/``OUT_INFO`` (TensorsInfo) declarations.
+  * ``<module>:<attr>`` — import path to a callable.
+A callable may also be handed directly via ``set_model_callable`` (used by
+the model zoo in ``nnstreamer_tpu.models``).
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import threading
+import urllib.parse
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import DataType, TensorsInfo
+from ..core.tensors import TensorSpec
+from ..registry.config import get_config
+from ..utils.log import logger
+from .base import (
+    Accelerator,
+    BackendEvent,
+    FilterBackend,
+    FilterProperties,
+    register_backend,
+)
+
+
+def _builtin_models() -> Dict[str, Callable[[dict], Callable]]:
+    import jax.numpy as jnp
+
+    def passthrough(_):
+        return lambda *xs: xs
+
+    def scaler(params):
+        f = float(params.get("factor", 2.0))
+        return lambda *xs: tuple(x * f for x in xs)
+
+    def add(params):
+        v = float(params.get("value", 1.0))
+        return lambda *xs: tuple(x + v for x in xs)
+
+    def average(_):
+        # reference custom_example_average: mean over all non-batch axes
+        return lambda *xs: tuple(
+            jnp.mean(x, axis=tuple(range(1, x.ndim)), keepdims=True) for x in xs
+        )
+
+    def argmax(_):
+        return lambda *xs: tuple(
+            jnp.argmax(x, axis=-1).astype(jnp.int32) for x in xs
+        )
+
+    def matmul(params):
+        n = int(params.get("n", 64))
+        import jax
+        w = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
+        return lambda x: (x @ w,)
+
+    return {
+        "passthrough": passthrough,
+        "scaler": scaler,
+        "add": add,
+        "average": average,
+        "argmax": argmax,
+        "matmul": matmul,
+    }
+
+
+def _as_tuple(out) -> tuple:
+    if isinstance(out, (list, tuple)):
+        return tuple(out)
+    return (out,)
+
+
+@register_backend
+class JaxBackend(FilterBackend):
+    NAME = "jax"
+    ALIASES = ("xla", "xla-tpu", "jax-tpu", "jax-cpu")
+    ACCELERATORS = (Accelerator.AUTO, Accelerator.TPU, Accelerator.CPU, Accelerator.GPU)
+    REENTRANT = True  # jitted executables are safe to call concurrently
+
+    def __init__(self):
+        super().__init__()
+        self._fn: Optional[Callable] = None
+        self._in_info: Optional[TensorsInfo] = None
+        self._out_info: Optional[TensorsInfo] = None
+        self._jit: Optional[Callable] = None
+        self._device = None
+        self._signatures: set = set()  # (shape, dtype) tuples seen
+        self._max_signatures = 32
+        self._sig_warned = False
+
+    # -- open/close ---------------------------------------------------------
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        import jax
+
+        self._select_device(props)
+        model = props.model
+        if self._fn is None:  # may be preset via set_model_callable
+            self._fn = self._load_model(model, props)
+        max_sig = props.custom_dict().get("max_signatures", "32")
+        try:
+            self._max_signatures = int(max_sig)
+        except ValueError:
+            raise ValueError(
+                f"custom=max_signatures:{max_sig!r} is not an integer")
+        logger.info("jax backend opened model=%s device=%s", model, self._device)
+
+    def _select_device(self, props: FilterProperties) -> None:
+        import jax
+
+        devices = jax.devices()
+        # explicit stage placement: custom=device:N pins this filter to chip
+        # N — consecutive pinned stages + queues = pipeline parallelism
+        # (each stage's compute and HBM live on its own chip; inter-stage
+        # buffers move device-to-device, never through host)
+        idx = props.custom_dict().get("device")
+        if idx is not None:
+            try:
+                i = int(idx)
+            except ValueError:
+                raise ValueError(
+                    f"custom=device:{idx!r} is not a device index "
+                    f"(expected 0..{len(devices) - 1})"
+                )
+            if not 0 <= i < len(devices):
+                raise ValueError(
+                    f"custom=device:{i} out of range ({len(devices)} devices)"
+                )
+            self._device = devices[i]
+            return
+        accel = props.accelerator
+        want = get_config().get("jax", "default_device", "auto")
+        if accel is not Accelerator.AUTO:
+            want = accel.value
+        if want in ("auto", ""):
+            self._device = devices[0]
+            return
+        matching = [d for d in devices if d.platform.startswith(want)]
+        self._device = matching[0] if matching else devices[0]
+        if not matching:
+            logger.warning("no %s device; falling back to %s", want, self._device)
+
+    @property
+    def device(self):
+        """The chip this backend instance is pinned to."""
+        return self._device
+
+    def set_model_callable(self, fn: Callable,
+                           in_info: Optional[TensorsInfo] = None,
+                           out_info: Optional[TensorsInfo] = None) -> None:
+        """Directly install a jax-traceable callable (model-zoo path)."""
+        self._fn = fn
+        self._in_info = in_info
+        self._out_info = out_info
+
+    def _load_model(self, model: str, props: FilterProperties) -> Callable:
+        if model.startswith("builtin://"):
+            parsed = urllib.parse.urlparse(model)
+            name = parsed.netloc or parsed.path.lstrip("/")
+            params = dict(urllib.parse.parse_qsl(parsed.query))
+            params.update(props.custom_dict())
+            builtins = _builtin_models()
+            if name not in builtins:
+                raise ValueError(
+                    f"unknown builtin model '{name}' (have: {sorted(builtins)})"
+                )
+            return builtins[name](params)
+        if model.endswith(".tflite") and os.path.exists(model):
+            # run a .tflite file on XLA: flatbuffer parsed, weights
+            # dequantized, graph re-emitted as jax (models/tflite_import.py)
+            from ..models.tflite_import import load_tflite
+
+            fn, self._in_info, self._out_info = load_tflite(
+                model, props.custom_dict())
+            return fn
+        if model.endswith(".py") and os.path.exists(model):
+            ns: Dict[str, Any] = {"__file__": model}
+            with open(model) as fh:
+                code = fh.read()
+            exec(compile(code, model, "exec"), ns)  # noqa: S102 - user model file
+            if "IN_INFO" in ns:
+                self._in_info = ns["IN_INFO"]
+            if "OUT_INFO" in ns:
+                self._out_info = ns["OUT_INFO"]
+            if "model" not in ns or not callable(ns["model"]):
+                raise ValueError(f"{model}: must define a callable 'model'")
+            return ns["model"]
+        if ":" in model and not os.path.exists(model):
+            mod_name, _, attr = model.partition(":")
+            mod = importlib.import_module(mod_name)
+            fn = getattr(mod, attr)
+            maker = getattr(fn, "make", None)
+            return maker() if maker else fn
+        raise ValueError(f"jax backend cannot load model '{model}'")
+
+    def close(self) -> None:
+        self._fn = None
+        self._jit = None
+        super().close()
+
+    # -- info ---------------------------------------------------------------
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        return self._in_info, self._out_info
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        """Derive output spec via ``jax.eval_shape`` — shape inference with
+        zero FLOPs (the reference must probe backends with real invokes)."""
+        import jax
+
+        specs = [
+            jax.ShapeDtypeStruct(s.shape, s.dtype.np_dtype) for s in in_info.specs
+        ]
+        out = jax.eval_shape(lambda *xs: _as_tuple(self._fn(*xs)), *specs)
+        self._in_info = in_info
+        self._out_info = TensorsInfo.of(
+            *(TensorSpec(o.shape, DataType.from_any(o.dtype)) for o in out)
+        )
+        return self._out_info
+
+    # -- invoke -------------------------------------------------------------
+    def _jitted(self) -> Callable:
+        # jax.jit's own trace cache keys on input signatures — one wrapper
+        # covers every shape bucket (recompiles per new signature, reuses
+        # compiled executables otherwise)
+        import jax
+
+        if self._jit is None:
+            self._jit = jax.jit(lambda *xs: _as_tuple(self._fn(*xs)))
+        return self._jit
+
+    def compile_cache_info(self) -> dict:
+        """Shape-bucketing introspection (SURVEY §7 'hard parts': flexible
+        streams recompile per signature; this makes that visible)."""
+        return {
+            "signatures": len(self._signatures),
+            "max_signatures": self._max_signatures,
+        }
+
+    def _track_signature(self, inputs: List[Any]) -> None:
+        sig = tuple((tuple(getattr(x, "shape", ())),
+                     str(getattr(x, "dtype", type(x))))
+                    for x in inputs)
+        if sig in self._signatures:
+            return
+        self._signatures.add(sig)
+        n = len(self._signatures)
+        # >= with a once-flag: concurrent invokes on this REENTRANT backend
+        # could jump past an exact-equality check and never warn
+        if n >= self._max_signatures and not self._sig_warned:
+            self._sig_warned = True
+            logger.warning(
+                "jax backend model=%s hit %d distinct input signatures — a "
+                "flexible stream is forcing XLA recompiles per shape; "
+                "bucket shapes upstream (tensor_aggregator / pad) or raise "
+                "custom=max_signatures:N to silence",
+                self.props.model if self.props else "?", n)
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        import jax
+
+        if self._fn is None:
+            raise RuntimeError("jax backend: invoke before open")
+        self._track_signature(inputs)
+        device_inputs = []
+        for x in inputs:
+            if hasattr(x, "addressable_shards"):
+                # device-resident already; move single-device arrays that sit
+                # on the WRONG chip (upstream pinned stage) onto ours —
+                # device-to-device (ICI on TPU), never through host. Sharded
+                # multi-device arrays pass through untouched (pjit stages).
+                devs = x.devices()
+                if (self._device is not None and len(devs) == 1
+                        and devs != {self._device}):
+                    x = jax.device_put(x, self._device)
+            else:
+                x = jax.device_put(x, self._device)
+            device_inputs.append(x)
+        out = self._jitted()(*device_inputs)
+        return list(out)
+
+    def handle_event(self, event: BackendEvent, data: Optional[dict] = None) -> None:
+        if event is BackendEvent.RELOAD_MODEL:
+            # Reference RELOAD_MODEL (nnstreamer_plugin_api_filter.h:378-384):
+            # old + new co-resident until swap completes.
+            new_fn = self._load_model(self.props.model, self.props)
+            self._fn = new_fn
+            self._jit = None  # recompile against the new model

@@ -1,0 +1,80 @@
+"""User-python filter backend (L4).
+
+Reference analog: ``ext/nnstreamer/tensor_filter/tensor_filter_python3.cc``
+(embedded CPython running a user class with ``setInputDim``/``invoke``,
+864 LoC). Here the host language *is* python, so the backend simply loads a
+user class from a file/module and calls it with numpy arrays — no jit, no
+tracing constraints (escape hatch for non-traceable code, e.g. OpenCV pre/post
+processing, mirroring custom_example_opencv).
+
+The model file must define a class ``Filter`` (or a factory ``filter``) with:
+  * ``invoke(self, inputs: list[np.ndarray]) -> list[np.ndarray]`` (required)
+  * ``in_info``/``out_info`` attributes or ``set_input_info(in_info)`` method
+    (optional, for negotiation).
+"""
+from __future__ import annotations
+
+import importlib
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import DataType, TensorsInfo
+from ..core.tensors import TensorSpec
+from .base import Accelerator, FilterBackend, FilterProperties, register_backend
+
+
+@register_backend
+class PythonBackend(FilterBackend):
+    NAME = "python"
+    ALIASES = ("python3",)
+    ACCELERATORS = (Accelerator.CPU,)
+
+    def __init__(self):
+        super().__init__()
+        self._obj = None
+
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        model = props.model
+        if model.endswith(".py") and os.path.exists(model):
+            ns: Dict[str, Any] = {"__file__": model}
+            with open(model) as fh:
+                exec(compile(fh.read(), model, "exec"), ns)  # noqa: S102
+            factory = ns.get("Filter") or ns.get("filter")
+        elif ":" in model:
+            mod_name, _, attr = model.partition(":")
+            factory = getattr(importlib.import_module(mod_name), attr)
+        else:
+            raise ValueError(f"python backend cannot load '{model}'")
+        if factory is None:
+            raise ValueError(f"{model}: must define class 'Filter' or callable 'filter'")
+        self._obj = factory() if isinstance(factory, type) else factory
+        if not hasattr(self._obj, "invoke"):
+            # bare callable: wrap
+            fn = self._obj
+
+            class _Wrap:
+                def invoke(self, inputs):
+                    out = fn(*inputs)
+                    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+            self._obj = _Wrap()
+
+    def close(self) -> None:
+        self._obj = None
+        super().close()
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        return (getattr(self._obj, "in_info", None), getattr(self._obj, "out_info", None))
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        if hasattr(self._obj, "set_input_info"):
+            return self._obj.set_input_info(in_info)
+        return super().set_input_info(in_info)  # base zeros-probe fallback
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        if self._obj is None:
+            raise RuntimeError("python backend: invoke before open")
+        return self._obj.invoke([np.asarray(x) for x in inputs])

@@ -1,0 +1,81 @@
+"""StableHLO / jax.export backend (L4).
+
+Loads a serialized jax-exported program (``jax.export.serialize`` bytes in a
+``.hlo``/``.stablehlo``/``.jaxexport`` file) and executes it. This is the
+"compiled artifact" deployment path — the analog of the reference's
+TensorRT-engine / tflite-flatbuffer loading backends
+(ext/nnstreamer/tensor_filter/tensor_filter_tensorrt.cc:298-350 builds an
+engine at open; we deserialize a portable StableHLO program instead).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import DataType, TensorsInfo
+from ..core.tensors import TensorSpec
+from ..utils.log import logger
+from .base import Accelerator, FilterBackend, FilterProperties, register_backend
+
+
+@register_backend
+class StableHloBackend(FilterBackend):
+    NAME = "stablehlo"
+    ALIASES = ("jax-export", "hlo")
+    ACCELERATORS = (Accelerator.AUTO, Accelerator.TPU, Accelerator.CPU)
+    REENTRANT = True
+
+    def __init__(self):
+        super().__init__()
+        self._exported = None
+        self._call = None
+
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        from jax import export
+
+        with open(props.model, "rb") as fh:
+            blob = fh.read()
+        self._exported = export.deserialize(blob)
+        self._call = self._exported.call
+        logger.info("stablehlo backend loaded %s", props.model)
+
+    def close(self) -> None:
+        self._exported = None
+        self._call = None
+        super().close()
+
+    def _info_from_avals(self, avals) -> TensorsInfo:
+        return TensorsInfo.of(
+            *(TensorSpec(tuple(a.shape), DataType.from_any(a.dtype)) for a in avals)
+        )
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        if self._exported is None:
+            return None, None
+        return (
+            self._info_from_avals(self._exported.in_avals),
+            self._info_from_avals(self._exported.out_avals),
+        )
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        if self._call is None:
+            raise RuntimeError("stablehlo backend: invoke before open")
+        out = self._call(*inputs)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return list(out)
+
+
+def export_callable(fn, example_inputs, path: str) -> None:
+    """Helper: serialize a jax callable to a ``.jaxexport`` file loadable by
+    this backend (the artifact-producing side)."""
+    import jax
+    from jax import export
+
+    args = [jax.ShapeDtypeStruct(np.asarray(a).shape, np.asarray(a).dtype)
+            for a in example_inputs]
+    exp = export.export(jax.jit(fn))(*args)
+    with open(path, "wb") as fh:
+        fh.write(exp.serialize())

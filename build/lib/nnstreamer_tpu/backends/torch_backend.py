@@ -1,0 +1,54 @@
+"""Torch (CPU) filter backend (L4).
+
+Reference analog: ``ext/nnstreamer/tensor_filter/tensor_filter_pytorch.cc``
+(TorchScript load + invoke, 775 LoC). Kept for capability parity so existing
+TorchScript models run in the pipeline; the TPU path is the jax/stablehlo
+backend. CPU-only (torch-cpu wheel in this image; the reference's
+``enable_use_gpu`` ini flag has no analog here).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import DataType, TensorsInfo
+from ..core.tensors import TensorSpec
+from ..utils.log import logger
+from .base import Accelerator, FilterBackend, FilterProperties, register_backend
+
+
+@register_backend
+class TorchBackend(FilterBackend):
+    NAME = "torch"
+    ALIASES = ("pytorch",)
+    ACCELERATORS = (Accelerator.CPU,)
+
+    def __init__(self):
+        super().__init__()
+        self._module = None
+
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        import torch
+
+        self._module = torch.jit.load(props.model, map_location="cpu")
+        self._module.eval()
+        logger.info("torch backend loaded %s", props.model)
+
+    def close(self) -> None:
+        self._module = None
+        super().close()
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        import torch
+
+        if self._module is None:
+            raise RuntimeError("torch backend: invoke before open")
+        with torch.no_grad():
+            tins = [torch.from_numpy(np.ascontiguousarray(np.asarray(x))) for x in inputs]
+            out = self._module(*tins)
+        if isinstance(out, (list, tuple)):
+            return [o.numpy() for o in out]
+        return [out.numpy()]
+    # set_input_info: inherited zeros-probe (torch has no eval_shape)

@@ -1,0 +1,9 @@
+"""Converter subplugins: external media/bytes → tensor streams.
+
+Reference analog: ``ext/nnstreamer/tensor_converter/`` (flatbuf/flexbuf/
+protobuf/python, SURVEY.md §2.6). The tensor_converter element delegates
+unknown media to these via its ``subplugin`` property.
+"""
+from .base import Converter, register_converter  # noqa: F401
+from . import bytes_converter  # noqa: F401
+from . import python_converter  # noqa: F401

@@ -1,0 +1,38 @@
+"""User-python converter (L4).
+
+Reference analog: the python3 custom converter in
+``ext/nnstreamer/tensor_converter/`` (embedded CPython user converter,
+SURVEY.md §2.6). The ``tensor_converter`` element selects it via
+``subplugin=python3 subplugin-option=<file.py>``; the file defines class
+``Converter`` with ``get_out_info(in_caps)`` and ``convert(buf)``
+(the base.Converter API).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import Buffer, Caps, TensorsInfo
+from .base import Converter, register_converter
+
+
+@register_converter
+class PythonConverter(Converter):
+    NAME = "python3"
+
+    def __init__(self, option: Optional[str] = None):
+        path = option
+        if not path:
+            raise ValueError("python3 converter: needs subplugin-option=<file.py>")
+        ns: dict = {"__file__": path}
+        with open(path) as fh:
+            exec(compile(fh.read(), path, "exec"), ns)  # noqa: S102 - user code
+        cls = ns.get("Converter")
+        if cls is None:
+            raise ValueError(f"{path}: must define class 'Converter'")
+        self._inner = cls()
+
+    def get_out_info(self, in_caps: Caps) -> TensorsInfo:
+        return self._inner.get_out_info(in_caps)
+
+    def convert(self, buf: Buffer) -> Optional[Buffer]:
+        return self._inner.convert(buf)

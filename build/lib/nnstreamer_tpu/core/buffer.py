@@ -1,0 +1,106 @@
+"""Stream buffers (L1).
+
+Reference analog: ``GstBuffer`` carrying one ``GstMemory`` chunk per tensor
+plus pts/dts/duration and attachable metas (``gst_tensor_buffer_get_nth_memory``
+/ ``append_memory``, gst/nnstreamer/nnstreamer_plugin_api_impl.c:1547-1790;
+``GstMetaQuery`` client routing, gst/nnstreamer/tensor_meta.c).
+
+TPU-first redesign: a ``Buffer`` holds a list of arrays that may live on host
+(numpy, zero-copy views) *or* on device (jax.Array) — elements that chain
+device-resident arrays between jitted stages never bounce through host memory,
+which is the reference's biggest per-frame cost (its invoke path maps/copies
+every tensor on the streaming thread, tensor_filter.c:702-816).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .tensors import DataType, TensorFormat, TensorSpec, TensorsInfo
+
+Array = Any  # np.ndarray | jax.Array
+
+
+def _is_device_array(a) -> bool:
+    return hasattr(a, "addressable_shards")  # jax.Array without importing jax here
+
+
+@dataclass
+class Buffer:
+    """One frame of a tensor (or media) stream.
+
+    ``tensors`` — the payload chunks. For ``other/tensors`` streams each entry
+    is one tensor; for media streams there is a single entry (raw frame bytes
+    viewed as an array).
+    ``pts`` — presentation timestamp, seconds (float, monotonic clock domain).
+    ``meta`` — attachable key/value metas (e.g. ``client_id`` for query
+    routing — reference ``GstMetaQuery``).
+    """
+
+    tensors: list
+    pts: Optional[float] = None
+    duration: Optional[float] = None
+    offset: Optional[int] = None  # frame sequence number
+    meta: dict = field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+    @property
+    def num_tensors(self) -> int:
+        return len(self.tensors)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(np.asarray(t).nbytes) if not _is_device_array(t) else t.nbytes
+                   for t in self.tensors)
+
+    @property
+    def on_device(self) -> bool:
+        return any(_is_device_array(t) for t in self.tensors)
+
+    def spec(self) -> TensorsInfo:
+        """Per-frame specs (the FLEXIBLE format's per-memory header analog)."""
+        return TensorsInfo.from_arrays(
+            [t for t in self.tensors], TensorFormat.FLEXIBLE
+        )
+
+    # ------------------------------------------------------------------
+    def as_numpy(self) -> "Buffer":
+        """Materialize device arrays on host. No copy for host arrays."""
+        if not self.on_device:
+            return self
+        host = [np.asarray(t) for t in self.tensors]
+        return replace(self, tensors=host)
+
+    def with_tensors(self, tensors: Sequence[Array]) -> "Buffer":
+        return replace(self, tensors=list(tensors))
+
+    def with_meta(self, **kv) -> "Buffer":
+        return replace(self, meta={**self.meta, **kv})
+
+    def copy_metadata_from(self, other: "Buffer") -> "Buffer":
+        self.pts = other.pts
+        self.duration = other.duration
+        self.offset = other.offset
+        self.meta = dict(other.meta)
+        return self
+
+    @classmethod
+    def of(cls, *tensors: Array, pts: Optional[float] = None, **kw) -> "Buffer":
+        return cls(list(tensors), pts=pts, **kw)
+
+    def __repr__(self):
+        shapes = ",".join(
+            f"{np.asarray(t).dtype if not _is_device_array(t) else t.dtype}"
+            f"{tuple(t.shape)}"
+            for t in self.tensors
+        )
+        loc = "dev" if self.on_device else "host"
+        return f"Buffer<{shapes} {loc} pts={self.pts}>"
+
+
+def clock_now() -> float:
+    """Pipeline clock: monotonic seconds (GStreamer clock analog)."""
+    return time.monotonic()

@@ -1,0 +1,108 @@
+"""Tensor frame wire format (L1/L5 shared).
+
+One binary framing used everywhere the reference uses flatbuf/protobuf/
+flexbuf serialization (ext/nnstreamer/tensor_decoder/tensordec-{flatbuf,
+flexbuf,protobuf}.*, the mqtt 1024-byte header gst/mqtt/mqttcommon.h:49-61,
+and the nns-edge data list) — header + per-tensor {dtype, shape, payload}:
+
+  magic  "NNST"  | u16 version | u32 n_tensors | f64 pts (nan=None) |
+  u32 meta_len | meta JSON | per tensor: u8 dtype_len | dtype name |
+  u8 rank | u64*rank dims | u64 nbytes | raw bytes
+"""
+from __future__ import annotations
+
+import json
+import math
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .buffer import Buffer
+from .tensors import DataType
+
+MAGIC = b"NNST"
+VERSION = 1
+
+
+def pack_tensors(buf: Buffer, extra_meta: Optional[dict] = None) -> memoryview:
+    """Serialize one frame into a single freshly-gathered buffer.
+
+    Headers are built in Python (tiny); tensor payloads are copied exactly
+    once, by one native memcpy-gather pass — the reference's encoders pay a
+    per-tensor copy plus a join copy. Returns a ``memoryview`` (socket send
+    paths consume it without another copy; call ``bytes()`` if an owning
+    immutable copy is needed).
+    """
+    from .. import native
+
+    arrays = [np.ascontiguousarray(np.asarray(t)) for t in buf.as_numpy().tensors]
+    meta = {k: v for k, v in buf.meta.items() if _jsonable(v)}
+    if extra_meta:
+        meta.update(extra_meta)
+    meta_blob = json.dumps(meta).encode()
+    parts: List[np.ndarray] = [_bview(
+        MAGIC
+        + struct.pack("<HIdI", VERSION, len(arrays),
+                      math.nan if buf.pts is None else buf.pts, len(meta_blob))
+        + meta_blob
+    )]
+    for a in arrays:
+        dt = DataType.from_any(a.dtype).value.encode()
+        header = (
+            struct.pack("<B", len(dt)) + dt + struct.pack("<B", a.ndim)
+            + struct.pack(f"<{a.ndim}Q", *a.shape) + struct.pack("<Q", a.nbytes)
+        )
+        parts.append(_bview(header))
+        parts.append(a.reshape(-1).view(np.uint8))
+    return native.gather(parts).data
+
+
+def _bview(b: bytes) -> np.ndarray:
+    return np.frombuffer(b, np.uint8)
+
+
+def unpack_tensors(blob) -> Buffer:
+    """Deserialize one frame from any contiguous byte buffer (bytes,
+    bytearray, memoryview, or uint8 ndarray)."""
+    blob = memoryview(blob).cast("B")
+    if bytes(blob[:4]) != MAGIC:
+        raise ValueError("bad tensor frame magic")
+    off = 4
+    version, n, pts, meta_len = struct.unpack_from("<HIdI", blob, off)
+    if version != VERSION:
+        raise ValueError(f"unsupported frame version {version}")
+    off += struct.calcsize("<HIdI")
+    meta = json.loads(bytes(blob[off:off + meta_len]) or b"{}")
+    off += meta_len
+    tensors = []
+    for _ in range(n):
+        (dt_len,) = struct.unpack_from("<B", blob, off)
+        off += 1
+        dtype = DataType(bytes(blob[off:off + dt_len]).decode())
+        off += dt_len
+        (rank,) = struct.unpack_from("<B", blob, off)
+        off += 1
+        shape = struct.unpack_from(f"<{rank}Q", blob, off)
+        off += 8 * rank
+        (nbytes,) = struct.unpack_from("<Q", blob, off)
+        off += 8
+        a = np.frombuffer(blob, dtype.np_dtype, count=int(np.prod(shape)) if shape else 1,
+                          offset=off)
+        if not shape:
+            a = a[:1].reshape(())
+        else:
+            a = a.reshape(shape)
+        tensors.append(a.copy())
+        off += nbytes
+    out = Buffer(tensors, pts=None if math.isnan(pts) else pts)
+    out.meta.update(meta)
+    return out
+
+
+def _jsonable(v) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False

@@ -1,0 +1,12 @@
+"""Decoder subplugins: tensor streams → media streams.
+
+Reference analog: ``ext/nnstreamer/tensor_decoder/`` (13 modes, SURVEY.md
+§2.5). Importing this package registers every built-in decoder.
+"""
+from .base import Decoder, register_decoder  # noqa: F401
+from . import simple  # noqa: F401
+from . import font  # noqa: F401
+from . import bounding_boxes  # noqa: F401
+from . import segment_pose  # noqa: F401
+from . import serialize  # noqa: F401
+from . import python_decoder  # noqa: F401

@@ -1,0 +1,38 @@
+"""Decoder subplugin vtable (L2).
+
+Reference analog: ``GstTensorDecoderDef``
+(gst/nnstreamer/include/nnstreamer_plugin_api_decoder.h:39-97 —
+``modename/init/exit/setOption/getOutCaps/decode``). Options arrive as the
+``option1..option9`` strings of the tensor_decoder element.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core import Buffer, Caps, TensorsInfo
+from ..registry.subplugin import SubpluginKind, register
+
+
+class Decoder:
+    MODE = ""
+
+    def init(self, options: List[Optional[str]]) -> None:
+        """Receive option1..optionN (None where unset)."""
+        self.options = options
+
+    def option(self, n: int, default: Optional[str] = None) -> Optional[str]:
+        """1-based option access."""
+        if 1 <= n <= len(self.options) and self.options[n - 1] is not None:
+            return self.options[n - 1]
+        return default
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        raise NotImplementedError
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        raise NotImplementedError
+
+
+def register_decoder(cls):
+    register(SubpluginKind.DECODER, cls.MODE, cls)
+    return cls

@@ -1,0 +1,294 @@
+"""bounding_boxes decoder: detections → video overlay (L4).
+
+Reference analog: ``ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c``
+(2292 LoC, 9 box formats at :157-203). Supported modes here (option1):
+
+  * ``mobilenet-ssd-postprocess`` (aka ``tf-ssd``): tensors
+    [boxes (N,4) norm ymin,xmin,ymax,xmax; scores (N,) or (N,C)];
+  * ``mobilenet-ssd``: RAW head tensors [locations (N,4) center-variance
+    offsets; class logits (N,C)] + a prior-box file (option7, ``.npy``
+    (N,4) [cy,cx,h,w] — the reference's box_priors.txt role); sigmoid
+    scores, anchors decoded on host via models.ssd_mobilenet.decode_boxes_np;
+  * ``yolov5``: (N, 5+C) rows [cx,cy,w,h,obj,cls...] (pixels or normalized);
+  * ``yolov8``: (4+C, N) or (N, 4+C) rows [cx,cy,w,h,cls...];
+  * ``ov-person-detection`` / ``ov-face-detection``: one tensor of
+    (N, 7) rows [image_id, label, conf, xmin, ymin, xmax, ymax]
+    (normalized); rows end at the first negative image_id; confidence
+    threshold 0.8, no NMS (the model already applies it) — reference
+    ``_get_persons_ov`` (tensordec-boundingbox.c:1675) and the caps check
+    [7, 200] (:1172-1188);
+  * ``mp-palm-detection``: tensors [boxes (N,18), scores (N,)] against
+    SSD-style anchors generated for the 192×192 palm model (reference
+    ``_mp_palm_detection_generate_anchors`` :673-755); sigmoid scores
+    clamped to ±100, anchor-relative decode, NMS IoU 0.05
+    (:1726-1770, :2160);
+  * ``custom``: a registered python callback (register_bbox_parser).
+
+Options (reference option2..): option2 = "W:H" output video size;
+option3 = labels file; option4 = score threshold; option5 = IoU threshold
+(both default per mode: 0.25/0.5 generally, 0.8/none for ov-*, 0.5/0.05
+for mp-palm); option8 = "W:H" model input size (palm decode scale,
+default 192:192); option9 = palm anchor params
+"layers:min_scale:max_scale:offset_x:offset_y:stride0:stride1:..."
+(reference option3 tail for mp-palm-detection).
+Output: RGBA video frame with box rectangles drawn (transparent background,
+to be alpha-blended over the source video — the reference's ``compositor``
+pattern); decoded detections also ride in ``buf.meta["detections"]``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, TensorsInfo
+from ..core.caps import VIDEO_MIME
+from ..ops.nms import nms_numpy
+from .base import Decoder, register_decoder
+
+_custom_parsers: Dict[str, Callable] = {}
+
+
+def register_bbox_parser(name: str, fn: Callable) -> None:
+    """fn(tensors) -> (boxes (N,4) normalized [ymin,xmin,ymax,xmax], scores
+    (N,), classes (N,))."""
+    _custom_parsers[name] = fn
+
+
+@register_decoder
+class BoundingBoxes(Decoder):
+    MODE = "bounding_boxes"
+
+    def init(self, options):
+        super().init(options)
+        self.fmt = self.option(1, "mobilenet-ssd-postprocess")
+        wh = self.option(2, "320:240").split(":")
+        self.width, self.height = int(wh[0]), int(wh[1])
+        self.labels: List[str] = []
+        path = self.option(3)
+        if path:
+            with open(path) as fh:
+                self.labels = [ln.strip() for ln in fh if ln.strip()]
+        # per-mode reference defaults: ov-* uses a fixed 0.8 confidence gate
+        # and no NMS (OV_PERSON_DETECTION_CONF_THRESHOLD); mp-palm uses
+        # sigmoid-score 0.5 and a tight 0.05 IoU NMS (tensordec-boundingbox.c)
+        if self.fmt in ("ov-person-detection", "ov-face-detection"):
+            default_score, default_iou, self.use_nms = "0.8", "0.5", False
+        elif self.fmt == "mp-palm-detection":
+            default_score, default_iou, self.use_nms = "0.5", "0.05", True
+        else:
+            default_score, default_iou, self.use_nms = "0.25", "0.5", True
+        self.score_threshold = float(self.option(4, default_score))
+        self.iou_threshold = float(self.option(5, default_iou))
+        in_wh = self.option(8, "192:192").split(":")
+        self.in_width, self.in_height = int(in_wh[0]), int(in_wh[1])
+        if self.fmt == "mp-palm-detection":
+            self.palm_anchors = _palm_anchors(self.option(9), self.in_width)
+        # yolov8 tensor layout: auto | boxes-first ((N,4+C) rows) |
+        # coords-first ((4+C,N) columns). auto transposes when the first dim
+        # is smaller — right for real heads (84, 8400) but ambiguous when
+        # N < 4+C, hence the override.
+        self.layout = self.option(6, "auto")
+        self.anchors = None
+        priors = self.option(7)
+        if priors:
+            self.anchors = np.load(priors).astype(np.float32)
+        elif self.fmt in ("mobilenet-ssd", "tflite-ssd"):
+            raise ValueError(
+                "bounding_boxes: mobilenet-ssd (raw) needs option7=<priors.npy>")
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        return Caps.new(VIDEO_MIME, format="RGBA", width=self.width, height=self.height)
+
+    # -- per-format parsing → normalized boxes ------------------------------
+    def _parse(self, tensors) -> tuple:
+        fmt = self.fmt
+        if fmt in ("mobilenet-ssd", "tflite-ssd"):  # tflite-ssd = old name
+            from ..models.ssd_mobilenet import decode_boxes_np
+
+            loc = np.asarray(tensors[0]).reshape(-1, 4).astype(np.float32)
+            logits = np.asarray(tensors[1]).astype(np.float32)
+            logits = logits.reshape(loc.shape[0], -1)
+            boxes = decode_boxes_np(loc, self.anchors)
+            scores = 1.0 / (1.0 + np.exp(-logits))  # sigmoid
+            classes = scores.argmax(-1)
+            return boxes, scores.max(-1), classes
+        if fmt in ("ov-person-detection", "ov-face-detection"):
+            a = np.asarray(tensors[0]).astype(np.float32).reshape(-1, 7)
+            # rows: [image_id, label, conf, xmin, ymin, xmax, ymax]; the
+            # detection list terminates at the first negative image_id
+            end = np.nonzero(a[:, 0] < 0)[0]
+            if end.size:
+                a = a[: end[0]]
+            boxes = a[:, [4, 3, 6, 5]]  # -> [ymin, xmin, ymax, xmax]
+            # class_id = -1 in the reference (no label set for ov modes)
+            classes = np.full(a.shape[0], -1, np.int64)
+            return boxes, a[:, 2], classes
+        if fmt == "mp-palm-detection":
+            anchors = self.palm_anchors  # (A, 4) [x_center, y_center, w, h]
+            raw = np.asarray(tensors[0]).astype(np.float32).reshape(-1, 18)
+            scores = np.asarray(tensors[1]).astype(np.float32).reshape(-1)
+            if len(raw) != len(anchors) or len(scores) != len(anchors):
+                raise ValueError(
+                    f"mp-palm-detection: {len(raw)} box rows / {len(scores)} "
+                    f"scores vs {len(anchors)} anchors — check option8 "
+                    "(model input size) and option9 (anchor params)"
+                )
+            n = len(anchors)
+            anc = anchors
+            clipped = np.clip(scores.astype(np.float64), -100.0, 100.0)
+            scores = (1.0 / (1.0 + np.exp(-clipped))).astype(np.float32)
+            # anchor-relative decode: offsets scaled by the model input size
+            yc = raw[:, 0] / self.in_height * anc[:, 3] + anc[:, 1]
+            xc = raw[:, 1] / self.in_width * anc[:, 2] + anc[:, 0]
+            h = raw[:, 2] / self.in_height * anc[:, 3]
+            w = raw[:, 3] / self.in_width * anc[:, 2]
+            boxes = np.stack([yc - h / 2, xc - w / 2, yc + h / 2, xc + w / 2], axis=1)
+            return boxes, scores, np.zeros(n, np.int64)
+        if fmt in ("mobilenet-ssd-postprocess", "tf-ssd"):
+            boxes = np.asarray(tensors[0]).reshape(-1, 4).astype(np.float32)
+            scores = np.asarray(tensors[1]).astype(np.float32)
+            if scores.ndim > 1:
+                scores = scores.reshape(boxes.shape[0], -1)
+                classes = scores.argmax(-1)
+                scores = scores.max(-1)
+            else:
+                scores = scores.reshape(-1)
+                classes = np.zeros(scores.shape[0], np.int64)
+            return boxes, scores, classes
+        if fmt in ("yolov5", "yolov8"):
+            a = np.asarray(tensors[0]).astype(np.float32)
+            a = a.reshape(-1, a.shape[-1]) if a.ndim > 2 else a
+            if a.size == 0:  # zero candidates: legal on flexible streams
+                empty = np.zeros((0,), np.float32)
+                return np.zeros((0, 4), np.float32), empty, empty.astype(np.int64)
+            if fmt == "yolov8":
+                transpose = (
+                    self.layout == "coords-first"
+                    or (self.layout == "auto" and a.shape[0] < a.shape[1])
+                )
+                if transpose:  # (4+C, N) layout
+                    a = a.T
+                cxcywh, cls = a[:, :4], a[:, 4:]
+                scores = cls.max(-1)
+                classes = cls.argmax(-1)
+            else:
+                cxcywh, obj, cls = a[:, :4], a[:, 4], a[:, 5:]
+                cls_score = cls.max(-1) if cls.size else np.ones_like(obj)
+                scores = obj * cls_score
+                classes = cls.argmax(-1) if cls.size else np.zeros(len(obj), np.int64)
+            # normalize if values look like pixels
+            scale = (
+                np.array([self.width, self.height, self.width, self.height], np.float32)
+                if cxcywh.max() > 2.0
+                else np.ones(4, np.float32)
+            )
+            cx, cy = cxcywh[:, 0] / scale[0], cxcywh[:, 1] / scale[1]
+            w, h = cxcywh[:, 2] / scale[2], cxcywh[:, 3] / scale[3]
+            boxes = np.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2], axis=1)
+            return boxes, scores, classes
+        if fmt in _custom_parsers:
+            return _custom_parsers[fmt](tensors)
+        raise ValueError(f"bounding_boxes: unknown format '{self.fmt}'")
+
+    # -- decode -------------------------------------------------------------
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        boxes, scores, classes = self._parse(buf.tensors)
+        if self.use_nms:
+            keep = nms_numpy(boxes, scores, self.iou_threshold, self.score_threshold)
+        else:  # ov-*: the model already suppressed; threshold only
+            keep = np.nonzero(scores >= self.score_threshold)[0]
+        frame = np.zeros((self.height, self.width, 4), np.uint8)
+        detections = []
+        for i in keep:
+            ymin, xmin, ymax, xmax = np.clip(boxes[i], 0.0, 1.0)
+            x1, y1 = int(xmin * self.width), int(ymin * self.height)
+            x2, y2 = int(xmax * self.width), int(ymax * self.height)
+            cls = int(classes[i])
+            color = _class_color(cls)
+            _draw_rect(frame, x1, y1, x2, y2, color)
+            detections.append({
+                "box": [x1, y1, x2 - x1, y2 - y1],
+                "score": float(scores[i]),
+                "class": cls,
+                "label": self.labels[cls] if 0 <= cls < len(self.labels) else str(cls),
+            })
+        out = Buffer([frame])
+        out.meta["detections"] = detections
+        return out
+
+
+def _palm_scale(min_scale: float, max_scale: float, idx: int, n: int) -> float:
+    if n == 1:
+        return (min_scale + max_scale) * 0.5
+    return min_scale + (max_scale - min_scale) * idx / (n - 1.0)
+
+
+def _palm_anchors(params: Optional[str], input_size: int = 192) -> np.ndarray:
+    """SSD anchor grid for the mediapipe palm model.
+
+    Layers sharing a stride are folded into one grid with 2 anchors per
+    same-stride layer per cell; defaults (4 layers, strides 8:16:16:16,
+    scales 1.0, 192×192 input) yield 2016 anchors — reference
+    ``_mp_palm_detection_generate_anchors`` (tensordec-boundingbox.c:673;
+    the reference hardcodes 192, here the grid follows the option8 input
+    size so non-192 palm variants decode against a matching grid).
+    Returns (A, 4) float32 [x_center, y_center, w, h], normalized.
+    """
+    num_layers, min_scale, max_scale = 4, 1.0, 1.0
+    offset_x, offset_y = 0.5, 0.5
+    strides = [8, 16, 16, 16]
+    if params:
+        parts = [p for p in str(params).split(":")]
+        vals = [float(p) if p else None for p in parts]
+        if len(vals) > 0 and vals[0] is not None:
+            num_layers = int(vals[0])
+        if len(vals) > 1 and vals[1] is not None:
+            min_scale = vals[1]
+        if len(vals) > 2 and vals[2] is not None:
+            max_scale = vals[2]
+        if len(vals) > 3 and vals[3] is not None:
+            offset_x = vals[3]
+        if len(vals) > 4 and vals[4] is not None:
+            offset_y = vals[4]
+        given = [int(v) for v in vals[5:] if v is not None]
+        if given:
+            strides = given
+    strides = (strides + [strides[-1]] * num_layers)[:num_layers]
+    out = []
+    layer = 0
+    while layer < num_layers:
+        sizes = []  # (w, h) per anchor at each cell
+        last = layer
+        while last < num_layers and strides[last] == strides[layer]:
+            for idx in (last, last + 1):
+                s = _palm_scale(min_scale, max_scale, idx, num_layers)
+                sizes.append((s, s))  # aspect ratio 1.0 twice per layer
+            last += 1
+        fm = int(np.ceil(input_size / strides[layer]))
+        for y in range(fm):
+            for x in range(fm):
+                for w, h in sizes:
+                    out.append(((x + offset_x) / fm, (y + offset_y) / fm, w, h))
+        layer = last
+    return np.asarray(out, np.float32)
+
+
+def _class_color(cls: int) -> np.ndarray:
+    rng = np.random.default_rng(cls + 1)
+    rgb = rng.integers(64, 255, 3)
+    return np.array([*rgb, 255], np.uint8)
+
+
+def _draw_rect(frame: np.ndarray, x1: int, y1: int, x2: int, y2: int,
+               color: np.ndarray, thickness: int = 2) -> None:
+    h, w = frame.shape[:2]
+    x1, x2 = max(x1, 0), min(x2, w - 1)
+    y1, y2 = max(y1, 0), min(y2, h - 1)
+    if x2 <= x1 or y2 <= y1:
+        return
+    t = thickness
+    frame[y1:y1 + t, x1:x2] = color
+    frame[max(y2 - t, 0):y2, x1:x2] = color
+    frame[y1:y2, x1:x1 + t] = color
+    frame[y1:y2, max(x2 - t, 0):x2] = color

@@ -1,0 +1,38 @@
+"""User-python decoder (L4).
+
+Reference analog: ``tensordec-python3.cc`` (393 LoC — embedded CPython user
+decoder class). option1 = path to a .py file defining class ``Decoder`` with
+``get_out_caps(in_info)`` and ``decode(buf, in_info)`` (base.Decoder API).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import Buffer, Caps, TensorsInfo
+from .base import Decoder, register_decoder
+
+
+@register_decoder
+class PythonDecoder(Decoder):
+    MODE = "python3"
+
+    def init(self, options):
+        super().init(options)
+        path = self.option(1)
+        if not path:
+            raise ValueError("python3 decoder: option1 must be a .py file")
+        ns: dict = {"__file__": path}
+        with open(path) as fh:
+            exec(compile(fh.read(), path, "exec"), ns)  # noqa: S102 - user decoder
+        cls = ns.get("Decoder")
+        if cls is None:
+            raise ValueError(f"{path}: must define class 'Decoder'")
+        self._inner = cls()
+        if hasattr(self._inner, "init"):
+            self._inner.init(options[1:])
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        return self._inner.get_out_caps(in_info)
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        return self._inner.decode(buf, in_info)

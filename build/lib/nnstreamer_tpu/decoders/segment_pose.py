@@ -1,0 +1,124 @@
+"""image_segment + pose_estimation decoders (L4).
+
+Reference analogs (ext/nnstreamer/tensor_decoder/):
+  * ``tensordec-imagesegment.c`` (665 LoC) — per-pixel class map → colored
+    video (tflite-deeplab palette);
+  * ``tensordec-pose.c`` (845 LoC) — keypoint heatmaps/coords → skeleton
+    drawing.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, TensorsInfo
+from ..core.caps import VIDEO_MIME
+from .base import Decoder, register_decoder
+
+
+def _palette(n: int = 32) -> np.ndarray:
+    rng = np.random.default_rng(7)
+    pal = rng.integers(0, 255, (n, 3)).astype(np.uint8)
+    pal[0] = 0  # background black
+    return pal
+
+
+@register_decoder
+class ImageSegment(Decoder):
+    """option1 = format: tflite-deeplab (H,W,C logits) | snpe-deeplab (H,W)
+    class ids | snpe-depth (H,W) scalar depth map."""
+
+    MODE = "image_segment"
+
+    def init(self, options):
+        super().init(options)
+        self.fmt = self.option(1, "tflite-deeplab")
+        self.pal = _palette()
+
+    def _hw(self, in_info: TensorsInfo):
+        shape = in_info.specs[0].shape if in_info.specs else None
+        if shape is None:
+            return None
+        s = shape[1:] if len(shape) == 4 else shape
+        return s[0], s[1]
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        hw = self._hw(in_info)
+        if hw is None:
+            return Caps.new(VIDEO_MIME, format="RGB")
+        return Caps.new(VIDEO_MIME, format="RGB", width=hw[1], height=hw[0])
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        a = np.asarray(buf.tensors[0])
+        if a.ndim == 4:
+            a = a[0]
+        if self.fmt == "snpe-depth":
+            d = a.astype(np.float32)
+            d = (255 * (d - d.min()) / max(float(d.max() - d.min()), 1e-9)).astype(np.uint8)
+            return Buffer([np.repeat(d[..., None] if d.ndim == 2 else d, 3, axis=-1)])
+        classes = a.argmax(-1) if a.ndim == 3 else a.astype(np.int64)
+        frame = self.pal[classes % len(self.pal)]
+        out = Buffer([frame.astype(np.uint8)])
+        out.meta["class_map"] = classes
+        return out
+
+
+# COCO-17 skeleton edges (the reference draws a similar fixed skeleton)
+_EDGES = [
+    (0, 1), (0, 2), (1, 3), (2, 4), (5, 6), (5, 7), (7, 9), (6, 8), (8, 10),
+    (5, 11), (6, 12), (11, 12), (11, 13), (13, 15), (12, 14), (14, 16),
+]
+
+
+@register_decoder
+class PoseEstimation(Decoder):
+    """option1 = "W:H" output size; option2 = input mode: "heatmap" (H,W,K
+    keypoint heatmaps, posenet-style) or "coords" ((K,2|3) normalized x,y[,s]).
+    """
+
+    MODE = "pose_estimation"
+
+    def init(self, options):
+        super().init(options)
+        wh = self.option(1, "320:240").split(":")
+        self.width, self.height = int(wh[0]), int(wh[1])
+        self.mode = self.option(2, "heatmap")
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        return Caps.new(VIDEO_MIME, format="RGBA", width=self.width, height=self.height)
+
+    def _keypoints(self, t: np.ndarray) -> np.ndarray:
+        if self.mode == "coords":
+            k = t.reshape(-1, t.shape[-1])[:, :2]
+            return k  # normalized (x, y)
+        a = t[0] if t.ndim == 4 else t  # (H,W,K)
+        hh, ww, kk = a.shape
+        flat = a.reshape(-1, kk)
+        idx = flat.argmax(0)
+        ys, xs = np.unravel_index(idx, (hh, ww))
+        return np.stack([xs / max(ww - 1, 1), ys / max(hh - 1, 1)], axis=1)
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        kps = self._keypoints(np.asarray(buf.tensors[0]).astype(np.float32))
+        frame = np.zeros((self.height, self.width, 4), np.uint8)
+        pts = np.stack(
+            [np.clip(kps[:, 0] * (self.width - 1), 0, self.width - 1),
+             np.clip(kps[:, 1] * (self.height - 1), 0, self.height - 1)],
+            axis=1,
+        ).astype(np.int64)
+        for x, y in pts:
+            frame[max(y - 2, 0):y + 3, max(x - 2, 0):x + 3] = (0, 255, 0, 255)
+        for a, b in _EDGES:
+            if a < len(pts) and b < len(pts):
+                _draw_line(frame, pts[a], pts[b], (255, 255, 0, 255))
+        out = Buffer([frame])
+        out.meta["keypoints"] = kps
+        return out
+
+
+def _draw_line(frame: np.ndarray, p0, p1, color) -> None:
+    n = int(max(abs(int(p1[0]) - int(p0[0])), abs(int(p1[1]) - int(p0[1])), 1))
+    xs = np.linspace(p0[0], p1[0], n + 1).astype(np.int64)
+    ys = np.linspace(p0[1], p1[1], n + 1).astype(np.int64)
+    frame[ys, xs] = color

@@ -1,0 +1,142 @@
+"""Simple decoders: direct_video, image_labeling, octet_stream, tensor_region.
+
+Reference analogs (ext/nnstreamer/tensor_decoder/):
+  * ``tensordec-directvideo.c`` (387 LoC) — tensor → video/x-raw;
+  * ``tensordec-imagelabel.c`` (274 LoC) — argmax + label file → text;
+  * ``tensordec-octetstream.c`` (130 LoC) — tensors → opaque bytes;
+  * ``tensordec-tensor_region.c`` (784 LoC) — detections → crop regions
+    consumed by tensor_crop.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, TensorFormat, TensorsInfo
+from ..core.caps import OCTET_MIME, TEXT_MIME, VIDEO_MIME, caps_from_tensors_info
+from .base import Decoder, register_decoder
+
+
+@register_decoder
+class DirectVideo(Decoder):
+    """Interpret a (1,H,W,C) / (H,W,C) tensor as a raw video frame."""
+
+    MODE = "direct_video"
+
+    _FMT = {1: "GRAY8", 3: "RGB", 4: "RGBA"}
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        if not in_info.specs:
+            return Caps.new(VIDEO_MIME)
+        shape = in_info.specs[0].shape
+        if len(shape) == 4:
+            _, h, w, c = shape
+        elif len(shape) == 3:
+            h, w, c = shape
+        else:
+            return None
+        fmt = self.option(1, self._FMT.get(c))
+        if fmt is None:
+            return None
+        return Caps.new(VIDEO_MIME, format=fmt, width=w, height=h)
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        a = np.asarray(buf.tensors[0])
+        if a.ndim == 4:
+            a = a[0]
+        if a.dtype != np.uint8:
+            a = np.clip(a, 0, 255).astype(np.uint8)
+        return Buffer([a])
+
+
+@register_decoder
+class ImageLabeling(Decoder):
+    """argmax over class scores + label file → text stream of the label.
+
+    option1 = labels file (one label per line, reference behavior).
+    """
+
+    MODE = "image_labeling"
+
+    def init(self, options):
+        super().init(options)
+        self.labels: List[str] = []
+        path = self.option(1)
+        if path:
+            with open(path) as fh:
+                self.labels = [ln.strip() for ln in fh if ln.strip()]
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        return Caps.new(TEXT_MIME)
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        scores = np.asarray(buf.tensors[0])
+        # batched input (aggregator upstream): one label per leading-dim frame;
+        # the reference only ever sees batch=1 (tensordec-imagelabel.c argmax).
+        # Only treat the leading axis as batch when the remaining axes hold
+        # the class scores — a (C,1) single-frame layout must not split.
+        if scores.ndim >= 2 and scores.shape[0] > 1 and np.prod(scores.shape[1:]) > 1:
+            idxs = [int(i) for i in scores.reshape(scores.shape[0], -1).argmax(-1)]
+        else:
+            idxs = [int(np.argmax(scores.reshape(-1)))]
+        labels = [
+            self.labels[i] if i < len(self.labels) else str(i) for i in idxs
+        ]
+        text = "\n".join(labels)
+        out = Buffer([np.frombuffer(text.encode(), np.uint8)])
+        out.meta["label_index"] = idxs[0]
+        out.meta["label"] = labels[0]
+        out.meta["label_indices"] = idxs
+        out.meta["labels"] = labels
+        return out
+
+
+@register_decoder
+class OctetStream(Decoder):
+    MODE = "octet_stream"
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        return Caps.new(OCTET_MIME)
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        raw = b"".join(np.ascontiguousarray(t).tobytes() for t in buf.tensors)
+        return Buffer([np.frombuffer(raw, np.uint8)])
+
+
+@register_decoder
+class TensorRegion(Decoder):
+    """Detections → (N,4) int32 [x,y,w,h] crop regions for tensor_crop.
+
+    Input: boxes (N,4) normalized [ymin,xmin,ymax,xmax] + scores (N,) or
+    (N,classes). option1 = number of regions to emit (default 1);
+    option2 = "W:H" frame size to denormalize to (default 1:1 = keep norm).
+    """
+
+    MODE = "tensor_region"
+
+    def init(self, options):
+        super().init(options)
+        self.num = int(self.option(1, "1"))
+        wh = self.option(2, "1:1").split(":")
+        self.frame_w, self.frame_h = int(wh[0]), int(wh[1])
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        return caps_from_tensors_info(TensorsInfo((), TensorFormat.FLEXIBLE))
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        boxes = np.asarray(buf.tensors[0]).reshape(-1, 4).astype(np.float32)
+        scores = np.asarray(buf.tensors[1]).astype(np.float32) if buf.num_tensors > 1 else None
+        if scores is not None:
+            if scores.ndim > 1:
+                scores = scores.max(axis=-1)
+            order = np.argsort(-scores.reshape(-1))[: self.num]
+        else:
+            order = np.arange(min(self.num, boxes.shape[0]))
+        sel = boxes[order]
+        ymin, xmin, ymax, xmax = sel[:, 0], sel[:, 1], sel[:, 2], sel[:, 3]
+        x = np.round(xmin * self.frame_w).astype(np.int32)
+        y = np.round(ymin * self.frame_h).astype(np.int32)
+        w = np.round((xmax - xmin) * self.frame_w).astype(np.int32)
+        h = np.round((ymax - ymin) * self.frame_h).astype(np.int32)
+        return Buffer([np.stack([x, y, w, h], axis=1)])

@@ -1,0 +1,142 @@
+"""tensor_aggregator: frame batching / windowing (L3).
+
+Reference analog: ``gst/nnstreamer/elements/gsttensor_aggregator.c`` (1081
+LoC) — the reference's only batching primitive: accumulate ``frames-in``-unit
+frames, emit ``frames-out`` concatenated along ``frames-dim``, slide by
+``frames-flush`` (SURVEY.md §2.3). TPU significance: this is the dynamic
+batcher in front of the MXU — batching N stream frames into one compiled
+invocation is how a streaming workload fills the systolic array.
+
+Semantics: each input buffer holds ``frames-in`` frames along axis
+``frames-dim``. The element re-chunks the stream into output buffers of
+``frames-out`` frames, advancing by ``frames-flush`` frames (default:
+``frames-out``, i.e. non-overlapping; smaller = sliding window).
+``concat=false`` stacks on a new leading axis instead.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import (
+    Buffer,
+    Caps,
+    TensorFormat,
+    TensorsInfo,
+    caps_from_tensors_info,
+    tensors_info_from_caps,
+)
+from ..core.tensors import TensorSpec
+from ..registry.elements import register_element
+from ..runtime.element import ElementError, Prop, TransformElement, prop_bool
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+
+
+@register_element
+class TensorAggregator(TransformElement):
+    ELEMENT_NAME = "tensor_aggregator"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    PROPERTIES = {
+        "frames_in": Prop(1, int, "frames per incoming buffer along frames-dim"),
+        "frames_out": Prop(1, int, "frames per outgoing buffer"),
+        "frames_flush": Prop(0, int, "frames to advance per output (0 = frames-out)"),
+        "frames_dim": Prop(0, int, "axis holding the frame dimension"),
+        "concat": Prop(True, prop_bool, "concat along frames-dim (else stack new axis)"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._window: List[np.ndarray] = []  # accumulated per-tensor windows
+        self._window_device = False  # latches on first device-resident frame
+        self._out_info: Optional[TensorsInfo] = None
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        info = tensors_info_from_caps(caps)
+        fi, fo = self.props["frames_in"], self.props["frames_out"]
+        dim = self.props["frames_dim"]
+        if info.format is not TensorFormat.STATIC or not info.specs:
+            self._out_info = TensorsInfo((), TensorFormat.FLEXIBLE)
+            return
+        specs = []
+        for s in info.specs:
+            if dim >= len(s.shape):
+                raise ElementError(
+                    f"{self.describe()}: frames-dim {dim} out of range for {s.describe()}"
+                )
+            if self.props["concat"]:
+                per_frame = s.shape[dim] // max(fi, 1)
+                shape = list(s.shape)
+                shape[dim] = per_frame * fo
+                specs.append(TensorSpec(tuple(shape), s.dtype))
+            else:
+                specs.append(TensorSpec((fo, *s.shape), s.dtype))
+        self._out_info = TensorsInfo.of(*specs)
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        return caps_from_tensors_info(self._out_info)
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        fi = max(self.props["frames_in"], 1)
+        fo = self.props["frames_out"]
+        flush = self.props["frames_flush"] or fo
+        dim = self.props["frames_dim"]
+        # device residency: jax arrays stay on device (slice/concat are
+        # jitted device ops), so filter→aggregator chains never bounce
+        # through host; plain numpy input stays numpy (host batching path).
+        # Once any device frame is in the window, the stream stays device-
+        # resident (a stray host frame must not drag buffered device frames
+        # back through a blocking D2H).
+        from ..core.buffer import _is_device_array
+
+        if buf.on_device:
+            self._window_device = True
+        if self._window_device:
+            import jax.numpy as jnp
+
+            xp = jnp
+            arrays = [t if _is_device_array(t) else jnp.asarray(t)
+                      for t in buf.tensors]
+        else:
+            xp = np
+            arrays = [np.asarray(t) for t in buf.as_numpy().tensors]
+        # split the incoming buffer into per-frame slices along frames-dim
+        frames = []
+        for f in range(fi):
+            per = [self._slice_frame(a, f, fi, dim) for a in arrays]
+            frames.append(per)
+        self._window.extend(frames)
+        out = None
+        while len(self._window) >= fo:
+            chunk = self._window[:fo]
+            if self.props["concat"]:
+                tensors = [
+                    xp.concatenate([c[i] for c in chunk], axis=dim)
+                    for i in range(len(arrays))
+                ]
+            else:
+                tensors = [
+                    xp.stack([c[i] for c in chunk], axis=0)
+                    for i in range(len(arrays))
+                ]
+            out = Buffer(tensors).copy_metadata_from(buf)
+            self.push(out)
+            self._window = self._window[flush:]
+        return None  # pushes happen inline above
+
+    @staticmethod
+    def _slice_frame(a, idx: int, total: int, dim: int):
+        size = a.shape[dim] // total
+        sl = [slice(None)] * a.ndim
+        sl[dim] = slice(idx * size, (idx + 1) * size)
+        return a[tuple(sl)]
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._window = []
+        self._window_device = False
+
+    def handle_eos(self) -> None:
+        self._window = []
+        super().handle_eos()

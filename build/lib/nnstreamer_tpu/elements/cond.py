@@ -1,0 +1,155 @@
+"""tensor_if: data-dependent control flow inside the pipeline (L3).
+
+Reference analog: ``gst/nnstreamer/elements/gsttensor_if.c`` (1212 LoC) —
+compared-value (A_VALUE / TENSOR_TOTAL_VALUE / TENSOR_AVERAGE_VALUE / CUSTOM,
+gsttensor_if.h:42-55), 10 operators (:60-72), then/else behaviors (:79-91)
+including PASSTHROUGH / SKIP / FILL_ZERO / FILL_VALUES / TENSORPICK, and
+registerable python callback conditions (custom_cb_s :112).
+
+Note the pipeline-level condition runs on host per frame (a scalar decision —
+the reference does the same); *inside* a jitted model data-dependent branches
+must use lax.cond, which model code is free to do.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps
+from ..core.data import parse_number
+from ..registry.elements import register_element
+from ..runtime.element import ElementError, Prop, TransformElement
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+
+_custom_conditions: Dict[str, Callable] = {}
+
+
+def register_if_condition(name: str, fn: Callable[[Buffer], bool]) -> None:
+    """Register a python condition callback (reference
+    ``gst_tensor_if_register_custom_callback``)."""
+    _custom_conditions[name] = fn
+
+
+def unregister_if_condition(name: str) -> bool:
+    return _custom_conditions.pop(name, None) is not None
+
+
+_OPERATORS = {
+    "eq": lambda v, a: v == a[0],
+    "ne": lambda v, a: v != a[0],
+    "gt": lambda v, a: v > a[0],
+    "ge": lambda v, a: v >= a[0],
+    "lt": lambda v, a: v < a[0],
+    "le": lambda v, a: v <= a[0],
+    "range-inclusive": lambda v, a: a[0] <= v <= a[1],
+    "range-exclusive": lambda v, a: a[0] < v < a[1],
+    "not-in-range-inclusive": lambda v, a: not (a[0] <= v <= a[1]),
+    "not-in-range-exclusive": lambda v, a: not (a[0] < v < a[1]),
+}
+
+
+@register_element
+class TensorIf(TransformElement):
+    ELEMENT_NAME = "tensor_if"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    PROPERTIES = {
+        "compared_value": Prop("a-value", str,
+                               "a-value | tensor-total-value | tensor-average-value | custom"),
+        "compared_value_option": Prop("0", str,
+                                      "a-value: 'tensorIdx:flatIdx'; total/average: tensor idx; custom: registered name"),
+        "operator": Prop("gt", str, "|".join(_OPERATORS)),
+        "supplied_value": Prop("0", str, "comparison value(s), ':'-separated for ranges"),
+        "then": Prop("passthrough", str, "passthrough | skip | fill-zero | fill-values | tensorpick"),
+        "then_option": Prop(None, str, "fill value / tensor indices"),
+        "else": Prop("skip", str, "same choices as then"),
+        "else_option": Prop(None, str, ""),
+    }
+
+    # -- negotiation --------------------------------------------------------
+    def transform_caps(self, src_pad):
+        """tensorpick changes the stream's tensor count — src caps must
+        reflect it (reference adjusts caps for TENSORPICK). Branches that
+        emit data must agree on the selection; skip branches don't count."""
+        from ..core import TensorsInfo, caps_from_tensors_info, tensors_info_from_caps
+
+        in_caps = self.sink_pads[0].caps
+        # collect each emitting branch's selection (None = full tensor set);
+        # all emitting branches must agree, regardless of then/else order
+        selections = []
+        for action_key, option_key in (("then", "then_option"), ("else", "else_option")):
+            action = self.props[action_key]
+            if action == "skip":
+                continue
+            selections.append(
+                [int(p) for p in str(self.props[option_key] or "0").split(",")]
+                if action == "tensorpick"
+                else None  # full tensor set
+            )
+        if len(set(map(repr, selections))) > 1:
+            raise ElementError(
+                f"{self.describe()}: then/else branches emit different "
+                "tensor selections; caps would be inconsistent"
+            )
+        picks = selections[0] if selections else None
+        if picks is None:
+            return in_caps
+        info = tensors_info_from_caps(in_caps)
+        return caps_from_tensors_info(TensorsInfo.of(*(info.specs[i] for i in picks)))
+
+    # -- condition ----------------------------------------------------------
+    def _compared_value(self, buf: Buffer) -> float:
+        kind = self.props["compared_value"]
+        opt = self.props["compared_value_option"]
+        if kind == "custom":
+            fn = _custom_conditions.get(opt)
+            if fn is None:
+                raise ElementError(f"{self.describe()}: no custom condition '{opt}'")
+            return fn(buf)
+        if kind == "a-value":
+            t_idx, _, flat_idx = opt.partition(":")
+            a = np.asarray(buf.tensors[int(t_idx or 0)])
+            return float(a.reshape(-1)[int(flat_idx or 0)])
+        t = np.asarray(buf.tensors[int(opt or 0)], dtype=np.float64)
+        if kind == "tensor-total-value":
+            return float(t.sum())
+        if kind == "tensor-average-value":
+            return float(t.mean())
+        raise ElementError(f"{self.describe()}: unknown compared-value '{kind}'")
+
+    def _evaluate(self, buf: Buffer) -> bool:
+        kind = self.props["compared_value"]
+        value = self._compared_value(buf)
+        if kind == "custom":
+            return bool(value)
+        op = self.props["operator"]
+        if op not in _OPERATORS:
+            raise ElementError(f"{self.describe()}: unknown operator '{op}'")
+        supplied = [parse_number(p) for p in str(self.props["supplied_value"]).split(":")]
+        return _OPERATORS[op](value, supplied)
+
+    # -- actions ------------------------------------------------------------
+    def _apply(self, action: str, option, buf: Buffer) -> Optional[Buffer]:
+        if action == "passthrough":
+            return buf
+        if action == "skip":
+            return None
+        if action == "fill-zero":
+            return buf.with_tensors(
+                [np.zeros_like(np.asarray(t)) for t in buf.tensors]
+            ).copy_metadata_from(buf)
+        if action == "fill-values":
+            v = parse_number(str(option or "0"))
+            return buf.with_tensors(
+                [np.full_like(np.asarray(t), v) for t in buf.tensors]
+            ).copy_metadata_from(buf)
+        if action == "tensorpick":
+            idx = [int(p) for p in str(option or "0").split(",")]
+            return buf.with_tensors([buf.tensors[i] for i in idx]).copy_metadata_from(buf)
+        raise ElementError(f"{self.describe()}: unknown action '{action}'")
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        if self._evaluate(buf):
+            return self._apply(self.props["then"], self.props["then_option"], buf)
+        return self._apply(self.props["else"], self.props["else_option"], buf)

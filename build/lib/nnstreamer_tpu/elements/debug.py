@@ -1,0 +1,59 @@
+"""tensor_debug: passthrough stream inspector (L3).
+
+Reference analog: ``gsttensor_debug.c`` (441 LoC; output-mode enums
+gsttensor_debug.h:47-74) — logs caps/shape/timestamps without altering flow.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps
+from ..core.caps import any_media_caps
+from ..registry.elements import register_element
+from ..runtime.element import Prop, TransformElement, prop_bool
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+from ..utils.log import logger
+
+
+@register_element
+class TensorDebug(TransformElement):
+    ELEMENT_NAME = "tensor_debug"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, any_media_caps()),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, any_media_caps()),)
+    PROPERTIES = {
+        "output_mode": Prop("log", str, "log | console | none"),
+        "capsinfo": Prop(True, prop_bool, "print caps on negotiation"),
+        "metainfo": Prop(True, prop_bool, "print per-buffer shapes/timestamps"),
+    }
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        if self.props["capsinfo"] and self._emitting():
+            self._emit(f"{self.name} caps: {caps}")
+
+    def _emitting(self) -> bool:
+        """True when the description string would actually go anywhere —
+        per-buffer dtype/shape formatting is the expensive part, so skip
+        building it for output-mode=none or a disabled INFO logger."""
+        mode = self.props["output_mode"]
+        if mode == "none":
+            return False
+        if mode == "console":
+            return True
+        return logger.isEnabledFor(logging.INFO)
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        if self.props["metainfo"] and self._emitting():
+            shapes = ", ".join(
+                f"{np.asarray(t).dtype}{tuple(t.shape)}" for t in buf.tensors
+            )
+            self._emit(f"{self.name} buf pts={buf.pts} offset={buf.offset} [{shapes}]")
+        return buf
+
+    def _emit(self, text: str) -> None:
+        if self.props["output_mode"] == "console":
+            print(text)
+        else:
+            logger.info("%s", text)

@@ -1,0 +1,65 @@
+"""tensor_decoder: the tensor→media boundary (L3).
+
+Reference analog: ``gst/nnstreamer/elements/gsttensor_decoder.c`` (1004 LoC)
+— looks up a decoder subplugin by ``mode=``, passes ``option1..optionN``
+strings, negotiates output caps from the subplugin, and per-buffer calls its
+``decode``. Decoder subplugins live in ``nnstreamer_tpu.decoders``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core import Buffer, Caps, TensorsInfo, tensors_info_from_caps
+from ..core.caps import any_media_caps
+from ..registry.elements import register_element
+from ..registry.subplugin import SubpluginKind, get as get_subplugin
+from ..runtime.element import ElementError, Prop, TransformElement
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+
+_N_OPTIONS = 9  # reference supports option1..option9
+
+
+def _option_props():
+    props = {"mode": Prop(None, str, "decoder subplugin name")}
+    for i in range(1, _N_OPTIONS + 1):
+        props[f"option{i}"] = Prop(None, str, f"decoder option #{i}")
+    return props
+
+
+@register_element
+class TensorDecoder(TransformElement):
+    ELEMENT_NAME = "tensor_decoder"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, any_media_caps()),)
+    PROPERTIES = _option_props()
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        mode = self.props["mode"]
+        if not mode:
+            raise ElementError(f"{self.describe()}: 'mode' property required")
+        cls = get_subplugin(SubpluginKind.DECODER, mode)
+        self.decoder = cls() if isinstance(cls, type) else cls
+        options = [self.props[f"option{i}"] for i in range(1, _N_OPTIONS + 1)]
+        self.decoder.init(options)
+        self._in_info: Optional[TensorsInfo] = None
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        self._in_info = tensors_info_from_caps(caps)
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        out = self.decoder.get_out_caps(self._in_info)
+        if out is None:
+            raise ElementError(
+                f"{self.describe()}: decoder rejects input {self._in_info.describe()}"
+            )
+        return out
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        out = self.decoder.decode(buf.as_numpy(), self._in_info)
+        if out is None:
+            return None
+        decoder_meta = out.meta  # decode() results must survive the metadata copy
+        out.copy_metadata_from(buf)
+        out.meta.update(decoder_meta)
+        return out

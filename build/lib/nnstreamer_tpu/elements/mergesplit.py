@@ -1,0 +1,137 @@
+"""tensor_merge / tensor_split: axis-wise concat and slice (L3).
+
+Reference analogs: ``gsttensor_merge.c`` (891 LoC — N single-tensor streams →
+1 tensor by concatenating along an axis, same sync policies as mux) and
+``gsttensor_split.c`` (725 LoC — slice one tensor into several along an axis,
+``tensorseg`` sizes). These are the reference's manual tensor-parallelism
+primitives (SURVEY.md §2.9: TP ≈ split → filters → merge); under pjit the
+same intent is expressed with shardings, but the elements remain for stream
+surgery.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import (
+    Buffer,
+    Caps,
+    TensorsInfo,
+    caps_from_tensors_info,
+    tensors_info_from_caps,
+)
+from ..core.tensors import TensorSpec
+from ..registry.elements import register_element
+from ..runtime.element import Element, ElementError, Prop
+from ..runtime.pad import Pad, PadDirection, PadPresence, PadTemplate
+
+
+@register_element
+class TensorMerge(Element):
+    """Concatenate one tensor from each sink pad along ``option`` axis
+    (reference mode=linear)."""
+
+    ELEMENT_NAME = "tensor_merge"
+    SINK_TEMPLATES = (
+        PadTemplate("sink_%u", PadDirection.SINK, Caps.new("other/tensors"),
+                    PadPresence.REQUEST),
+    )
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    PROPERTIES = {
+        "mode": Prop("linear", str, "only 'linear' (axis concat) exists"),
+        "option": Prop(0, int, "concat axis"),
+        "sync_mode": Prop("slowest", str, "slowest | nosync"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._queues: Dict[str, List[Buffer]] = {}
+        self._merge_lock = threading.Lock()
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        axis = self.props["option"]
+        specs = [tensors_info_from_caps(p.caps).specs[0] for p in self.sink_pads
+                 if p.is_linked]
+        base = list(specs[0].shape)
+        for s in specs[1:]:
+            if len(s.shape) != len(base):
+                raise ElementError(f"{self.describe()}: rank mismatch")
+            base[axis] += s.shape[axis]
+        return caps_from_tensors_info(
+            TensorsInfo.of(TensorSpec(tuple(base), specs[0].dtype))
+        )
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        with self._merge_lock:
+            self._queues.setdefault(pad.name, []).append(buf)
+            linked = [p for p in self.sink_pads if p.is_linked]
+            if not all(self._queues.get(p.name) for p in linked):
+                return
+            parts = [self._queues[p.name].pop(0) for p in linked]
+        axis = self.props["option"]
+        merged = np.concatenate([np.asarray(p.tensors[0]) for p in parts], axis=axis)
+        out = Buffer([merged]).copy_metadata_from(parts[0])
+        out.pts = max((p.pts for p in parts if p.pts is not None), default=None)
+        self.push(out)
+
+
+@register_element
+class TensorSplit(Element):
+    """Slice the single input tensor along an axis into per-pad chunks.
+
+    ``tensorseg``: ','-separated chunk sizes along the axis ("2,2,4");
+    without it the tensor is split evenly across linked src pads.
+    """
+
+    ELEMENT_NAME = "tensor_split"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    SRC_TEMPLATES = (
+        PadTemplate("src_%u", PadDirection.SRC, Caps.new("other/tensors"),
+                    PadPresence.REQUEST),
+    )
+    PROPERTIES = {
+        "axis": Prop(0, int, "split axis"),
+        "tensorseg": Prop(None, str, "chunk sizes along axis, ','-separated"),
+    }
+
+    def _segments(self, total: int) -> List[int]:
+        v = self.props["tensorseg"]
+        if v:
+            segs = [int(p) for p in str(v).split(",")]
+            if sum(segs) != total:
+                raise ElementError(
+                    f"{self.describe()}: tensorseg {segs} != axis size {total}"
+                )
+            return segs
+        n = len([p for p in self.src_pads if p.is_linked]) or 1
+        if total % n:
+            raise ElementError(f"{self.describe()}: axis {total} not divisible by {n} pads")
+        return [total // n] * n
+
+    def _linked_pads(self) -> List[Pad]:
+        return [p for p in self.src_pads if p.is_linked]
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        info = tensors_info_from_caps(self.sinkpad.caps)
+        spec = info.specs[0]
+        axis = self.props["axis"]
+        segs = self._segments(spec.shape[axis])
+        idx = self._linked_pads().index(src_pad)
+        shape = list(spec.shape)
+        shape[axis] = segs[idx]
+        return caps_from_tensors_info(
+            TensorsInfo.of(TensorSpec(tuple(shape), spec.dtype))
+        )
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        axis = self.props["axis"]
+        a = np.asarray(buf.tensors[0])
+        segs = self._segments(a.shape[axis])
+        offset = 0
+        for seg, src in zip(segs, self._linked_pads()):
+            sl = [slice(None)] * a.ndim
+            sl[axis] = slice(offset, offset + seg)
+            offset += seg
+            src.push(Buffer([a[tuple(sl)]]).copy_metadata_from(buf))

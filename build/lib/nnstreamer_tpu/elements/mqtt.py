@@ -1,0 +1,157 @@
+"""mqttsrc / mqttsink: tensor streams over an MQTT broker (L5).
+
+Reference analog: ``gst/mqtt/`` (mqttsrc.c/mqttsink.c over Eclipse Paho,
+message = 1024-byte header {num_mems, size_mems, base_time, caps string} +
+payload, gst/mqtt/mqttcommon.h:49-61). Own design:
+
+  * transport: our dependency-free MQTT 3.1.1 client (query/mqtt.py),
+    wire-compatible with real brokers; ``broker=embedded`` starts an
+    in-process MiniBroker (the loopback test story — the reference skips
+    mqtt tests when no broker runs);
+  * framing: the shared tensor wire format (core/serialize.py) — dtype/
+    shape/pts/meta ride in the frame, no fixed-size header;
+  * negotiation: caps string published RETAINED on ``<topic>/caps`` —
+    late subscribers still negotiate (the reference re-sends caps in every
+    message header instead).
+"""
+from __future__ import annotations
+
+import queue as _queue
+from typing import Optional
+
+from ..core import Buffer, Caps, parse_caps_string
+from ..core.serialize import pack_tensors, unpack_tensors
+from ..registry.elements import register_element
+from ..runtime.element import ElementError, Prop, SinkElement, SourceElement
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+from ..utils.log import logger
+
+_TENSOR_CAPS = Caps.new("other/tensors")
+
+
+@register_element
+class MqttSink(SinkElement):
+    ELEMENT_NAME = "mqttsink"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
+    PROPERTIES = {
+        "host": Prop("127.0.0.1", str, "broker host"),
+        "port": Prop(1883, int, "broker port (embedded: 0 = ephemeral)"),
+        "pub_topic": Prop("", str, "publish topic (reference pub-topic)"),
+        "broker": Prop("external", str, "external | embedded (in-process)"),
+        "client_id": Prop("", str),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._client = None
+        self._broker = None
+
+    @property
+    def bound_port(self) -> int:
+        """Embedded broker's actual port (for tests / mqttsrc wiring)."""
+        return self._broker.port if self._broker else self.props["port"]
+
+    def start(self) -> None:
+        from ..query import mqtt
+
+        if not self.props["pub_topic"]:
+            raise ElementError(f"{self.describe()}: pub-topic required")
+        host, port = self.props["host"], self.props["port"]
+        if self.props["broker"] == "embedded":
+            self._broker = mqtt.get_embedded_broker(port)
+            host, port = self._broker.host, self._broker.port
+        self._client = mqtt.MqttClient(host, port,
+                                       client_id=self.props["client_id"])
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        self._client.publish(f"{self.props['pub_topic']}/caps",
+                             str(caps).encode(), retain=True)
+
+    def render(self, buf: Buffer) -> None:
+        self._client.publish(self.props["pub_topic"], pack_tensors(buf))
+
+    def stop(self) -> None:
+        from ..query import mqtt
+
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._broker is not None:
+            mqtt.release_embedded_broker(self._broker)
+            self._broker = None
+
+
+@register_element
+class MqttSrc(SourceElement):
+    ELEMENT_NAME = "mqttsrc"
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
+    PROPERTIES = {
+        "host": Prop("127.0.0.1", str, "broker host"),
+        "port": Prop(1883, int, "broker port"),
+        "sub_topic": Prop("", str, "subscribe topic (reference sub-topic)"),
+        "timeout": Prop(10.0, float, "caps-wait / connect timeout seconds"),
+        "client_id": Prop("", str),
+        "num_buffers": Prop(-1, int, "stop after N frames (-1 = endless)"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._client = None
+        self._q: _queue.Queue = _queue.Queue()
+        self._caps_q: _queue.Queue = _queue.Queue()
+        self._count = 0
+
+    def get_src_caps(self) -> Caps:
+        from ..query import mqtt
+
+        topic = self.props["sub_topic"]
+        if not topic:
+            raise ElementError(f"{self.describe()}: sub-topic required")
+        self._client = mqtt.MqttClient(self.props["host"], self.props["port"],
+                                       client_id=self.props["client_id"],
+                                       timeout=self.props["timeout"])
+        caps_topic = f"{topic}/caps"
+
+        def on_message(t: str, body: bytes) -> None:
+            if t == caps_topic:
+                self._caps_q.put(body.decode())
+            elif t == topic:
+                try:
+                    self._q.put(unpack_tensors(body))
+                except ValueError as e:
+                    logger.warning("%s: bad frame dropped: %s", self.name, e)
+
+        # '<topic>/#' also matches '<topic>' itself (MQTT wildcard rules),
+        # so one subscription covers the caps topic and the data stream
+        self._client.subscribe(f"{topic}/#", on_message,
+                               timeout=self.props["timeout"])
+        try:
+            caps_str = self._caps_q.get(timeout=self.props["timeout"])
+        except _queue.Empty:
+            raise ElementError(
+                f"{self.describe()}: no retained caps on '{caps_topic}' "
+                f"within {self.props['timeout']}s — is the publisher up?")
+        return parse_caps_string(caps_str)
+
+    def create(self) -> Optional[Buffer]:
+        limit = self.props["num_buffers"]
+        if 0 <= limit <= self._count:
+            return None
+        while self.running:
+            try:
+                buf = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            self._count += 1
+            return buf
+        return None
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._count = 0
+
+    def stop(self) -> None:
+        super().stop()
+        if self._client is not None:
+            self._client.close()
+            self._client = None

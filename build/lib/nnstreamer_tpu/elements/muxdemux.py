@@ -1,0 +1,138 @@
+"""tensor_mux / tensor_demux: combine/split multi-tensor frames (L3).
+
+Reference analogs: ``gsttensor_mux.c`` (662 LoC — N streams → 1 multi-tensor
+frame, sync policies nosync/slowest/basepad/refresh from
+tensor_common.h:62-68) and ``gsttensor_demux.c`` (682 LoC — 1 multi-tensor
+stream → N streams with ``tensorpick`` reordering).
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Dict, List, Optional
+
+from ..core import (
+    Buffer,
+    Caps,
+    Event,
+    EventType,
+    TensorsInfo,
+    caps_from_tensors_info,
+    tensors_info_from_caps,
+)
+from ..registry.elements import register_element
+from ..runtime.element import Element, ElementError, Prop
+from ..runtime.pad import Pad, PadDirection, PadPresence, PadTemplate
+
+
+@register_element
+class TensorMux(Element):
+    """N tensor streams → one frame carrying all tensors.
+
+    Sync policies (reference tensor_common.h:62-68):
+      * ``slowest`` (default) / ``nosync``: one frame from every pad per
+        output (queue-per-pad, pop one each — the pipeline advances at the
+        slowest producer);
+      * ``basepad``: emit on every frame of pad 0, combining the most recent
+        frame from the other pads;
+      * ``refresh``: emit whenever *any* pad receives, reusing the last frame
+        from the others.
+    """
+
+    ELEMENT_NAME = "tensor_mux"
+    SINK_TEMPLATES = (
+        PadTemplate("sink_%u", PadDirection.SINK, Caps.new("other/tensors"),
+                    PadPresence.REQUEST),
+    )
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    PROPERTIES = {
+        "sync_mode": Prop("slowest", str, "slowest | nosync | basepad | refresh"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._queues: Dict[str, List[Buffer]] = {}
+        self._latest: Dict[str, Buffer] = {}
+        self._mux_lock = threading.Lock()
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        specs = []
+        for pad in self.sink_pads:
+            info = tensors_info_from_caps(pad.caps)
+            specs.extend(info.specs)
+        return caps_from_tensors_info(TensorsInfo.of(*specs))
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        mode = self.props["sync_mode"]
+        with self._mux_lock:
+            self._latest[pad.name] = buf
+            if mode in ("slowest", "nosync"):
+                self._queues.setdefault(pad.name, []).append(buf)
+                ready = all(self._queues.get(p.name) for p in self.sink_pads if p.is_linked)
+                if not ready:
+                    return
+                parts = [self._queues[p.name].pop(0) for p in self.sink_pads if p.is_linked]
+            elif mode == "basepad":
+                if pad is not self.sink_pads[0]:
+                    return
+                parts = [self._latest.get(p.name) for p in self.sink_pads if p.is_linked]
+                if any(p is None for p in parts):
+                    return
+            else:  # refresh
+                parts = [self._latest.get(p.name) for p in self.sink_pads if p.is_linked]
+                if any(p is None for p in parts):
+                    return
+        tensors = [t for part in parts for t in part.tensors]
+        out = Buffer(tensors).copy_metadata_from(parts[0])
+        # timestamp = latest of the combined frames (reference collects pts)
+        out.pts = max((p.pts for p in parts if p.pts is not None), default=None)
+        self.push(out)
+
+
+@register_element
+class TensorDemux(Element):
+    """One multi-tensor stream → N streams.
+
+    ``tensorpick`` (reference prop) assigns tensors to src pads:
+    "0,2" → pad0 gets tensor0, pad1 gets tensor2; "0:1,2" → pad0 gets
+    tensors 0+1, pad1 gets tensor 2. Default: pad i gets tensor i.
+    """
+
+    ELEMENT_NAME = "tensor_demux"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    SRC_TEMPLATES = (
+        PadTemplate("src_%u", PadDirection.SRC, Caps.new("other/tensors"),
+                    PadPresence.REQUEST),
+    )
+    PROPERTIES = {
+        "tensorpick": Prop(None, str, "per-pad tensor indices, ','-separated"),
+    }
+
+    def _picks(self) -> Optional[List[List[int]]]:
+        v = self.props["tensorpick"]
+        if not v:
+            return None
+        return [[int(i) for i in part.split(":")] for part in str(v).split(",")]
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        info = tensors_info_from_caps(self.sinkpad.caps)
+        idx = self.src_pads.index(src_pad)
+        picks = self._picks()
+        sel = picks[idx] if picks else [idx]
+        try:
+            specs = [info.specs[i] for i in sel]
+        except IndexError:
+            raise ElementError(
+                f"{self.describe()}: pad {idx} picks {sel} from "
+                f"{info.num_tensors}-tensor stream"
+            )
+        return caps_from_tensors_info(TensorsInfo.of(*specs))
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        picks = self._picks()
+        for idx, src in enumerate(self.src_pads):
+            if not src.is_linked:
+                continue
+            sel = picks[idx] if picks else [idx]
+            out = Buffer([buf.tensors[i] for i in sel]).copy_metadata_from(buf)
+            src.push(out)

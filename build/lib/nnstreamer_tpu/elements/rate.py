@@ -1,0 +1,65 @@
+"""tensor_rate: framerate control + QoS load shedding (L3).
+
+Reference analog: ``gst/nnstreamer/elements/gsttensor_rate.c`` (997 LoC) —
+drops/duplicates frames to hit a target rate and, with ``throttle=true``,
+sends ``GST_QOS_TYPE_THROTTLE`` events upstream so ``tensor_filter`` skips
+invokes at the source (gsttensor_rate.c:452-465 → tensor_filter.c:512).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import Buffer, Caps, Event
+from ..registry.elements import register_element
+from ..runtime.element import Prop, TransformElement, prop_bool
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+
+
+def _parse_rate(v) -> float:
+    text = str(v)
+    if "/" in text:
+        num, den = text.split("/", 1)
+        return int(num) / max(int(den), 1)
+    return float(text)
+
+
+@register_element
+class TensorRate(TransformElement):
+    ELEMENT_NAME = "tensor_rate"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    PROPERTIES = {
+        "framerate": Prop(0.0, _parse_rate, "target output rate (fps or 'n/d'; 0 = off)"),
+        "throttle": Prop(False, prop_bool, "send QoS throttle events upstream"),
+        "silent": Prop(True, prop_bool),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._next_slot = 0.0
+        self.in_count = 0
+        self.out_count = 0
+        self.drop_count = 0
+        self._throttle_sent = False
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        rate = self.props["framerate"]
+        if rate > 0 and self.props["throttle"] and not self._throttle_sent:
+            # one-time steady-state throttle hint (reference re-sends per QoS
+            # evaluation; a constant target rate needs only the steady value)
+            pad.send_upstream(Event.qos_throttle(1.0 / rate))
+            self._throttle_sent = True
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        self.in_count += 1
+        rate = self.props["framerate"]
+        if rate <= 0 or buf.pts is None:
+            self.out_count += 1
+            return buf
+        # emit at most one frame per 1/rate of stream time
+        if buf.pts + 1e-9 < self._next_slot:
+            self.drop_count += 1
+            return None
+        self._next_slot = max(self._next_slot, buf.pts) + 1.0 / rate
+        self.out_count += 1
+        return buf

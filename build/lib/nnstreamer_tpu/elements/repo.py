@@ -1,0 +1,108 @@
+"""tensor_repo_sink / tensor_repo_src: in-process circular streams (L3).
+
+Reference analog: ``gsttensor_repo.c`` (394 LoC) + ``gsttensor_reposink.c`` /
+``gsttensor_reposrc.c`` — a shared, slot-keyed tensor repository enabling
+RNN-style feedback loops: a downstream repo_sink writes a slot, an upstream
+repo_src replays it into the next iteration (GMutex/GCond per slot,
+gsttensor_repo.h:44-62).
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, Optional
+
+from ..core import (
+    Buffer,
+    Caps,
+    TensorsInfo,
+    caps_from_tensors_info,
+    parse_caps_string,
+)
+from ..registry.elements import register_element
+from ..runtime.element import Prop, SinkElement, SourceElement
+from ..runtime.pad import PadDirection, PadTemplate
+
+
+class _Slot:
+    def __init__(self, depth: int = 2):
+        self.q: Deque[Buffer] = deque(maxlen=depth)
+        self.cond = threading.Condition()
+        self.eos = False
+
+    def push(self, buf: Buffer) -> None:
+        with self.cond:
+            self.q.append(buf)
+            self.cond.notify_all()
+
+    def pop(self, timeout: float) -> Optional[Buffer]:
+        with self.cond:
+            if not self.q and not self.eos:
+                self.cond.wait(timeout)
+            return self.q.popleft() if self.q else None
+
+    def set_eos(self) -> None:
+        with self.cond:
+            self.eos = True
+            self.cond.notify_all()
+
+
+class TensorRepo:
+    """Global slot table (reference's process-wide repo + repo_lock)."""
+
+    def __init__(self):
+        self._slots: Dict[int, _Slot] = {}
+        self._lock = threading.Lock()
+
+    def slot(self, idx: int) -> _Slot:
+        with self._lock:
+            if idx not in self._slots:
+                self._slots[idx] = _Slot()
+            return self._slots[idx]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._slots.clear()
+
+
+REPO = TensorRepo()
+
+
+@register_element
+class TensorRepoSink(SinkElement):
+    ELEMENT_NAME = "tensor_repo_sink"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    PROPERTIES = {"slot_index": Prop(0, int, "repository slot id")}
+
+    def render(self, buf: Buffer) -> None:
+        REPO.slot(self.props["slot_index"]).push(buf)
+
+    def handle_eos(self) -> None:
+        REPO.slot(self.props["slot_index"]).set_eos()
+        super().handle_eos()
+
+
+@register_element
+class TensorRepoSrc(SourceElement):
+    ELEMENT_NAME = "tensor_repo_src"
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    PROPERTIES = {
+        "slot_index": Prop(0, int, "repository slot id"),
+        "caps": Prop(None, str, "stream caps (repo carries no negotiation)"),
+        "timeout": Prop(5.0, float, "seconds to wait per frame before EOS"),
+    }
+
+    def get_src_caps(self) -> Caps:
+        if not self.props["caps"]:
+            raise ValueError(f"{self.describe()}: caps property required")
+        return parse_caps_string(self.props["caps"])
+
+    def create(self) -> Optional[Buffer]:
+        slot = REPO.slot(self.props["slot_index"])
+        while self.running:
+            buf = slot.pop(timeout=0.1)
+            if buf is not None:
+                return buf
+            if slot.eos:
+                return None
+        return None

@@ -1,0 +1,141 @@
+"""Sink elements.
+
+Reference analogs: ``tensor_sink`` (terminal with ``new-data`` signal,
+gst/nnstreamer/elements/gsttensor_sink.c), GStreamer's ``appsink`` (pull
+interface, used by the reference tests), ``fakesink``, and
+``filesink``/``multifilesink`` (golden-file test outputs, SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps
+from ..core.caps import any_media_caps
+from ..registry.elements import register_element
+from ..runtime.element import Prop, SinkElement, prop_bool
+from ..runtime.pad import PadDirection, PadTemplate
+
+_ANY_MEDIA_CAPS = any_media_caps()
+
+
+@register_element
+class TensorSink(SinkElement):
+    """Terminal tensor sink with new-data callbacks AND appsink-style pulls.
+
+    Reference: ``tensor_sink`` emits a ``new-data`` GObject signal per buffer
+    (gsttensor_sink.c); our callbacks play that role. ``pull()`` additionally
+    gives the blocking-consume pattern the reference gets from ``appsink``.
+    """
+
+    ELEMENT_NAME = "tensor_sink"
+    # accepts any media: plays both the reference's tensor_sink (tensors) and
+    # appsink (text/video pulls in decoder tests) roles
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
+    PROPERTIES = {
+        "sync": Prop(False, prop_bool, "honor buffer pts against the clock (unused yet)"),
+        "max_stored": Prop(256, int, "keep last N buffers for pull() (0 = unbounded)"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._callbacks: List[Callable[[Buffer], None]] = []
+        self._q: _queue.Queue = _queue.Queue()
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def connect(self, callback: Callable[[Buffer], None]) -> None:
+        """Register a new-data callback (``g_signal_connect`` analog)."""
+        self._callbacks.append(callback)
+
+    def render(self, buf: Buffer) -> None:
+        with self._lock:
+            self._count += 1
+        for cb in self._callbacks:
+            cb(buf)
+        maxn = self.props["max_stored"]
+        if maxn > 0:
+            while self._q.qsize() >= maxn:
+                try:
+                    self._q.get_nowait()
+                except _queue.Empty:
+                    break
+        self._q.put(buf)
+
+    def pull(self, timeout: float = 5.0) -> Optional[Buffer]:
+        try:
+            return self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    @property
+    def buffer_count(self) -> int:
+        with self._lock:
+            return self._count
+
+
+@register_element
+class FakeSink(SinkElement):
+    """Discards everything (GStreamer ``fakesink``)."""
+
+    ELEMENT_NAME = "fakesink"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.buffer_count = 0
+
+    def render(self, buf: Buffer) -> None:
+        self.buffer_count += 1
+
+
+@register_element
+class FileSink(SinkElement):
+    """Appends every buffer's raw bytes to one file (``filesink``)."""
+
+    ELEMENT_NAME = "filesink"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
+    PROPERTIES = {"location": Prop(None, str, "output path")}
+
+    def start(self) -> None:
+        loc = self.props["location"]
+        if not loc:
+            raise ValueError(f"{self.describe()}: location not set")
+        self._fh = open(loc, "wb")
+
+    def stop(self) -> None:
+        fh = getattr(self, "_fh", None)
+        if fh is not None:
+            fh.close()
+            self._fh = None
+
+    def render(self, buf: Buffer) -> None:
+        for t in buf.as_numpy().tensors:
+            self._fh.write(np.ascontiguousarray(t).tobytes())
+        self._fh.flush()
+
+
+@register_element
+class MultiFileSink(SinkElement):
+    """Writes each buffer to ``location % index`` (``multifilesink``) — the
+    reference's golden-file test pattern (SURVEY.md §4 SSAT tests)."""
+
+    ELEMENT_NAME = "multifilesink"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
+    PROPERTIES = {"location": Prop("out_%03d.raw", str, "printf-style path pattern")}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._index = 0
+
+    def render(self, buf: Buffer) -> None:
+        path = self.props["location"] % self._index
+        self._index += 1
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as fh:
+            for t in buf.as_numpy().tensors:
+                fh.write(np.ascontiguousarray(t).tobytes())

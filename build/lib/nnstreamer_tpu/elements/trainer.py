@@ -1,0 +1,93 @@
+"""tensor_trainer: in-pipeline training element (L3).
+
+Reference analog: ``gst/nnstreamer/elements/gsttensor_trainer.c`` (1392 LoC,
+call stack SURVEY.md §3.5) — receives (input, label) tensor frames, feeds the
+trainer subplugin's queue, exposes epoch/loss/accuracy, posts a bus message
+when the model is saved.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import Buffer, Caps, MessageType
+from ..registry.elements import register_element
+from ..registry.subplugin import SubpluginKind, get as get_subplugin
+from ..runtime.element import ElementError, Prop, SinkElement
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+from ..trainer.base import TrainerBackend, TrainerProperties
+
+
+@register_element
+class TensorTrainer(SinkElement):
+    ELEMENT_NAME = "tensor_trainer"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    PROPERTIES = {
+        "framework": Prop("optax", str, "trainer backend name"),
+        "model_config": Prop("", str, "model definition file"),
+        "model_save_path": Prop("", str),
+        "model_load_path": Prop("", str, "resume checkpoint"),
+        "num_inputs": Prop(1, int, "leading tensors per frame used as inputs"),
+        "num_labels": Prop(1, int, "trailing tensors per frame used as labels"),
+        "num_training_samples": Prop(0, int, "samples per epoch (0 = one epoch of all data)"),
+        "epochs": Prop(1, int),
+        "custom": Prop("", str, "backend options 'batch:32,lr:0.001'"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.backend: Optional[TrainerBackend] = None
+        self._pushed = 0
+
+    def start(self) -> None:
+        cls = get_subplugin(SubpluginKind.TRAINER, self.props["framework"])
+        self.backend = cls()
+        self.backend.configure(TrainerProperties(
+            model_config=self.props["model_config"],
+            model_save_path=self.props["model_save_path"],
+            model_load_path=self.props["model_load_path"],
+            num_inputs=self.props["num_inputs"],
+            num_labels=self.props["num_labels"],
+            num_training_samples=self.props["num_training_samples"],
+            epochs=self.props["epochs"],
+            custom=self.props["custom"],
+        ))
+        self.backend.start()
+
+    def render(self, buf: Buffer) -> None:
+        n_in = self.props["num_inputs"]
+        n_lb = self.props["num_labels"]
+        if buf.num_tensors != n_in + n_lb:
+            raise ElementError(
+                f"{self.describe()}: frame has {buf.num_tensors} tensors, "
+                f"expected {n_in} inputs + {n_lb} labels"
+            )
+        arrays = buf.as_numpy().tensors
+        self.backend.push_data(arrays[:n_in], arrays[n_in:])
+        self._pushed += 1
+
+    PROPERTIES_EOS_TIMEOUT_S = 120.0
+
+    def handle_eos(self) -> None:
+        if self.backend is not None:
+            self.backend.end_of_data()
+            done = self.backend.wait_complete(timeout=self.PROPERTIES_EOS_TIMEOUT_S)
+            s = self.backend.stats
+            # report the path the backend actually wrote, not the requested
+            # one — a zero-batch run (e.g. fully-resumed) saves nothing
+            saved = getattr(self.backend, "last_saved_path",
+                            self.props["model_save_path"] or None)
+            self.post_message(
+                MessageType.ELEMENT,
+                event="training-complete" if done else "training-timeout",
+                epochs=s.epoch_count,
+                training_loss=s.training_loss,
+                training_accuracy=s.training_accuracy,
+                model_saved=saved if done else None,
+                samples=self._pushed,
+            )
+        super().handle_eos()
+
+    def stop(self) -> None:
+        if self.backend is not None:
+            self.backend.stop()
+            self.backend = None

@@ -1,0 +1,72 @@
+"""tensor_transform: elementwise stream transforms (L3).
+
+Reference analog: ``gst/nnstreamer/elements/gsttensor_transform.c`` (2202 LoC)
+with modes dimchg/typecast/arithmetic/transpose/stand/clamp (+padding). The
+ORC SIMD acceleration (``acceleration`` prop) is replaced by XLA jit/fusion —
+always on. Output caps are derived by ``jax.eval_shape`` over the negotiated
+input spec.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ..core import (
+    Buffer,
+    Caps,
+    DataType,
+    TensorFormat,
+    TensorsInfo,
+    caps_from_tensors_info,
+    tensors_info_from_caps,
+)
+from ..core.tensors import TensorSpec
+from ..ops.transform_ops import parse_transform_options
+from ..registry.elements import register_element
+from ..runtime.element import ElementError, Prop, TransformElement
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+
+
+@register_element
+class TensorTransform(TransformElement):
+    ELEMENT_NAME = "tensor_transform"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    PROPERTIES = {
+        "mode": Prop(None, str, "dimchg|typecast|arithmetic|transpose|stand|clamp|padding"),
+        "option": Prop("", str, "mode-specific option string"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if not self.props["mode"]:
+            raise ElementError(f"{self.describe()}: 'mode' property required")
+        self._fn: Callable = parse_transform_options(
+            self.props["mode"], self.props["option"]
+        )
+        self._jit = None
+        self._out_info: Optional[TensorsInfo] = None
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        import jax
+
+        in_info = tensors_info_from_caps(caps)
+        self._jit = jax.jit(lambda *xs: tuple(self._fn(x) for x in xs))
+        if in_info.format is TensorFormat.STATIC and in_info.specs:
+            outs = jax.eval_shape(
+                self._jit,
+                *(jax.ShapeDtypeStruct(s.shape, s.dtype.np_dtype) for s in in_info.specs),
+            )
+            self._out_info = TensorsInfo.of(
+                *(TensorSpec(o.shape, DataType.from_any(o.dtype)) for o in outs)
+            )
+        else:
+            self._out_info = TensorsInfo((), in_info.format)
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        if self._out_info is None:
+            raise ElementError(f"{self.describe()}: not negotiated")
+        return caps_from_tensors_info(self._out_info)
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        outs = self._jit(*buf.tensors)
+        return Buffer(list(outs)).copy_metadata_from(buf)

@@ -1,0 +1,16 @@
+"""Model zoo — own jax/flax implementations of the reference's baseline
+pipeline models (BASELINE.json configs; the reference ships tiny tflite
+graphs under tests/test_models/models/):
+
+  * :mod:`.mobilenet_v2` — image classification (image_labeling pipeline);
+  * :mod:`.ssd_mobilenet` — detection (bounding_boxes pipeline, decoded
+    on-device; raw+priors variant for the reference's raw-SSD path);
+  * :mod:`.deeplab` — semantic segmentation (image_segment pipeline);
+  * :mod:`.posenet` — keypoint heatmaps (pose_estimation pipeline);
+  * :mod:`.transformer` — sharded LM used by the parallelism stack
+    (dp/tp/sp training step; no reference analog — TPU-scale extension).
+
+Modules import jax lazily inside ``build_*`` so importing the package stays
+cheap; each exposes a ``filter_model`` entry loadable by
+``tensor_filter framework=jax model=nnstreamer_tpu.models.<m>:filter_model``.
+"""

@@ -1,0 +1,63 @@
+"""Shared flax building blocks for the model zoo.
+
+One definition of the MobileNet-v2-style blocks used by mobilenet_v2 /
+ssd_mobilenet / deeplab / posenet (inference-mode BN folded to per-channel
+scale+bias, relu6, NHWC, bfloat16-friendly). ``make_blocks`` is a factory so
+jax/flax import stays lazy and the compute dtype is baked per model.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def make_blocks(compute_dtype: str = "bfloat16"):
+    """Returns ``(ConvBnRelu, InvertedResidual)`` flax Modules bound to the
+    given compute dtype."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    cdt = jnp.dtype(compute_dtype)
+
+    class ConvBnRelu(nn.Module):
+        features: int
+        kernel: Tuple[int, int] = (3, 3)
+        strides: int = 1
+        groups: int = 1
+        dilation: int = 1
+        act: bool = True
+
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                        padding="SAME", feature_group_count=self.groups,
+                        kernel_dilation=self.dilation, use_bias=False,
+                        dtype=cdt)(x)
+            # inference-mode BN = per-channel scale + bias
+            scale = self.param("bn_scale", nn.initializers.ones, (self.features,))
+            bias = self.param("bn_bias", nn.initializers.zeros, (self.features,))
+            x = x * scale.astype(cdt) + bias.astype(cdt)
+            if self.act:
+                x = jnp.minimum(jax.nn.relu(x), 6.0)  # relu6
+            return x
+
+    class InvertedResidual(nn.Module):
+        features: int
+        strides: int
+        expand: int
+        dilation: int = 1
+
+        @nn.compact
+        def __call__(self, x):
+            in_ch = x.shape[-1]
+            h = x
+            if self.expand != 1:
+                h = ConvBnRelu(in_ch * self.expand, (1, 1))(h)
+            h = ConvBnRelu(in_ch * self.expand, (3, 3), strides=self.strides,
+                           groups=in_ch * self.expand, dilation=self.dilation)(h)
+            h = ConvBnRelu(self.features, (1, 1), act=False)(h)
+            if self.strides == 1 and in_ch == self.features:
+                h = h + x
+            return h
+
+    return ConvBnRelu, InvertedResidual

@@ -1,0 +1,89 @@
+"""DeepLab-v3-style semantic segmentation — the image_segment baseline.
+
+Reference analog: the DeepLab-v3 tflite pipeline behind
+``tensordec-imagesegment.c`` (ext/nnstreamer/tensor_decoder/, tflite-deeplab
+format) and BASELINE.json config #4. Own TPU-first design:
+
+  * MobileNet-v2-style NHWC trunk at output-stride 16 (bfloat16 on MXU);
+  * ASPP-lite: parallel atrous 3×3 branches (rates 1/6/12) + image-level
+    pooling, fused by a 1×1 — all static shapes, one XLA program;
+  * bilinear upsample back to input resolution via ``jax.image.resize``
+    inside the jitted graph (the reference upsamples on CPU in the decoder).
+
+Output: (B, H, W, 21) float32 logits — exactly what the ``image_segment``
+decoder's ``tflite-deeplab`` mode consumes (argmax → palette).
+"""
+from __future__ import annotations
+
+_NUM_CLASSES = 21  # PASCAL-VOC, like the reference's deeplab demo
+
+
+def build_deeplab(num_classes: int = _NUM_CLASSES, image_size: int = 224,
+                  compute_dtype: str = "bfloat16"):
+    """Returns ``(apply_fn, params)``: ``apply_fn(params, x_nhwc_f32) ->
+    (B, H, W, num_classes) logits`` at input resolution."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from ._blocks import make_blocks
+
+    cdt = jnp.dtype(compute_dtype)
+    ConvBnRelu, InvertedResidual = make_blocks(compute_dtype)
+
+    class DeepLab(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            in_h, in_w = x.shape[1], x.shape[2]
+            x = x.astype(cdt)
+            x = ConvBnRelu(32, (3, 3), strides=2)(x)
+            x = InvertedResidual(16, 1, 1)(x)
+            x = InvertedResidual(24, 2, 6)(x)
+            x = InvertedResidual(24, 1, 6)(x)
+            x = InvertedResidual(32, 2, 6)(x)          # stride 8
+            x = InvertedResidual(32, 1, 6)(x)
+            x = InvertedResidual(64, 2, 6)(x)          # stride 16
+            x = InvertedResidual(64, 1, 6)(x)
+            # keep stride 16: dilated instead of strided (deeplab trick)
+            x = InvertedResidual(96, 1, 6, dilation=2)(x)
+            x = InvertedResidual(96, 1, 6, dilation=2)(x)
+
+            # ASPP-lite
+            branches = [
+                ConvBnRelu(128, (1, 1))(x),
+                ConvBnRelu(128, (3, 3), dilation=6)(x),
+                ConvBnRelu(128, (3, 3), dilation=12)(x),
+            ]
+            img = jnp.mean(x, axis=(1, 2), keepdims=True)
+            img = ConvBnRelu(128, (1, 1))(img)
+            img = jnp.broadcast_to(img, branches[0].shape)
+            x = jnp.concatenate(branches + [img], axis=-1)
+            x = ConvBnRelu(128, (1, 1))(x)
+            x = nn.Conv(num_classes, (1, 1), dtype=cdt)(x)
+            x = x.astype(jnp.float32)
+            # on-device bilinear upsample to input resolution
+            b, _, _, c = x.shape
+            return jax.image.resize(x, (b, in_h, in_w, c), method="bilinear")
+
+    model = DeepLab()
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32))
+
+    def apply_fn(params, x):
+        return model.apply(params, x)
+
+    return apply_fn, params
+
+
+class _FilterEntry:
+    """``tensor_filter framework=jax
+    model=nnstreamer_tpu.models.deeplab:filter_model`` → feeds
+    ``tensor_decoder mode=image_segment option1=tflite-deeplab``."""
+
+    @staticmethod
+    def make():
+        apply_fn, params = build_deeplab()
+        return lambda x: apply_fn(params, x)
+
+
+filter_model = _FilterEntry()

@@ -1,0 +1,97 @@
+"""MobileNet-v2 in flax — the flagship bench model.
+
+The reference's headline pipelines run MobileNet-v2 through the tflite
+backend (tests/test_models/models/mobilenet_v2_1.0_224_quant.tflite, used by
+tests/nnstreamer_decoder_image_labeling/); BASELINE.json's north star is this
+model at ≥2000 fps aggregate on TPU. Own implementation (not a port): NHWC
+layout (TPU conv native), bfloat16 compute / float32 params, inference-mode
+batch norm folded into conv scale+bias (no running stats at inference —
+exactly what a deployed tflite graph has).
+
+Weights are randomly initialized (the quantized tflite weights are not
+importable without a tflite parser); throughput/latency are weight-agnostic.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# (expansion t, output channels c, repeats n, stride s) — the standard
+# MobileNet-v2 body configuration
+_BODY = [
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+]
+
+
+def build_mobilenet_v2(num_classes: int = 1001, width_mult: float = 1.0,
+                       compute_dtype: str = "bfloat16"):
+    """Returns ``(apply_fn, params)``: ``apply_fn(params, x_nhwc_f32) ->
+    logits`` — a pure jax-traceable callable (jit/pjit-ready)."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from ._blocks import make_blocks
+
+    cdt = jnp.dtype(compute_dtype)
+    ConvBnRelu, InvertedResidual = make_blocks(compute_dtype)
+
+    def ch(c: int) -> int:
+        v = max(8, int(c * width_mult + 4) // 8 * 8)
+        return v
+
+    class MobileNetV2(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(cdt)
+            x = ConvBnRelu(ch(32), (3, 3), strides=2)(x)
+            for t, c, n, s in _BODY:
+                for i in range(n):
+                    x = InvertedResidual(ch(c), s if i == 0 else 1, t)(x)
+            x = ConvBnRelu(ch(1280), (1, 1))(x)
+            x = jnp.mean(x, axis=(1, 2))  # global average pool
+            x = nn.Dense(num_classes, dtype=cdt)(x)
+            return x.astype(jnp.float32)
+
+    model = MobileNetV2()
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.float32))
+
+    def apply_fn(params, x):
+        return model.apply(params, x)
+
+    return apply_fn, params
+
+
+class _FilterEntry:
+    """``tensor_filter framework=jax model=nnstreamer_tpu.models.mobilenet_v2:filter_model``
+    loads this via the module:attr path (the jax backend calls ``.make()``)."""
+
+    @staticmethod
+    def make():
+        apply_fn, params = build_mobilenet_v2()
+        return lambda x: apply_fn(params, x)
+
+
+class _FilterEntryU8:
+    """uint8-input variant: normalization ((x/127.5)-1) fused into the jitted
+    graph. The pipeline then ships RAW uint8 batches to the device — 4× less
+    host→HBM traffic than pre-normalized float32 (HBM/PCIe bandwidth is the
+    streaming bottleneck; the reference converts on CPU and pays full-width
+    copies per frame, gsttensor_transform.c arithmetic mode)."""
+
+    @staticmethod
+    def make():
+        import jax.numpy as jnp
+
+        fn = _FilterEntry.make()
+        return lambda x: fn(x.astype(jnp.bfloat16) * (1.0 / 127.5) - 1.0)
+
+
+filter_model = _FilterEntry()
+filter_model_u8 = _FilterEntryU8()

@@ -1,0 +1,77 @@
+"""PoseNet-style keypoint heatmap model — the pose_estimation baseline.
+
+Reference analog: the PoseNet tflite pipeline behind ``tensordec-pose.c``
+(ext/nnstreamer/tensor_decoder/) and BASELINE.json config #3. Own TPU-first
+design: MobileNet-v2-style NHWC trunk to stride 8, a heatmap head emitting
+K=17 COCO keypoint channels, plus short-range offset channels (the classic
+PoseNet head shape). Sigmoid heatmaps; argmax + offset refinement happen in
+the ``pose_estimation`` decoder (host) or can be fused on device via
+``apply_fn.keypoints`` for the pure-TPU path.
+"""
+from __future__ import annotations
+
+_NUM_KEYPOINTS = 17
+
+
+def build_posenet(num_keypoints: int = _NUM_KEYPOINTS, image_size: int = 224,
+                  compute_dtype: str = "bfloat16"):
+    """Returns ``(apply_fn, params)``: ``apply_fn(params, x_nhwc_f32) ->
+    (B, H/8, W/8, K) sigmoid heatmaps``. ``apply_fn.keypoints`` maps the
+    same input to normalized (B, K, 2) [x, y] coordinates on device."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from ._blocks import make_blocks
+
+    cdt = jnp.dtype(compute_dtype)
+    ConvBnRelu, InvertedResidual = make_blocks(compute_dtype)
+
+    class PoseNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(cdt)
+            x = ConvBnRelu(32, (3, 3), strides=2)(x)
+            x = InvertedResidual(16, 1, 1)(x)
+            x = InvertedResidual(24, 2, 6)(x)
+            x = InvertedResidual(24, 1, 6)(x)
+            x = InvertedResidual(32, 2, 6)(x)      # stride 8
+            x = InvertedResidual(32, 1, 6)(x)
+            x = InvertedResidual(64, 1, 6)(x)
+            x = InvertedResidual(96, 1, 6)(x)
+            heat = nn.Conv(num_keypoints, (1, 1), dtype=cdt)(x)
+            return jax.nn.sigmoid(heat.astype(jnp.float32))
+
+    model = PoseNet()
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32))
+
+    def apply_fn(params, x):
+        return model.apply(params, x)
+
+    def keypoints(params, x):
+        """Fused on-device argmax decode → (B, K, 2) normalized [x, y]."""
+        hm = model.apply(params, x)  # (B, H, W, K)
+        b, hh, ww, kk = hm.shape
+        flat = hm.reshape(b, hh * ww, kk)
+        idx = jnp.argmax(flat, axis=1)  # (B, K)
+        ys = (idx // ww) / jnp.maximum(hh - 1, 1)
+        xs = (idx % ww) / jnp.maximum(ww - 1, 1)
+        return jnp.stack([xs, ys], axis=-1).astype(jnp.float32)
+
+    apply_fn.keypoints = keypoints
+    return apply_fn, params
+
+
+class _FilterEntry:
+    """``tensor_filter framework=jax
+    model=nnstreamer_tpu.models.posenet:filter_model`` → feeds
+    ``tensor_decoder mode=pose_estimation option2=heatmap``."""
+
+    @staticmethod
+    def make():
+        apply_fn, params = build_posenet()
+        return lambda x: apply_fn(params, x)
+
+
+filter_model = _FilterEntry()

@@ -1,0 +1,187 @@
+"""SSD-MobileNet object detection — the bounding-box baseline model.
+
+Reference analog: the SSD-MobileNet pipelines behind
+``tests/nnstreamer_decoder_boundingbox/`` + the ``mobilenet-ssd`` /
+``mobilenet-ssd-postprocess`` modes of ``tensordec-boundingbox.c``
+(ext/nnstreamer/tensor_decoder/, formats listed at :157-203). The reference
+runs a quantized tflite graph; this is an own TPU-first design:
+
+  * MobileNet-v2-style NHWC backbone (bfloat16 compute on the MXU);
+  * multi-scale SSD heads over 4 feature strides;
+  * anchor (prior-box) generation at trace time — static shapes, so the
+    whole detect step is one fused XLA program;
+  * box decoding (center-variance) ON DEVICE — the reference decodes boxes
+    on the CPU in the decoder element; we emit already-decoded
+    [ymin,xmin,ymax,xmax] + per-class scores so the host-side decoder only
+    runs NMS. The raw head (``filter_model_raw``) is also exported for
+    parity with the reference's "raw locations + priors file" path.
+
+Weights are randomly initialized (throughput parity is weight-agnostic —
+same rationale as models/mobilenet_v2.py).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+# per-stride anchor config: (scale, aspect ratios)
+_ANCHOR_SCALES = (0.15, 0.35, 0.55, 0.8)
+_ASPECTS = (1.0, 2.0, 0.5)
+_VARIANCES = (0.1, 0.1, 0.2, 0.2)  # standard SSD box-coding variances
+
+
+def make_anchors(image_size: int, strides: Sequence[int]) -> np.ndarray:
+    """Prior boxes as (N, 4) [cy, cx, h, w], normalized. Numpy at build
+    time — constants folded into the XLA program."""
+    all_boxes: List[np.ndarray] = []
+    for scale, stride in zip(_ANCHOR_SCALES, strides):
+        fm = image_size // stride
+        centers = (np.arange(fm, dtype=np.float32) + 0.5) / fm
+        cy, cx = np.meshgrid(centers, centers, indexing="ij")
+        for ar in _ASPECTS:
+            h = scale / np.sqrt(ar)
+            w = scale * np.sqrt(ar)
+            boxes = np.stack(
+                [cy.ravel(), cx.ravel(),
+                 np.full(fm * fm, h, np.float32),
+                 np.full(fm * fm, w, np.float32)],
+                axis=1,
+            )
+            all_boxes.append(boxes.astype(np.float32))
+    return np.concatenate(all_boxes, axis=0)
+
+
+def decode_boxes_np(loc: np.ndarray, anchors: np.ndarray,
+                    variances: Sequence[float] = _VARIANCES) -> np.ndarray:
+    """Host-side center-variance decode (used by the decoder's raw
+    ``mobilenet-ssd`` mode; mirrors the on-device decode below)."""
+    vy, vx, vh, vw = variances
+    cy = loc[:, 0] * vy * anchors[:, 2] + anchors[:, 0]
+    cx = loc[:, 1] * vx * anchors[:, 3] + anchors[:, 1]
+    h = anchors[:, 2] * np.exp(loc[:, 2] * vh)
+    w = anchors[:, 3] * np.exp(loc[:, 3] * vw)
+    return np.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2], axis=1)
+
+
+def build_ssd_mobilenet(num_classes: int = 91, image_size: int = 224,
+                        compute_dtype: str = "bfloat16"):
+    """Returns ``(apply_fn, params, anchors)``.
+
+    ``apply_fn(params, x_nhwc_f32) -> (boxes, scores)`` with boxes
+    (B, N, 4) normalized [ymin,xmin,ymax,xmax] decoded on device and scores
+    (B, N, C) sigmoid class scores.
+    """
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from ._blocks import make_blocks
+
+    cdt = jnp.dtype(compute_dtype)
+    ConvBnRelu, InvertedResidual = make_blocks(compute_dtype)
+    strides = (8, 16, 32, 64)
+    anchors = make_anchors(image_size, strides)
+    n_anchor_kinds = len(_ASPECTS)
+
+    class Backbone(nn.Module):
+        """MobileNet-v2-style trunk emitting stride-8/16/32/64 features."""
+
+        @nn.compact
+        def __call__(self, x) -> List[jnp.ndarray]:
+            feats = []
+            x = ConvBnRelu(32, (3, 3), strides=2)(x)        # s4 after next
+            x = InvertedResidual(16, 1, 1)(x)
+            x = InvertedResidual(24, 2, 6)(x)               # s4
+            x = InvertedResidual(24, 1, 6)(x)
+            x = InvertedResidual(32, 2, 6)(x)               # s8
+            x = InvertedResidual(32, 1, 6)(x)
+            feats.append(x)                                  # stride 8
+            x = InvertedResidual(64, 2, 6)(x)               # s16
+            x = InvertedResidual(64, 1, 6)(x)
+            x = InvertedResidual(96, 1, 6)(x)
+            feats.append(x)                                  # stride 16
+            x = InvertedResidual(160, 2, 6)(x)              # s32
+            x = InvertedResidual(160, 1, 6)(x)
+            feats.append(x)                                  # stride 32
+            x = ConvBnRelu(128, (3, 3), strides=2)(x)       # s64 extra layer
+            feats.append(x)
+            return feats
+
+    class SSD(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(cdt)
+            feats = Backbone()(x)
+            locs, confs = [], []
+            for f in feats:
+                loc = nn.Conv(n_anchor_kinds * 4, (3, 3), padding="SAME",
+                              dtype=cdt)(f)
+                conf = nn.Conv(n_anchor_kinds * num_classes, (3, 3),
+                               padding="SAME", dtype=cdt)(f)
+                b = loc.shape[0]
+                locs.append(loc.reshape(b, -1, 4))
+                confs.append(conf.reshape(b, -1, num_classes))
+            loc = jnp.concatenate(locs, axis=1).astype(jnp.float32)
+            conf = jnp.concatenate(confs, axis=1).astype(jnp.float32)
+            return loc, conf
+
+    model = SSD()
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32))
+    anchors_j = jnp.asarray(anchors)
+    vy, vx, vh, vw = _VARIANCES
+
+    def apply_fn(params, x):
+        loc, conf = model.apply(params, x)
+        # on-device center-variance decode → [ymin,xmin,ymax,xmax]
+        cy = loc[..., 0] * vy * anchors_j[:, 2] + anchors_j[:, 0]
+        cx = loc[..., 1] * vx * anchors_j[:, 3] + anchors_j[:, 1]
+        h = anchors_j[:, 2] * jnp.exp(loc[..., 2] * vh)
+        w = anchors_j[:, 3] * jnp.exp(loc[..., 3] * vw)
+        boxes = jnp.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2],
+                          axis=-1)
+        scores = jax.nn.sigmoid(conf)
+        return boxes, scores
+
+    def apply_raw(params, x):
+        """Raw head outputs (locations + logits) for the priors-file path."""
+        return model.apply(params, x)
+
+    apply_fn.raw = apply_raw
+    return apply_fn, params, anchors
+
+
+class _FilterEntry:
+    """``tensor_filter framework=jax
+    model=nnstreamer_tpu.models.ssd_mobilenet:filter_model`` — decoded
+    boxes+scores, feeds ``mode=bounding_boxes option1=mobilenet-ssd-postprocess``."""
+
+    image_size = 224
+
+    @staticmethod
+    def make():
+        apply_fn, params, _ = build_ssd_mobilenet(image_size=_FilterEntry.image_size)
+        return lambda x: apply_fn(params, x)
+
+
+class _FilterEntryRaw:
+    """Raw locations+logits variant: feeds ``option1=mobilenet-ssd`` with an
+    anchors (box-priors) file — the reference's raw-SSD decode path."""
+
+    image_size = 224
+
+    @staticmethod
+    def make():
+        apply_fn, params, _ = build_ssd_mobilenet(image_size=_FilterEntryRaw.image_size)
+        return lambda x: apply_fn.raw(params, x)
+
+
+filter_model = _FilterEntry()
+filter_model_raw = _FilterEntryRaw()
+
+
+def save_anchors(path: str, image_size: int = 224) -> None:
+    """Write the prior boxes as a .npy file (the decoder's option for the
+    raw mode; the reference ships box_priors.txt with its test models)."""
+    np.save(path, make_anchors(image_size, (8, 16, 32, 64)))

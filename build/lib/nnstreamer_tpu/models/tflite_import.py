@@ -1,0 +1,438 @@
+"""tflite flatbuffer → jax importer: run .tflite model files on the MXU.
+
+The reference runs ``.tflite`` files through the tflite interpreter
+(``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc``); here
+the same model files compile to XLA: the flatbuffer is parsed with TF's
+generated schema bindings (no tflite runtime in the execution path),
+weights are dequantized to float32, and the graph is emitted as a
+jax-traceable callable in native NHWC layout. Quantized models run as
+float simulations of the integer graph: weights/inputs dequantized by
+their recorded (scale, zero_point), every activation fake-quantized to
+its tensor's grid (rounding + saturation — in quantized graphs the
+activation clamp lives in the output tensor's quantization range, not
+the fused-activation field), outputs re-quantized to the declared output
+dtype by default. That makes the importer caps-compatible with the
+tflite backend and label-parity comparable. Convs/matmuls request
+``Precision.HIGHEST`` so the fake-quant grid snapping stays faithful on
+TPU (bf16 MXU passes would compound per-layer rounding errors).
+
+The flatbuffer is parsed ONCE at load: op options and weights are copied
+into plain python/numpy structures, so the returned callable holds no
+references to the raw model bytes or schema objects.
+
+Supported builtin ops (the set covering the reference's test models —
+mobilenet_v2_1.0_224_quant, deeplabv3_257_mv_gpu, add, simple_32):
+CONV_2D, DEPTHWISE_CONV_2D, FULLY_CONNECTED, ADD, SUB, MUL, DIV, PAD,
+AVERAGE_POOL_2D, MAX_POOL_2D, MEAN, RESHAPE, SOFTMAX, RESIZE_BILINEAR,
+CONCATENATION, RELU, RELU6, LOGISTIC, TANH, DEQUANTIZE, QUANTIZE.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import DataType, TensorsInfo
+from ..core.tensors import TensorSpec
+
+# tflite schema enums (tensorflow.lite.python.schema_py_generated values;
+# named here so the importer reads like the spec)
+_PAD_SAME, _PAD_VALID = 0, 1
+_ACT_NONE, _ACT_RELU, _ACT_RELU_N1_1, _ACT_RELU6, _ACT_TANH = 0, 1, 2, 3, 4
+
+_TENSOR_TYPE_NP = {
+    0: np.float32, 1: np.float16, 2: np.int32, 3: np.uint8, 4: np.int64,
+    6: np.bool_, 7: np.int16, 9: np.int8, 10: np.float64,
+}
+
+
+class _Tensor:
+    """One tflite tensor's metadata (+ constant data, dropped after load)."""
+
+    def __init__(self, t, buffers):
+        self.shape = tuple(int(x) for x in (t.ShapeAsNumpy() if t.ShapeLength() else ()))
+        self.dtype = _TENSOR_TYPE_NP[t.Type()]
+        q = t.Quantization()
+        self.scale = self.zero_point = None
+        self.quant_dim = 0
+        if q is not None and q.ScaleLength():
+            self.scale = q.ScaleAsNumpy().astype(np.float32)
+            self.zero_point = (
+                q.ZeroPointAsNumpy().astype(np.int64)
+                if q.ZeroPointLength() else np.zeros_like(self.scale, np.int64)
+            )
+            self.quant_dim = int(q.QuantizedDimension())
+        buf = buffers[t.Buffer()]
+        self.data: Optional[np.ndarray] = None
+        if buf is not None and getattr(buf, "size", 0):
+            self.data = np.frombuffer(buf.tobytes(), self.dtype).reshape(self.shape)
+
+    @property
+    def quantized(self) -> bool:
+        return self.scale is not None and self.dtype in (np.uint8, np.int8, np.int32)
+
+    def dequantized(self) -> np.ndarray:
+        """Weight data as float32 (per-tensor or per-channel)."""
+        a = self.data
+        if a is None:
+            raise ValueError("tensor has no constant data")
+        if not self.quantized:
+            return a.astype(np.float32)
+        scale, zp = self.scale, self.zero_point
+        if scale.size > 1:  # per-channel: broadcast along quant_dim
+            bshape = [1] * a.ndim
+            bshape[self.quant_dim] = scale.size
+            scale = scale.reshape(bshape)
+            zp = zp.reshape(bshape)
+        return (a.astype(np.float32) - zp) * scale
+
+
+def _builtin_names():
+    from tensorflow.lite.python import schema_py_generated as s
+
+    return {v: k for k, v in vars(s.BuiltinOperator).items() if not k.startswith("_")}
+
+
+def _options(op, cls):
+    """Instantiate a typed options table over the op's raw flatbuffer."""
+    o = cls()
+    raw = op.BuiltinOptions()
+    if raw is None:
+        return None
+    o.Init(raw.Bytes, raw.Pos)
+    return o
+
+
+def _fused(act: int, x):
+    import jax.numpy as jnp
+
+    if act == _ACT_NONE:
+        return x
+    if act == _ACT_RELU:
+        return jnp.maximum(x, 0.0)
+    if act == _ACT_RELU_N1_1:
+        return jnp.clip(x, -1.0, 1.0)
+    if act == _ACT_RELU6:
+        return jnp.clip(x, 0.0, 6.0)
+    if act == _ACT_TANH:
+        return jnp.tanh(x)
+    raise NotImplementedError(f"tflite fused activation {act}")
+
+
+def _conv_padding(mode: int) -> str:
+    return "SAME" if mode == _PAD_SAME else "VALID"
+
+
+def _pool(x, kind: str, cfg: dict):
+    """AVERAGE/MAX pool via reduce_window; SAME average pooling divides by
+    the per-window valid-element count (tflite semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    kh, kw = cfg["filter"]
+    sh, sw = cfg["strides"]
+    pad = cfg["padding"]
+    dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+    if kind == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pad)
+    total = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pad)
+    if pad == "VALID":
+        return total / (kh * kw)
+    ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+    count = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pad)
+    return total / count
+
+
+def _resize_bilinear(x, out_hw, align_corners: bool, half_pixel: bool):
+    import jax.numpy as jnp
+
+    n, ih, iw, c = x.shape
+    oh, ow = int(out_hw[0]), int(out_hw[1])
+
+    def coords(out_n, in_n):
+        i = jnp.arange(out_n, dtype=jnp.float32)
+        if align_corners and out_n > 1:
+            return i * (in_n - 1) / (out_n - 1)
+        if half_pixel:
+            return jnp.clip((i + 0.5) * in_n / out_n - 0.5, 0.0, in_n - 1.0)
+        return jnp.clip(i * in_n / out_n, 0.0, in_n - 1.0)
+
+    ys, xs = coords(oh, ih), coords(ow, iw)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, ih - 1)
+    x1 = jnp.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    g = lambda yi, xi: x[:, yi][:, :, xi]  # gather rows then cols
+    top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+    bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+    return top * (1 - wy) + bot * wy
+
+
+def _parse_step(code: str, op, tensors: List[_Tensor]) -> dict:
+    """Extract everything an op needs into a plain dict, so execution never
+    touches flatbuffer schema objects (and the model bytes can be freed)."""
+    from tensorflow.lite.python import schema_py_generated as s
+
+    cfg: Dict[str, Any] = {}
+    if code in ("CONV_2D", "DEPTHWISE_CONV_2D"):
+        cls = s.Conv2DOptions if code == "CONV_2D" else s.DepthwiseConv2DOptions
+        o = _options(op, cls)
+        cfg = {
+            "strides": (o.StrideH(), o.StrideW()),
+            "padding": _conv_padding(o.Padding()),
+            "dilation": (o.DilationHFactor(), o.DilationWFactor()),
+            "act": o.FusedActivationFunction(),
+        }
+    elif code == "FULLY_CONNECTED":
+        o = _options(op, s.FullyConnectedOptions)
+        cfg = {"act": o.FusedActivationFunction()}
+    elif code in ("ADD", "SUB", "MUL", "DIV"):
+        cls = {"ADD": s.AddOptions, "SUB": s.SubOptions,
+               "MUL": s.MulOptions, "DIV": s.DivOptions}[code]
+        o = _options(op, cls)
+        cfg = {"act": o.FusedActivationFunction() if o is not None else _ACT_NONE}
+    elif code in ("AVERAGE_POOL_2D", "MAX_POOL_2D"):
+        o = _options(op, s.Pool2DOptions)
+        cfg = {
+            "filter": (o.FilterHeight(), o.FilterWidth()),
+            "strides": (o.StrideH(), o.StrideW()),
+            "padding": _conv_padding(o.Padding()),
+            "act": o.FusedActivationFunction(),
+        }
+    elif code == "MEAN":
+        o = _options(op, s.ReducerOptions)
+        cfg = {"keepdims": bool(o.KeepDims())}
+    elif code == "RESHAPE":
+        o = _options(op, s.ReshapeOptions)
+        if o is not None and o.NewShapeLength():
+            cfg = {"new_shape": [int(v) for v in o.NewShapeAsNumpy()]}
+    elif code == "SOFTMAX":
+        o = _options(op, s.SoftmaxOptions)
+        cfg = {"beta": o.Beta() if o is not None else 1.0}
+    elif code == "CONCATENATION":
+        o = _options(op, s.ConcatenationOptions)
+        cfg = {"axis": o.Axis(), "act": o.FusedActivationFunction()}
+    elif code == "RESIZE_BILINEAR":
+        o = _options(op, s.ResizeBilinearOptions)
+        cfg = {"align_corners": bool(o.AlignCorners()),
+               "half_pixel": bool(o.HalfPixelCenters())}
+    return cfg
+
+
+def load_tflite(path: str, options: Optional[Dict[str, str]] = None
+                ) -> Tuple[Callable, TensorsInfo, TensorsInfo]:
+    """Parse ``path`` and return ``(fn, in_info, out_info)``.
+
+    ``fn(*inputs)`` is jax-traceable; quantized inputs may be fed as their
+    integer dtype (dequantized in-graph) or pre-dequantized float32.
+    ``options['float_output']`` truthy → skip output re-quantization and
+    emit float32.
+    """
+    import jax
+    import jax.numpy as jnp
+    from tensorflow.lite.python import schema_py_generated as s
+
+    options = options or {}
+    float_output = str(options.get("float_output", "")).lower() in ("1", "true", "yes")
+
+    with open(path, "rb") as fh:
+        data = fh.read()
+    model = s.Model.GetRootAsModel(data, 0)
+    buffers = [
+        model.Buffers(i).DataAsNumpy() if model.Buffers(i).DataLength() else None
+        for i in range(model.BuffersLength())
+    ]
+    sg = model.Subgraphs(0)
+    tensors = [_Tensor(sg.Tensors(i), buffers) for i in range(sg.TensorsLength())]
+    in_idx = [int(i) for i in sg.InputsAsNumpy()]
+    out_idx = [int(i) for i in sg.OutputsAsNumpy()]
+    names = _builtin_names()
+
+    opcodes = []
+    for i in range(model.OperatorCodesLength()):
+        oc = model.OperatorCodes(i)
+        opcodes.append(max(oc.BuiltinCode(), oc.DeprecatedBuiltinCode()))
+
+    steps: List[Tuple[str, dict, List[int], List[int]]] = []
+    for i in range(sg.OperatorsLength()):
+        op = sg.Operators(i)
+        code = names.get(opcodes[op.OpcodeIndex()], str(opcodes[op.OpcodeIndex()]))
+        ins = [int(x) for x in op.InputsAsNumpy()]
+        outs = [int(x) for x in op.OutputsAsNumpy()]
+        steps.append((code, _parse_step(code, op, tensors), ins, outs))
+
+    # materialize constants once (weights dequantized to f32, shape/axis
+    # operands raw), then drop the raw views so the callable holds no
+    # reference to the model bytes
+    consts: Dict[int, np.ndarray] = {}
+    raw_consts: Dict[int, np.ndarray] = {}
+    for idx, t in enumerate(tensors):
+        if t.data is not None:
+            raw_consts[idx] = np.array(t.data)  # owned copy, small operands
+            consts[idx] = t.dequantized() if t.quantized else t.data.astype(t.dtype)
+            t.data = None
+    del model, buffers, data, sg
+
+    def _in(env, idx):
+        if idx in env:
+            return env[idx]
+        return jnp.asarray(consts[idx])
+
+    def _fake_quant(idx: int, y):
+        """Emulate integer inference on an activation tensor: round to the
+        tensor's quantization grid and saturate to its integer range. In
+        quantized tflite graphs the activation clamp (e.g. relu6) lives in
+        the OUTPUT tensor's quantization range, not the fused-activation
+        field — without this, out-of-range values propagate un-saturated
+        and the float simulation diverges from the interpreter."""
+        t = tensors[idx]
+        if not (t.quantized and t.dtype in (np.uint8, np.int8)):
+            return y
+        if not jnp.issubdtype(jnp.asarray(y).dtype, jnp.floating):
+            return y
+        scale, zp = float(t.scale[0]), float(t.zero_point[0])
+        info = np.iinfo(t.dtype)
+        q = jnp.clip(jnp.round(y / scale) + zp, info.min, info.max)
+        return (q - zp) * scale
+
+    def _const(idx) -> np.ndarray:
+        """Operand that must be statically known at trace time (shapes,
+        axes, pads) — raw integer values, not dequantized."""
+        if idx not in raw_consts:
+            raise NotImplementedError(
+                f"tflite import: dynamic (non-const) shape operand tensor {idx}"
+            )
+        return raw_consts[idx]
+
+    # full-precision accumulation: fake-quant snapping is only faithful when
+    # the MXU doesn't round products to bf16 first
+    precision = jax.lax.Precision.HIGHEST
+
+    def fn(*inputs):
+        env: Dict[int, Any] = {}
+        for i, idx in enumerate(in_idx):
+            t = tensors[idx]
+            x = jnp.asarray(inputs[i])
+            if t.quantized and not jnp.issubdtype(x.dtype, jnp.floating):
+                x = (x.astype(jnp.float32) - float(t.zero_point[0])) * float(t.scale[0])
+            elif x.dtype != jnp.float32 and jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(jnp.float32)
+            env[idx] = x
+
+        for code, cfg, ins, outs in steps:
+            if code == "CONV_2D":
+                x, w = _in(env, ins[0]), _in(env, ins[1])
+                y = jax.lax.conv_general_dilated(
+                    x, jnp.transpose(w, (1, 2, 3, 0)),  # OHWI → HWIO
+                    window_strides=cfg["strides"],
+                    padding=cfg["padding"],
+                    rhs_dilation=cfg["dilation"],
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    precision=precision,
+                )
+                if len(ins) > 2 and ins[2] >= 0:
+                    y = y + _in(env, ins[2])
+                env[outs[0]] = _fused(cfg["act"], y)
+            elif code == "DEPTHWISE_CONV_2D":
+                x, w = _in(env, ins[0]), _in(env, ins[1])
+                in_c = x.shape[-1]
+                # tflite weights [1, kh, kw, in_c*mult] → HWIO groups=in_c
+                kh, kw, oc = w.shape[1], w.shape[2], w.shape[3]
+                y = jax.lax.conv_general_dilated(
+                    x, jnp.reshape(w, (kh, kw, 1, oc)),
+                    window_strides=cfg["strides"],
+                    padding=cfg["padding"],
+                    rhs_dilation=cfg["dilation"],
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    feature_group_count=in_c,
+                    precision=precision,
+                )
+                if len(ins) > 2 and ins[2] >= 0:
+                    y = y + _in(env, ins[2])
+                env[outs[0]] = _fused(cfg["act"], y)
+            elif code == "FULLY_CONNECTED":
+                x, w = _in(env, ins[0]), _in(env, ins[1])
+                y = jnp.matmul(x.reshape(x.shape[0], -1), w.T, precision=precision)
+                if len(ins) > 2 and ins[2] >= 0:
+                    y = y + _in(env, ins[2])
+                env[outs[0]] = _fused(cfg["act"], y)
+            elif code in ("ADD", "SUB", "MUL", "DIV"):
+                a, b = _in(env, ins[0]), _in(env, ins[1])
+                y = {"ADD": a + b, "SUB": a - b, "MUL": a * b, "DIV": a / b}[code]
+                env[outs[0]] = _fused(cfg["act"], y)
+            elif code == "AVERAGE_POOL_2D":
+                env[outs[0]] = _fused(cfg["act"], _pool(_in(env, ins[0]), "avg", cfg))
+            elif code == "MAX_POOL_2D":
+                env[outs[0]] = _fused(cfg["act"], _pool(_in(env, ins[0]), "max", cfg))
+            elif code == "MEAN":
+                axes = tuple(int(a) for a in np.atleast_1d(_const(ins[1])))
+                env[outs[0]] = jnp.mean(
+                    _in(env, ins[0]), axis=axes, keepdims=cfg["keepdims"])
+            elif code == "PAD":
+                pads = np.asarray(_const(ins[1])).reshape(-1, 2)
+                env[outs[0]] = jnp.pad(_in(env, ins[0]), [tuple(p) for p in pads])
+            elif code == "RESHAPE":
+                x = _in(env, ins[0])
+                if "new_shape" in cfg:
+                    shape = list(cfg["new_shape"])
+                else:
+                    shape = [int(v) for v in np.asarray(_const(ins[1])).reshape(-1)]
+                # batch-polymorphism: rewrite a recorded batch-1 leading dim
+                # to the runtime batch ONLY when the recorded shape cannot
+                # hold the actual element count
+                if (shape and shape[0] == 1 and x.shape[0] != 1
+                        and -1 not in shape
+                        and int(np.prod(shape)) != int(np.prod(x.shape))):
+                    shape[0] = int(x.shape[0])
+                env[outs[0]] = x.reshape(shape)
+            elif code == "SOFTMAX":
+                env[outs[0]] = jax.nn.softmax(_in(env, ins[0]) * cfg["beta"], axis=-1)
+            elif code == "CONCATENATION":
+                parts = [_in(env, i) for i in ins]
+                y = jnp.concatenate(parts, axis=cfg["axis"])
+                env[outs[0]] = _fused(cfg["act"], y)
+            elif code == "RESIZE_BILINEAR":
+                out_hw = np.asarray(_const(ins[1])).reshape(-1)
+                env[outs[0]] = _resize_bilinear(
+                    _in(env, ins[0]), out_hw,
+                    cfg["align_corners"], cfg["half_pixel"])
+            elif code == "RELU":
+                env[outs[0]] = jnp.maximum(_in(env, ins[0]), 0.0)
+            elif code == "RELU6":
+                env[outs[0]] = jnp.clip(_in(env, ins[0]), 0.0, 6.0)
+            elif code == "LOGISTIC":
+                env[outs[0]] = jax.nn.sigmoid(_in(env, ins[0]))
+            elif code == "TANH":
+                env[outs[0]] = jnp.tanh(_in(env, ins[0]))
+            elif code in ("DEQUANTIZE", "QUANTIZE"):
+                t = tensors[ins[0]]
+                x = _in(env, ins[0])
+                if code == "DEQUANTIZE" and not jnp.issubdtype(x.dtype, jnp.floating):
+                    x = (x.astype(jnp.float32) - float(t.zero_point[0])) * float(t.scale[0])
+                env[outs[0]] = x.astype(jnp.float32)
+            else:
+                raise NotImplementedError(f"tflite import: builtin op {code}")
+            for oidx in outs:
+                env[oidx] = _fake_quant(oidx, env[oidx])
+
+        results = []
+        for idx in out_idx:
+            y = env[idx]
+            t = tensors[idx]
+            if t.quantized and not float_output:
+                q = jnp.round(y / float(t.scale[0])) + float(t.zero_point[0])
+                info = np.iinfo(t.dtype)
+                y = jnp.clip(q, info.min, info.max).astype(t.dtype)
+            results.append(y)
+        return tuple(results)
+
+    def _spec(idx, force_float):
+        t = tensors[idx]
+        dt = np.float32 if (force_float and t.quantized) else t.dtype
+        return TensorSpec(t.shape, DataType.from_any(np.dtype(dt)))
+
+    in_info = TensorsInfo.of(*(_spec(i, False) for i in in_idx))
+    out_info = TensorsInfo.of(*(_spec(i, float_output) for i in out_idx))
+    return fn, in_info, out_info

@@ -1,0 +1,229 @@
+"""Decoder-only transformer LM with dp/tp/sp sharding — the distributed
+training demonstrator.
+
+The reference has no training-at-scale (SURVEY.md §2.9); this model is the
+TPU-native counterpart of that gap: one train step jitted over a
+``Mesh("dp","tp","sp")`` where
+  * batch is sharded over ``dp`` (data parallel),
+  * attention heads / mlp hidden are sharded over ``tp`` (tensor parallel —
+    XLA inserts the all-reduces the reference would need NCCL for),
+  * sequence activations are sharded over ``sp`` (context parallel; GSPMD
+    gathers K/V across ``sp`` for attention — the all-to-all family).
+
+Pure jax (no flax) so the param pytree's shardings are explicit and visible.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    dim: int = 64
+    heads: int = 4
+    layers: int = 2
+    mlp_mult: int = 4
+    max_seq: int = 128
+    # attention impl: "gspmd" (sharding-constraint driven, XLA picks the
+    # collectives), "ring" (ppermute ring attention over sp), "ulysses"
+    # (all_to_all head/seq reshard over sp) — see parallel/context.py
+    attn_impl: str = "gspmd"
+    # expert parallelism: >0 replaces the dense FFN with a switch-routed
+    # MoE of this many experts, sharded over the tp axis (parallel/moe.py)
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # switch-transformer load-balance coeff
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.split(jax.random.PRNGKey(seed), 2 + cfg.layers)
+    scale = 0.02
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    params: Dict[str, Any] = {
+        "embed": dense(k[0], (cfg.vocab, cfg.dim)),
+        "pos": dense(k[1], (cfg.max_seq, cfg.dim)),
+        "blocks": [],
+        "out_norm": jnp.ones((cfg.dim,), jnp.float32),
+    }
+    f = cfg.dim * cfg.mlp_mult
+    for i in range(cfg.layers):
+        kk = jax.random.split(k[2 + i], 6)
+        block = {
+            "ln1": jnp.ones((cfg.dim,), jnp.float32),
+            "wqkv": dense(kk[0], (cfg.dim, 3 * cfg.dim)),
+            "wo": dense(kk[1], (cfg.dim, cfg.dim)),
+            "ln2": jnp.ones((cfg.dim,), jnp.float32),
+        }
+        if cfg.moe_experts > 0:
+            from ..parallel.moe import init_moe_params
+
+            block["moe"] = init_moe_params(kk[2], cfg.dim, f, cfg.moe_experts)
+        else:
+            block["w1"] = dense(kk[2], (cfg.dim, f))
+            block["w2"] = dense(kk[3], (f, cfg.dim))
+        params["blocks"].append(block)
+    return params
+
+
+def param_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs: tensor-parallel over 'tp' (megatron-style: column-
+    parallel in, row-parallel out)."""
+    from jax.sharding import PartitionSpec as P
+
+    block = {
+        "ln1": P(None),
+        "wqkv": P(None, "tp"),
+        "wo": P("tp", None),
+        "ln2": P(None),
+    }
+    if cfg.moe_experts > 0:
+        # expert parallelism rides the tp axis: each tp shard holds
+        # moe_experts/tp experts (parallel/moe.py)
+        from ..parallel.moe import moe_pspecs
+
+        block["moe"] = moe_pspecs(ep_axis="tp")
+    else:
+        block["w1"] = P(None, "tp")
+        block["w2"] = P("tp", None)
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "blocks": [dict(block) for _ in range(cfg.layers)],
+        "out_norm": P(None),
+    }
+
+
+def _rmsnorm(x, g):
+    import jax.numpy as jnp
+
+    return x * g / jnp.sqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+
+
+def forward(cfg: TransformerConfig, params, tokens, mesh=None,
+            return_aux: bool = False):
+    """tokens (B, S) int32 -> logits (B, S, V), or (logits, aux_loss) with
+    ``return_aux`` (MoE load-balance term, 0 for dense). With ``mesh``,
+    activations are constrained to P("dp", "sp", None) so GSPMD keeps
+    sequence sharded."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def constrain(x, *spec):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec))
+        )
+
+    ctx_attn = None
+    if mesh is not None and cfg.attn_impl != "gspmd":
+        from ..parallel.context import make_context_attention
+
+        ctx_attn = make_context_attention(mesh, impl=cfg.attn_impl)
+
+    B, S = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:S][None, :, :]
+    x = constrain(x, "dp", "sp", None)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    aux_total = jnp.zeros((), jnp.float32)
+    for blk in params["blocks"]:
+        h = _rmsnorm(x, blk["ln1"])
+        qkv = h @ blk["wqkv"]                      # (B,S,3D) — tp-sharded cols
+        q, kk, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+
+        q, kk, v = heads(q), heads(kk), heads(v)   # (B,H,S,Dh)
+        if ctx_attn is not None:
+            o = ctx_attn(q, kk, v)
+            o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        else:
+            att = (q @ kk.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+            att = jnp.where(mask[None, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1)
+            o = (att @ v).transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        x = x + o @ blk["wo"]
+        x = constrain(x, "dp", "sp", None)
+        h = _rmsnorm(x, blk["ln2"])
+        if "moe" in blk:
+            from ..parallel.moe import moe_ffn
+
+            y, aux = moe_ffn(blk["moe"], h, mesh, ep_axis="tp",
+                             capacity_factor=cfg.moe_capacity_factor,
+                             return_aux=True)
+            x = x + y
+            aux_total = aux_total + aux
+        else:
+            x = x + jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
+        x = constrain(x, "dp", "sp", None)
+    x = _rmsnorm(x, params["out_norm"])
+    logits = x @ params["embed"].T                 # tied un-embedding
+    if return_aux:
+        return logits, aux_total
+    return logits
+
+
+def loss_fn(cfg: TransformerConfig, params, tokens, mesh=None):
+    """Next-token cross entropy (+ MoE load-balance auxiliary term — the
+    switch router collapses onto one expert without it)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits, aux = forward(cfg, params, tokens[:, :-1], mesh, return_aux=True)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll) + cfg.moe_aux_weight * aux
+
+
+def make_train_step(cfg: TransformerConfig, mesh, lr: float = 1e-2):
+    """Build (jitted_step, shard_params, data_sharding): the full sharded
+    training step — grads via value_and_grad, sgd update, params donated."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if cfg.moe_experts > 0:
+        tp_size = dict(mesh.shape).get("tp", 1)
+        if cfg.moe_experts % tp_size:
+            raise ValueError(
+                f"moe_experts={cfg.moe_experts} must be divisible by the "
+                f"tp axis size {tp_size} (experts are sharded over tp)")
+    pspecs = param_pspecs(cfg)
+    param_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    data_sharding = NamedSharding(mesh, P("dp", None))
+
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, mesh)
+        )(params)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(param_shardings, data_sharding),
+        out_shardings=(param_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+
+    def shard_params(params):
+        return jax.device_put(params, param_shardings)
+
+    return jitted, shard_params, data_sharding

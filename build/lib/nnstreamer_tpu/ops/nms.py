@@ -1,0 +1,105 @@
+"""Non-maximum suppression + box utilities (decoder post-processing).
+
+Reference analog: the NMS/IoU logic embedded in
+``ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c`` (consts
+DETECTION_THRESHOLD/IOU 0.5 etc., :138-141). Two implementations:
+
+* ``nms_numpy`` — host-side, exact match of the reference's greedy NMS,
+  used by decoders (box counts are tiny; host wins over a device round-trip);
+* ``nms_jax`` — jit-compatible fixed-size variant (lax.fori_loop mask
+  sweep) for keeping NMS inside a fused device pipeline when the model
+  already runs on TPU and the detection count is large.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_IOU_THRESHOLD = 0.5
+DEFAULT_SCORE_THRESHOLD = 0.25
+
+
+def iou_matrix(boxes: np.ndarray) -> np.ndarray:
+    """Pairwise IoU for (N,4) [ymin,xmin,ymax,xmax] boxes."""
+    y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(y2 - y1, 0) * np.maximum(x2 - x1, 0)
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    inter = np.maximum(iy2 - iy1, 0) * np.maximum(ix2 - ix1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-9), 0.0)
+
+
+def nms_numpy(boxes: np.ndarray, scores: np.ndarray,
+              iou_threshold: float = DEFAULT_IOU_THRESHOLD,
+              score_threshold: float = DEFAULT_SCORE_THRESHOLD,
+              max_out: int = 100) -> np.ndarray:
+    """Greedy NMS; returns indices of kept boxes (descending score)."""
+    keep_mask = scores >= score_threshold
+    idx = np.flatnonzero(keep_mask)
+    if idx.size == 0:
+        return idx
+    order = idx[np.argsort(-scores[idx])]
+    ious = iou_matrix(boxes[order])
+    kept = []
+    suppressed = np.zeros(order.size, bool)
+    for i in range(order.size):
+        if suppressed[i]:
+            continue
+        kept.append(order[i])
+        if len(kept) >= max_out:
+            break
+        suppressed |= ious[i] > iou_threshold
+        suppressed[i] = False
+    return np.asarray(kept, dtype=np.int64)
+
+
+def nms_jax(boxes, scores,
+            iou_threshold: float = DEFAULT_IOU_THRESHOLD,
+            score_threshold: float = DEFAULT_SCORE_THRESHOLD,
+            max_out: int = 100):
+    """Fixed-size jit-friendly NMS: returns (indices[max_out], valid[max_out]).
+
+    Suppression sweep over score-sorted boxes using a mask; O(N·max_out) but
+    fully vectorized on the VPU — keeps detection post-processing on device.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    s = scores[order]
+
+    y1, x1, y2, x2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    area = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+
+    def iou_row(i):
+        iy1 = jnp.maximum(y1[i], y1)
+        ix1 = jnp.maximum(x1[i], x1)
+        iy2 = jnp.minimum(y2[i], y2)
+        ix2 = jnp.minimum(x2[i], x2)
+        inter = jnp.maximum(iy2 - iy1, 0) * jnp.maximum(ix2 - ix1, 0)
+        union = area[i] + area - inter
+        return jnp.where(union > 0, inter / jnp.maximum(union, 1e-9), 0.0)
+
+    def body(i, state):
+        alive, kept, count = state
+        ok = alive[i] & (s[i] >= score_threshold) & (count < max_out)
+        kept = jax.lax.cond(
+            ok, lambda k: k.at[count].set(order[i]), lambda k: k, kept
+        )
+        count = count + ok.astype(jnp.int32)
+        row = iou_row(i)
+        alive = jnp.where(ok, alive & ~(row > iou_threshold), alive)
+        alive = alive.at[i].set(False)
+        return alive, kept, count
+
+    alive0 = jnp.ones((n,), bool)
+    kept0 = jnp.full((max_out,), -1, jnp.int32)
+    _, kept, count = jax.lax.fori_loop(0, n, body, (alive0, kept0, jnp.int32(0)))
+    valid = jnp.arange(max_out) < count
+    return kept, valid

@@ -1,0 +1,180 @@
+"""Elementwise tensor-stream ops, jit-compiled (L3 compute).
+
+Reference analog: the ORC assembly-DSL SIMD kernels behind ``tensor_transform``
+(gst/nnstreamer/elements/nnstreamer-orc.orc + the macro dispatch in
+gsttensor_transform.c:460-490). TPU redesign: each transform mode is a pure
+jax function; XLA fuses chains of them into single kernels, which is exactly
+the role ORC plays on CPU — except the fusion crosses op boundaries here.
+
+Every ``make_*`` returns a jax-traceable ``fn(x) -> y``; the transform element
+jit-caches per input signature.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+from ..core import DataType
+from ..core.data import parse_number
+
+
+def make_typecast(dtype: DataType) -> Callable:
+    import jax.numpy as jnp
+
+    target = jnp.dtype(dtype.np_dtype)
+
+    def fn(x):
+        return x.astype(target)
+
+    return fn
+
+
+def make_dimchg(from_dim: int, to_dim: int) -> Callable:
+    """Move axis ``from_dim`` to position ``to_dim``.
+
+    NOTE on conventions: the reference's dimchg indexes dims lowest-first
+    ("0:3" = NCHW->NHWC style moves, gsttensor_transform.h:57-67); our axes
+    are row-major python axes counted from the end when negative.
+    """
+    import jax.numpy as jnp
+
+    def fn(x):
+        return jnp.moveaxis(x, from_dim, to_dim)
+
+    return fn
+
+
+def make_transpose(axes: Sequence[int]) -> Callable:
+    import jax.numpy as jnp
+
+    axes_t = tuple(axes)
+
+    def fn(x):
+        return jnp.transpose(x, axes_t)
+
+    return fn
+
+
+def make_arithmetic(ops: Sequence[Tuple[str, float]],
+                    out_dtype: DataType | None = None) -> Callable:
+    """Chained scalar arithmetic: [("add", 1), ("mul", 0.5), ...] — the
+    reference's operator-chain syntax ``add:1,mul:0.5`` incl. per-channel
+    variants handled by broadcasting."""
+    import jax.numpy as jnp
+
+    def fn(x):
+        y = x
+        if out_dtype is not None:
+            y = y.astype(jnp.dtype(out_dtype.np_dtype))
+        elif not np.issubdtype(np.dtype(str(x.dtype)), np.floating):
+            y = y.astype(jnp.float32)  # reference promotes int arith to float
+        for op, val in ops:
+            if op == "add":
+                y = y + val
+            elif op == "sub":
+                y = y - val
+            elif op == "mul":
+                y = y * val
+            elif op == "div":
+                y = y / val
+            elif op == "pow":
+                y = y ** val
+            else:
+                raise ValueError(f"unknown arithmetic op '{op}'")
+        return y
+
+    return fn
+
+
+def make_stand(mode: str = "default", per_channel: bool = False) -> Callable:
+    """Standardization: zero-mean/unit-variance ("default") or dc-removal
+    ("dc-average") — reference stand mode."""
+    import jax.numpy as jnp
+
+    def fn(x):
+        xf = x.astype(jnp.float32)
+        axes = tuple(range(xf.ndim - 1)) if per_channel else None
+        mean = jnp.mean(xf, axis=axes, keepdims=per_channel)
+        if mode == "dc-average":
+            return xf - mean
+        std = jnp.std(xf, axis=axes, keepdims=per_channel)
+        return (xf - mean) / jnp.maximum(std, 1e-10)
+
+    return fn
+
+
+def make_clamp(lo: float, hi: float) -> Callable:
+    import jax.numpy as jnp
+
+    def fn(x):
+        return jnp.clip(x, lo, hi)
+
+    return fn
+
+
+def make_padding(pads: Sequence[Tuple[int, int]], value: float = 0.0) -> Callable:
+    import jax.numpy as jnp
+
+    pads_t = tuple(tuple(p) for p in pads)
+
+    def fn(x):
+        return jnp.pad(x, pads_t, constant_values=value)
+
+    return fn
+
+
+# -- option-string parsing (reference gsttensor_transform.c property syntax) --
+
+def parse_transform_options(mode: str, option: str):
+    """Parse the ``option=`` string for a transform ``mode`` into a maker call.
+
+    Syntax parity (gsttensor_transform.h:57-67 modes):
+      * typecast: ``option=uint8``
+      * arithmetic: ``option=typecast:float32,add:-127.5,div:127.5``
+      * transpose: ``option=1:0:2`` (axis order)
+      * dimchg: ``option=0:2`` (move axis 0 to 2)
+      * stand: ``option=default`` | ``dc-average`` [``:per-channel``]
+      * clamp: ``option=lo:hi``
+      * padding: ``option=a0lo:a0hi,a1lo:a1hi,...`` [``,value:v``]
+    """
+    if mode == "typecast":
+        return make_typecast(DataType.from_any(option.strip()))
+    if mode == "arithmetic":
+        ops: List[Tuple[str, float]] = []
+        out_dtype = None
+        for part in option.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            op, _, val = part.partition(":")
+            op = op.strip().lower()
+            if op == "typecast":
+                out_dtype = DataType.from_any(val.strip())
+            else:
+                ops.append((op, parse_number(val)))
+        return make_arithmetic(ops, out_dtype)
+    if mode == "transpose":
+        return make_transpose([int(p) for p in option.split(":")])
+    if mode == "dimchg":
+        frm, _, to = option.partition(":")
+        return make_dimchg(int(frm), int(to))
+    if mode == "stand":
+        parts = option.split(":")
+        return make_stand(parts[0] or "default",
+                          per_channel=("per-channel" in parts))
+    if mode == "clamp":
+        lo, _, hi = option.partition(":")
+        return make_clamp(parse_number(lo), parse_number(hi))
+    if mode == "padding":
+        pads = []
+        value = 0.0
+        for part in option.split(","):
+            part = part.strip()
+            if part.startswith("value:"):
+                value = parse_number(part.split(":", 1)[1])
+            elif part:
+                lo, _, hi = part.partition(":")
+                pads.append((int(lo), int(hi)))
+        return make_padding(pads, value)
+    raise ValueError(f"unknown transform mode '{mode}'")

@@ -1,0 +1,27 @@
+"""Parallelism layer: device meshes, sharded runners, context parallelism.
+
+TPU-native counterpart of the reference's pipeline-topology parallelism
+(SURVEY.md §2.9): dp/tp via pjit shardings (`shard.py`, `mesh.py`), sp/cp
+via ring attention and Ulysses (`context.py`).
+"""
+from .mesh import AXES, factor_devices, make_mesh
+from .multihost import global_mesh, init_multihost, process_info
+from .shard import ShardedRunner
+from .context import (
+    make_context_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+__all__ = [
+    "AXES",
+    "factor_devices",
+    "make_mesh",
+    "ShardedRunner",
+    "global_mesh",
+    "init_multihost",
+    "process_info",
+    "make_context_attention",
+    "ring_attention",
+    "ulysses_attention",
+]

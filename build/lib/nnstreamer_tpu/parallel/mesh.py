@@ -1,0 +1,73 @@
+"""Device mesh construction + sharding helpers (TPU-first distribution).
+
+The reference's distribution is pipeline offloading over TCP/MQTT (SURVEY.md
+§2.8-2.9: no DP/TP/SP, no collectives). The TPU-native equivalents here:
+intra-slice parallelism is expressed as ``jax.sharding`` over a ``Mesh`` and
+XLA inserts the ICI collectives (the scaling-book recipe: pick a mesh,
+annotate shardings, let GSPMD do the rest).
+
+Axis conventions used across the package:
+  * ``dp``  — data/batch parallel
+  * ``tp``  — tensor/model parallel (attention heads, mlp hidden)
+  * ``sp``  — sequence/context parallel (long-context activations)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXES = ("dp", "tp", "sp")
+
+
+def factor_devices(n: int, want: Sequence[str] = AXES) -> Dict[str, int]:
+    """Factor ``n`` devices into mesh axis sizes, preferring dp ≥ tp ≥ sp.
+
+    8 -> {dp:2, tp:2, sp:2}; 4 -> {dp:2, tp:2, sp:1}; 6 -> {dp:3, tp:2, sp:1};
+    prime n lands entirely on dp.
+    """
+    sizes = {a: 1 for a in want}
+    remaining = n
+    order = list(want)
+    # greedily strip small prime factors round-robin so axes stay balanced
+    factors: List[int] = []
+    m = remaining
+    d = 2
+    while d * d <= m:
+        while m % d == 0:
+            factors.append(d)
+            m //= d
+        d += 1
+    if m > 1:
+        factors.append(m)
+    factors.sort(reverse=True)
+    for i, f in enumerate(factors):
+        sizes[order[i % len(order)]] *= f
+    return sizes
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              axis_sizes: Optional[Dict[str, int]] = None):
+    """Build a ``jax.sharding.Mesh`` with the package's axis names."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = axis_sizes or factor_devices(len(devices))
+    # canonical ordering: known axes keep the dp-outermost convention
+    # (dp spans hosts/DCN, tp/sp stay inner on ICI — multihost layout
+    # depends on this) regardless of the caller's dict order; custom axes
+    # ("ep", ...) follow in insertion order after the known ones
+    axes = tuple([a for a in AXES if a in sizes]
+                 + [a for a in sizes if a not in AXES])
+    shape = tuple(sizes[a] for a in axes)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh {sizes} does not cover {len(devices)} devices")
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, axes)
+
+
+def named_sharding(mesh, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec))

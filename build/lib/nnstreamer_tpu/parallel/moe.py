@@ -1,0 +1,118 @@
+"""Expert parallelism: mixture-of-experts FFN with experts sharded over a
+mesh axis (EP).
+
+The reference has no expert parallelism (SURVEY.md §2.9: its nearest
+analog is per-frame conditional routing via tensor_if/demux); this is the
+TPU-native treatment: switch (top-1) routing expressed as DENSE one-hot
+dispatch/combine einsums — static shapes, no data-dependent gathers, so
+XLA tiles everything onto the MXU — with the expert dimension sharded
+over a mesh axis via sharding constraints, letting GSPMD insert the
+all_to_all family of collectives over ICI (the GShard/Switch formulation
+re-derived for this runtime).
+
+Capacity semantics: each expert processes at most
+``ceil(tokens/experts * capacity_factor)`` tokens; overflow tokens fall
+through the residual connection (contribute zero from the MoE branch) —
+the standard load-shedding stance, matching the framework's QoS
+philosophy.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+
+def init_moe_params(key, dim: int, hidden: int, num_experts: int,
+                    scale: float = 0.02) -> Dict[str, Any]:
+    """Router + per-expert FFN weights: wr (D,E), w1 (E,D,F), w2 (E,F,D)."""
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "wr": jax.random.normal(k1, (dim, num_experts), jnp.float32) * scale,
+        "w1": jax.random.normal(k2, (num_experts, dim, hidden), jnp.float32) * scale,
+        "w2": jax.random.normal(k3, (num_experts, hidden, dim), jnp.float32) * scale,
+    }
+
+
+def moe_pspecs(ep_axis: str = "ep"):
+    """PartitionSpecs for the MoE block: experts sharded over ``ep_axis``
+    (models reusing an existing model-parallel axis pass e.g. "tp")."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "wr": P(None, None),              # router replicated (tiny)
+        "w1": P(ep_axis, None, None),     # each chip holds E/ep experts
+        "w2": P(ep_axis, None, None),
+    }
+
+
+def moe_ffn(params: Dict[str, Any], x, mesh=None, ep_axis: str = "ep",
+            capacity_factor: float = 1.25, return_aux: bool = False):
+    """Switch-routed expert FFN. ``x`` (..., D) → (..., D), or
+    ``(y, aux_loss)`` with ``return_aux`` (wire the load-balance loss into
+    training or the router can collapse onto one expert).
+
+    Dense dispatch: a (T, E, C) one-hot tensor carries each token to its
+    expert slot; expert compute is one batched einsum over (E, C, D); the
+    combine einsum weights results by the router gate. With ``mesh``, the
+    (E, ...) tensors are constrained to ``ep_axis`` so expert compute and
+    weights live together per chip and GSPMD moves tokens, not experts.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    xt = x.reshape(-1, D)                      # (T, D)
+    T = xt.shape[0]
+    E = params["wr"].shape[1]
+    C = max(1, math.ceil(T / E * capacity_factor))
+
+    def constrain(t, *spec):
+        if mesh is None or ep_axis not in mesh.axis_names:
+            return t
+        return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, P(*spec)))
+
+    # routing bookkeeping stays float32 regardless of activation dtype:
+    # bf16 cumsum counters round above 256 and would collide capacity slots
+    logits = (xt.astype(jnp.float32) @ params["wr"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = probs.max(axis=-1)                  # (T,)
+    expert = probs.argmax(axis=-1)             # (T,)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)       # (T, E)
+    # position of each token within its expert's capacity buffer
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot        # (T, E)
+    keep = (pos < C) * onehot                                   # drop overflow
+    pos_oh = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
+                            dtype=jnp.float32)                  # (T, C)
+    dispatch = (keep[:, :, None] * pos_oh[:, None, :]).astype(xt.dtype)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)         # (E, C, D)
+    expert_in = constrain(expert_in, ep_axis, None, None)
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))
+    h = constrain(h, ep_axis, None, None)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])    # (E, C, D)
+    expert_out = constrain(expert_out, ep_axis, None, None)
+
+    combine = dispatch * gate.astype(xt.dtype)[:, None, None]   # (T, E, C)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out).reshape(orig_shape)
+    if return_aux:
+        return y, load_balance_loss(logits, expert)
+    return y
+
+
+def load_balance_loss(logits, expert) -> Any:
+    """Switch-transformer auxiliary loss: mean(expert fraction × router
+    probability fraction) × E — pushes the router toward uniform load."""
+    import jax
+    import jax.numpy as jnp
+
+    E = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1).reshape(-1, E)
+    onehot = jax.nn.one_hot(expert.reshape(-1), E, dtype=probs.dtype)
+    frac_tokens = onehot.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    return (frac_tokens * frac_probs).sum() * E

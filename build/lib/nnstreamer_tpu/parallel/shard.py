@@ -1,0 +1,53 @@
+"""Sharded pipeline inference (TPU-first DP/TP).
+
+The reference expresses data parallelism as pipeline topology (``tee`` + N
+filter branches, SURVEY.md §2.9) and tensor parallelism as
+``tensor_split → filters → tensor_merge``. Here the same intents are one
+sharded executable: ``ShardedRunner`` wraps a model callable in ``jax.jit``
+with a batch sharding over the mesh's ``dp`` axis (and whatever param
+shardings the model declares), so one invoke uses every chip and XLA places
+the collectives on ICI.
+
+Used by ``tensor_filter`` through the ``custom=sharded:dp`` option of the jax
+backend's model callables, or directly:
+
+    runner = ShardedRunner(fn)
+    out = runner(batch)      # batch split across all devices
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from .mesh import AXES, factor_devices, make_mesh
+
+
+class ShardedRunner:
+    def __init__(self, fn: Callable, mesh=None, batch_axis: str = "dp"):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if mesh is None:
+            n = len(jax.devices())
+            mesh = make_mesh(axis_sizes={"dp": n, "tp": 1, "sp": 1})
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self._in_sharding = NamedSharding(mesh, P(batch_axis))
+        self._jit = jax.jit(fn, in_shardings=(self._in_sharding,))
+
+    @property
+    def batch_divisor(self) -> int:
+        return self.mesh.shape[self.batch_axis]
+
+    def __call__(self, batch):
+        import jax
+
+        n = self.batch_divisor
+        if batch.shape[0] % n:
+            raise ValueError(
+                f"batch {batch.shape[0]} not divisible by dp={n} "
+                f"(pad upstream with tensor_aggregator)"
+            )
+        batch = jax.device_put(batch, self._in_sharding)
+        return self._jit(batch)

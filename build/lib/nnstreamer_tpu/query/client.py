@@ -1,0 +1,114 @@
+"""Tensor-query client core (L5).
+
+Reference analog: the client side of nnstreamer-edge
+(tensor_query_client.c:524-549 create/connect, :656-692 per-frame send,
+:421-487 event callback receiving answers / connection-closed)."""
+from __future__ import annotations
+
+import queue as _queue
+import socket
+import threading
+from typing import Optional
+
+from ..core import Buffer, Caps, parse_caps_string
+from ..core.serialize import pack_tensors, unpack_tensors
+from ..utils.log import logger
+from .protocol import MsgType, recv_msg, send_msg
+
+
+class Disconnected:
+    """Sentinel queued on connection loss (vs ``None`` = clean server EOS),
+    so consumers can tell a dead link from end-of-stream — the reference
+    distinguishes these via the CONNECTION_CLOSED event
+    (tensor_query_client.c:421-480)."""
+
+
+DISCONNECTED = Disconnected()
+
+
+class QueryClient:
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host, self.port = host, port
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self.responses: _queue.Queue = _queue.Queue()
+        self.server_caps: Optional[Caps] = None
+        self._caps_event = threading.Event()
+        self._reader: Optional[threading.Thread] = None
+        self._running = threading.Event()
+        self.connected = False
+        self._clean_eos = False
+
+    def connect(self, caps: Caps) -> Caps:
+        """TCP connect + caps handshake; returns the server's caps
+        (remote caps negotiation, tensor_query_client.c:386-460)."""
+        self._sock = socket.create_connection((self.host, self.port),
+                                              timeout=self.timeout)
+        self._sock.settimeout(None)
+        self._running.set()
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name=f"qclient:{self.host}:{self.port}",
+                                        daemon=True)
+        self._reader.start()
+        try:
+            send_msg(self._sock, MsgType.CAPABILITY, str(caps).encode())
+            if not self._caps_event.wait(self.timeout):
+                raise TimeoutError("tensor-query caps handshake timed out")
+            if self.server_caps is None:
+                raise ConnectionError("tensor-query server rejected caps")
+        except Exception:
+            # a failed handshake must not leak the socket + reader thread
+            # (retry loops create one client per attempt)
+            self.close()
+            raise
+        self.connected = True
+        return self.server_caps
+
+    def _read_loop(self) -> None:
+        try:
+            while self._running.is_set():
+                msg = recv_msg(self._sock)
+                if msg is None:
+                    break
+                msg_type, payload = msg
+                if msg_type is MsgType.CAPABILITY:
+                    self.server_caps = parse_caps_string(payload.decode())
+                    self._caps_event.set()
+                elif msg_type is MsgType.ERROR:
+                    logger.error("tensor-query server error: %s", payload.decode())
+                    self.server_caps = None
+                    self._caps_event.set()
+                elif msg_type is MsgType.DATA:
+                    self.responses.put(unpack_tensors(payload))
+                elif msg_type is MsgType.EOS:
+                    self._clean_eos = True
+                    self.responses.put(None)
+        except (ConnectionError, OSError) as e:
+            logger.info("tensor-query connection closed: %s", e)
+        finally:
+            self.connected = False
+            # unblock any waiter: None = clean end, DISCONNECTED = link died
+            self.responses.put(None if self._clean_eos else DISCONNECTED)
+
+    def send(self, buf: Buffer) -> None:
+        if self._sock is None:
+            raise ConnectionError("tensor-query client not connected")
+        send_msg(self._sock, MsgType.DATA, pack_tensors(buf.as_numpy()))
+
+    def send_eos(self) -> None:
+        if self._sock is not None:
+            try:
+                send_msg(self._sock, MsgType.EOS)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._running.clear()
+        if self._sock is not None:
+            from .server import _shutdown_close
+
+            _shutdown_close(self._sock)
+            self._sock = None
+        if self._reader is not None:
+            self._reader.join(timeout=2.0)
+            self._reader = None

@@ -1,0 +1,358 @@
+"""Query/offload pipeline elements (L5).
+
+Reference analogs (SURVEY.md §3.4):
+  * ``tensor_query_client`` (tensor_query_client.c, 774 LoC) — sends each
+    input frame to a remote server pipeline, emits the answer stream;
+  * ``tensor_query_serversrc``/``serversink`` (server entry/exit pads with a
+    shared per-id server handle and GstMetaQuery client routing);
+  * ``edgesrc``/``edgesink`` (gst/edge/, topic pub/sub).
+
+CLIENT:  ... ! tensor_query_client host=H port=P ! ...
+SERVER:  tensor_query_serversrc port=P ! (sub-pipeline) ! tensor_query_serversink
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Optional
+
+from ..core import Buffer, Caps, Event, EventType, clock_now, parse_caps_string
+from ..registry.elements import register_element
+from ..runtime.element import Element, ElementError, Prop, SinkElement, SourceElement, prop_bool
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+from ..utils.log import logger
+from .client import DISCONNECTED, QueryClient
+from .edge import PubSubBroker, get_broker, release_broker
+from .server import (
+    QueryServer,
+    get_shared_server,
+    lookup_shared_server,
+    release_shared_server,
+)
+
+_TENSOR_CAPS = Caps.new("other/tensors")
+
+
+@register_element
+class TensorQueryClient(Element):
+    """Offload frames to a remote server pipeline; 1 sink (requests) + 1 src
+    (responses). Responses are pushed from a puller thread (the reference's
+    async pending-output queue)."""
+
+    ELEMENT_NAME = "tensor_query_client"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
+    PROPERTIES = {
+        "host": Prop("127.0.0.1", str, "server host (reference dest-host)"),
+        "port": Prop(0, int, "server port (reference dest-port)"),
+        "timeout": Prop(10.0, float,
+                        "connect/handshake timeout seconds (reference "
+                        "QUERY_DEFAULT_TIMEOUT_SEC, tensor_query_common.h:28)"),
+        "reconnect": Prop(True, prop_bool,
+                          "on connection loss, retry with backoff instead of "
+                          "ending the stream (reference CONNECTION_CLOSED "
+                          "handling, tensor_query_client.c:421-480)"),
+        "reconnect_window": Prop(30.0, float,
+                                 "give up and end the stream after this many "
+                                 "seconds without a successful reconnect"),
+        "max_reconnect_delay": Prop(2.0, float,
+                                    "backoff cap between reconnect attempts"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.client: Optional[QueryClient] = None
+        self._puller: Optional[threading.Thread] = None
+        self._running = threading.Event()
+        self._stopping = threading.Event()  # interrupts reconnect backoff
+        self._in_caps: Optional[Caps] = None
+        self._got_input_eos = False
+        self._reconnect_error: Optional[str] = None
+
+    def _new_client(self) -> QueryClient:
+        return QueryClient(self.props["host"], self.props["port"],
+                           self.props["timeout"])
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        self._in_caps = caps
+        self.client = self._new_client()
+        self._server_caps = self.client.connect(caps)
+        self._running.set()
+        self._puller = threading.Thread(target=self._pull_loop,
+                                        name=f"{self.name}:pull", daemon=True)
+        self._puller.start()
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        return self._server_caps
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        try:
+            self.client.send(buf)
+        except (ConnectionError, OSError):
+            # link is down; drop the frame and keep the stream alive while
+            # the pull loop reconnects in the background (streaming QoS:
+            # same frame-drop semantics as the reference under throttle)
+            logger.warning("%s: frame dropped while disconnected", self.name)
+
+    def handle_eos(self) -> None:
+        self._got_input_eos = True
+        if self.client is not None:
+            self.client.send_eos()
+        # EOS forwarded downstream when the response stream drains (pull loop)
+
+    def _reconnect(self) -> bool:
+        """Retry with exponential backoff until success, the reconnect
+        window closes, the server comes back with different caps, or the
+        element stops. Returns True on success; on failure the reason is
+        in ``self._reconnect_error`` (None for a clean stop)."""
+        self._reconnect_error: Optional[str] = None
+        deadline = clock_now() + self.props["reconnect_window"]
+        delay = 0.2
+        while self._running.is_set() and clock_now() < deadline:
+            try:
+                client = self._new_client()
+                new_caps = client.connect(self._in_caps)
+                if not self._running.is_set():
+                    # stop() raced the connect: don't leak the fresh
+                    # socket + reader thread past pipeline shutdown
+                    client.close()
+                    return False
+                if not new_caps.can_intersect(self._server_caps):
+                    # downstream already negotiated the old caps; pushing an
+                    # incompatible format would corrupt far from the cause.
+                    # (Intersection, not string equality: the advertised
+                    # string legitimately varies with server-side
+                    # negotiation timing, e.g. num_tensors appearing.)
+                    client.close()
+                    self._reconnect_error = (
+                        f"server at {self.props['host']}:{self.props['port']} "
+                        f"came back with different caps ({new_caps} != "
+                        f"{self._server_caps}); restart the pipeline")
+                    return False
+                old, self.client = self.client, client
+                if old is not None:
+                    old.close()  # release the dead link's fd + reader
+                logger.info("%s: reconnected to %s:%s", self.name,
+                            self.props["host"], self.props["port"])
+                if self._got_input_eos:
+                    # upstream EOS fired while the link was down; the dead
+                    # socket swallowed it — re-send so the new server drains
+                    self.client.send_eos()
+                return True
+            except (ConnectionError, OSError, TimeoutError) as e:
+                logger.info("%s: reconnect failed (%s); retrying in %.1fs",
+                            self.name, e, delay)
+            time_left = deadline - clock_now()
+            self._stopping.wait(min(delay, max(time_left, 0)))
+            delay = min(delay * 2, self.props["max_reconnect_delay"])
+        if self._running.is_set():
+            self._reconnect_error = (
+                f"connection to {self.props['host']}:{self.props['port']} "
+                f"lost and not re-established within "
+                f"{self.props['reconnect_window']}s")
+        return False
+
+    def _pull_loop(self) -> None:
+        while self._running.is_set():
+            try:
+                buf = self.client.responses.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            if buf is None:  # clean server EOS
+                self.send_eos()
+                return
+            if buf is DISCONNECTED:
+                if not self._running.is_set() or not self.props["reconnect"]:
+                    self.send_eos()
+                    return
+                if self._reconnect():
+                    continue
+                if self._reconnect_error:  # None = clean stop, no error
+                    self.post_error(self._reconnect_error)
+                self.send_eos()
+                return
+            self.srcpad.push(buf)
+
+    def stop(self) -> None:
+        self._running.clear()
+        self._stopping.set()
+        if self.client is not None:
+            self.client.close()
+        if self._puller is not None and self._puller is not threading.current_thread():
+            self._puller.join(timeout=2.0)
+            self._puller = None
+        if self.client is not None:
+            # the puller may have installed a fresh client between the close
+            # above and the join; close whatever is current (idempotent)
+            self.client.close()
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._stopping.clear()
+        self._got_input_eos = False
+
+
+@register_element
+class TensorQueryServerSrc(SourceElement):
+    ELEMENT_NAME = "tensor_query_serversrc"
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
+    PROPERTIES = {
+        "host": Prop("127.0.0.1", str),
+        "port": Prop(0, int, "listen port (0 = ephemeral; see bound_port)"),
+        "id": Prop(0, int, "shared server id (pairs src and sink)"),
+        "caps": Prop(None, str, "caps this server accepts/produces on its src"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.server: Optional[QueryServer] = None
+
+    @property
+    def bound_port(self) -> int:
+        return self.server.port if self.server else 0
+
+    def start(self) -> None:
+        self.server = get_shared_server(
+            self.props["id"], self.props["host"], self.props["port"]
+        )
+        if self.props["caps"]:
+            accepted = parse_caps_string(self.props["caps"])
+            # remote caps negotiation: reject clients whose stream cannot
+            # intersect this server's declared input caps
+            self.server.accept_caps = accepted.can_intersect
+        super().start()
+
+    def get_src_caps(self) -> Caps:
+        if not self.props["caps"]:
+            raise ElementError(f"{self.describe()}: caps property required")
+        return parse_caps_string(self.props["caps"])
+
+    def create(self) -> Optional[Buffer]:
+        while self.running:
+            try:
+                item = self.server.inbox.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            if isinstance(item, tuple):  # ("eos", client_id): per-client end
+                continue  # server keeps serving other clients
+            return item
+        return None
+
+    def stop(self) -> None:
+        super().stop()
+        if self.server is not None:
+            release_shared_server(self.props["id"])
+            self.server = None
+
+
+@register_element
+class TensorQueryServerSink(SinkElement):
+    ELEMENT_NAME = "tensor_query_serversink"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
+    PROPERTIES = {"id": Prop(0, int, "shared server id (pairs src and sink)")}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.server: Optional[QueryServer] = None
+
+    def _server(self) -> QueryServer:
+        # lazy lookup of the server the paired serversrc created — never
+        # create here: the sink doesn't know the host/port (creating first
+        # would pin an ephemeral port and void the src's port= property)
+        if self.server is None:
+            self.server = lookup_shared_server(self.props["id"])
+        return self.server
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        self._server().caps = caps  # advertised to clients in the handshake
+
+    def render(self, buf: Buffer) -> None:
+        client_id = buf.meta.get("client_id")
+        if client_id is None:
+            logger.warning("%s: answer without client_id meta dropped", self.name)
+            return
+        self._server().send(client_id, buf)
+
+    def stop(self) -> None:
+        super().stop()
+        if self.server is not None:
+            release_shared_server(self.props["id"])
+            self.server = None
+
+
+# ---------------------------------------------------------------------------
+# edge pub/sub (reference gst/edge/: topic-based streams over nnstreamer-edge)
+# ---------------------------------------------------------------------------
+
+
+@register_element
+class EdgeSink(SinkElement):
+    """Publish the stream on a topic (reference ``edgesink``)."""
+
+    ELEMENT_NAME = "edgesink"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
+    PROPERTIES = {
+        "host": Prop("127.0.0.1", str),
+        "port": Prop(0, int, "broker listen port (0 = ephemeral)"),
+        "topic": Prop("", str),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.broker: Optional[PubSubBroker] = None
+
+    @property
+    def bound_port(self) -> int:
+        return self.broker.port if self.broker else 0
+
+    def start(self) -> None:
+        self.broker = get_broker(self.props["host"], self.props["port"])
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        self.broker.set_topic_caps(self.props["topic"], caps)
+
+    def render(self, buf: Buffer) -> None:
+        self.broker.publish(self.props["topic"], buf)
+
+    def stop(self) -> None:
+        if self.broker is not None:
+            release_broker(self.broker)
+            self.broker = None
+
+
+@register_element
+class EdgeSrc(SourceElement):
+    """Subscribe to a topic (reference ``edgesrc``)."""
+
+    ELEMENT_NAME = "edgesrc"
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
+    PROPERTIES = {
+        "dest_host": Prop("127.0.0.1", str),
+        "dest_port": Prop(0, int),
+        "topic": Prop("", str),
+        "timeout": Prop(10.0, float),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._sub = None
+
+    def get_src_caps(self) -> Caps:
+        from .edge import Subscriber
+
+        self._sub = Subscriber(self.props["dest_host"], self.props["dest_port"],
+                               self.props["topic"], self.props["timeout"])
+        return self._sub.caps
+
+    def create(self) -> Optional[Buffer]:
+        while self.running:
+            buf = self._sub.next(timeout=0.1)
+            if buf is not None:
+                return buf if buf != "eos" else None
+        return None
+
+    def stop(self) -> None:
+        super().stop()
+        if self._sub is not None:
+            self._sub.close()
+            self._sub = None

@@ -1,0 +1,412 @@
+"""gRPC tensor streaming transport + tensor_src_grpc / tensor_sink_grpc (L5).
+
+Reference analog: ``ext/nnstreamer/tensor_source/tensor_src_grpc.c`` +
+``tensor_sink/tensor_sink_grpc.c`` with the shared ``NNStreamerRPC`` C++
+class (ext/nnstreamer/extra/nnstreamer_grpc_common.h:32-83 — async
+completion-queue server, client/server modes on both elements, protobuf or
+flatbuf IDL). TPU redesign: grpcio with *generic* bytes methods — the IDL is
+our own ``core/serialize`` tensor frame (already the wire format of the
+query/edge/mqtt layers), so no codegen step and one serialization everywhere.
+
+Service surface (bytes in/out, identity serializers):
+  /nnstreamer.Tensor/Send   client-streaming — remote pushes frames to us
+  /nnstreamer.Tensor/Recv   server-streaming — remote pulls our frame stream
+
+Each stream message is 1 tag byte + payload:
+  ``C`` caps string (always first), ``D`` serialized tensor frame, ``E`` EOS.
+
+Like the reference, BOTH elements speak BOTH roles (``server=true/false``):
+  sink(server=false) --Send-->  src(server=true)     (push topology)
+  src(server=false)  --Recv-->  sink(server=true)    (pull topology)
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from concurrent import futures
+from typing import Optional
+
+from ..core import Buffer, Caps, parse_caps_string
+from ..core.serialize import pack_tensors, unpack_tensors
+from ..registry.elements import register_element
+from ..runtime.element import ElementError, Prop, SinkElement, SourceElement, prop_bool
+from ..runtime.pad import PadDirection, PadTemplate
+from ..utils.log import logger
+
+_TENSOR_CAPS = Caps.new("other/tensors")
+SEND_METHOD = "/nnstreamer.Tensor/Send"
+RECV_METHOD = "/nnstreamer.Tensor/Recv"
+_IDENT = lambda b: bytes(b)  # noqa: E731 — identity (de)serializer
+
+
+def _tag(msg: bytes) -> tuple:
+    if not msg:
+        raise ValueError("empty grpc tensor message")
+    return msg[:1], msg[1:]
+
+
+class GrpcTensorService:
+    """Hosts Send (inbound frames → ``inbox``) and Recv (``outbox`` frames →
+    subscribers). One service instance backs one element."""
+
+    def __init__(self, host: str, port: int, max_queued: int = 64):
+        import grpc
+
+        self.inbox: _queue.Queue = _queue.Queue(max_queued)
+        self.expected_caps: Optional[Caps] = None  # configured accept filter
+        self.caps: Optional[Caps] = None           # learned from Send streams
+        self._caps_lock = threading.Lock()
+        self._out_caps: Optional[Caps] = None      # declared for Recv streams
+        self._out_caps_set = threading.Event()
+        self._caps_seen = threading.Event()
+        self._stopped = threading.Event()
+        self._subs_lock = threading.Lock()
+        self._subs: list = []                     # per-subscriber queues
+        self._grpc = grpc
+
+        def send_handler(request_iterator, context):
+            got_caps = False
+            for msg in request_iterator:
+                tag, payload = _tag(msg)
+                if tag == b"C":
+                    caps = parse_caps_string(payload.decode())
+                    with self._caps_lock:
+                        # always validate against the CONFIGURED caps, never
+                        # against what a previous client happened to declare
+                        expected = self.expected_caps
+                        if expected is not None and not expected.can_intersect(caps):
+                            reject = True
+                        else:
+                            reject = False
+                            if self.caps is None:
+                                self.caps = caps
+                    if reject:
+                        context.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                            f"caps {caps} rejected (server expects {expected})",
+                        )
+                    self._caps_seen.set()
+                    got_caps = True
+                elif tag == b"D":
+                    if not got_caps:
+                        context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                      "DATA before CAPABILITY")
+                    if not self._inbox_put(unpack_tensors(payload), context):
+                        return b"dropped"
+                elif tag == b"E":
+                    self._inbox_put(None, context)
+            return b"ok"
+
+        def recv_handler(request, context):
+            q: _queue.Queue = _queue.Queue(max_queued)
+            with self._subs_lock:
+                self._subs.append(q)
+            try:
+                # a subscriber may connect before the pipeline negotiated;
+                # hold the caps message until set_caps ran
+                if not self._out_caps_set.wait(timeout=10.0):
+                    context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                  "server pipeline has no negotiated caps yet")
+                yield b"C" + str(self._out_caps).encode()
+                while True:
+                    # bounded wait: the handler must exit when the service
+                    # stops or the client hangs up, else its executor thread
+                    # blocks process exit (concurrent.futures joins at atexit)
+                    try:
+                        item = q.get(timeout=0.5)
+                    except _queue.Empty:
+                        if self._stopped.is_set() or not context.is_active():
+                            return
+                        continue
+                    if item is None:
+                        yield b"E"
+                        return
+                    yield b"D" + bytes(item)
+            finally:
+                with self._subs_lock:
+                    if q in self._subs:
+                        self._subs.remove(q)
+
+        handler = grpc.method_handlers_generic_handler(
+            "nnstreamer.Tensor",
+            {
+                "Send": grpc.stream_unary_rpc_method_handler(
+                    send_handler, request_deserializer=_IDENT,
+                    response_serializer=_IDENT),
+                "Recv": grpc.unary_stream_rpc_method_handler(
+                    recv_handler, request_deserializer=_IDENT,
+                    response_serializer=_IDENT),
+            },
+        )
+        self._executor = futures.ThreadPoolExecutor(max_workers=8)
+        self._server = grpc.server(self._executor)
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise ElementError(f"grpc: cannot bind {host}:{port}")
+        self._server.start()
+
+    def _inbox_put(self, item, context) -> bool:
+        """Bounded put that stays interruptible: a handler thread must never
+        block forever in queue.put or it outlives server.stop() and wedges
+        interpreter exit (same hazard as the recv_handler loop)."""
+        while True:
+            try:
+                self.inbox.put(item, timeout=0.5)
+                return True
+            except _queue.Full:
+                if self._stopped.is_set() or not context.is_active():
+                    return False
+
+    @property
+    def out_caps(self) -> Optional[Caps]:
+        return self._out_caps
+
+    @out_caps.setter
+    def out_caps(self, caps: Caps) -> None:
+        self._out_caps = caps
+        self._out_caps_set.set()
+
+    def wait_caps(self, timeout: float) -> Optional[Caps]:
+        self._caps_seen.wait(timeout)
+        return self.caps
+
+    def publish(self, buf: Optional[Buffer]) -> None:
+        """Fan a frame (or None = EOS) out to every Recv subscriber.
+
+        Live-stream semantics: a slow subscriber drops its oldest frame
+        rather than backpressuring the pipeline's render thread (a blocking
+        put here would also deadlock stop(), which publishes the EOS)."""
+        payload = None if buf is None else pack_tensors(buf)
+        with self._subs_lock:
+            subs = list(self._subs)
+        for q in subs:
+            while True:
+                try:
+                    q.put_nowait(payload)
+                    break
+                except _queue.Full:
+                    try:
+                        q.get_nowait()  # drop oldest
+                    except _queue.Empty:
+                        pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.publish(None)
+        self._server.stop(grace=1.0).wait(timeout=5.0)
+        self._executor.shutdown(wait=False)
+
+
+class GrpcTensorClient:
+    """Client side of both methods."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        import grpc
+
+        self._grpc = grpc
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        self._send_q: Optional[_queue.Queue] = None
+        self._send_future = None
+        self._recv_call = None
+
+    # -- push topology: we stream frames to a remote Send ------------------
+    def start_send(self, caps: Caps) -> None:
+        self._send_q = _queue.Queue(64)
+        self._send_q.put(b"C" + str(caps).encode())
+        stub = self._channel.stream_unary(
+            SEND_METHOD, request_serializer=_IDENT, response_deserializer=_IDENT)
+
+        def gen():
+            while True:
+                item = self._send_q.get()
+                if item is None:
+                    return
+                yield item
+
+        self._send_future = stub.future(gen())
+
+    def send(self, buf: Buffer) -> None:
+        self._send_q.put(b"D" + bytes(pack_tensors(buf)))
+
+    def finish_send(self, timeout: float = 10.0) -> None:
+        self._send_q.put(b"E")
+        self._send_q.put(None)
+        if self._send_future is not None:
+            self._send_future.result(timeout=timeout)
+
+    # -- pull topology: we consume a remote Recv stream --------------------
+    def recv_stream(self):
+        """Yields (caps, iterator-of-Buffer-or-None)."""
+        stub = self._channel.unary_stream(
+            RECV_METHOD, request_serializer=_IDENT, response_deserializer=_IDENT)
+        stream = stub(b"")
+        self._recv_call = stream  # cancellable from close()
+        first = next(stream)
+        tag, payload = _tag(first)
+        if tag != b"C":
+            raise ConnectionError("grpc Recv stream did not start with caps")
+        caps = parse_caps_string(payload.decode())
+
+        def frames():
+            for msg in stream:
+                tag, payload = _tag(msg)
+                if tag == b"D":
+                    yield unpack_tensors(payload)
+                elif tag == b"E":
+                    yield None
+                    return
+
+        return caps, frames()
+
+    def close(self) -> None:
+        if self._recv_call is not None:
+            self._recv_call.cancel()
+            self._recv_call = None
+        if self._send_q is not None:
+            self._send_q.put(None)  # unblock the request generator
+        self._channel.close()
+
+
+@register_element
+class TensorSrcGrpc(SourceElement):
+    """Receive a tensor stream over gRPC.
+
+    server=true (default): host the service, remote sinks push via Send.
+    server=false: connect out and pull a remote tensor_sink_grpc's Recv.
+    """
+
+    ELEMENT_NAME = "tensor_src_grpc"
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
+    PROPERTIES = {
+        "server": Prop(True, prop_bool, "host the service vs connect out"),
+        "host": Prop("127.0.0.1", str),
+        "port": Prop(0, int, "listen/connect port (0 server = ephemeral)"),
+        "caps": Prop(None, str, "expected caps (optional in server mode)"),
+        "timeout": Prop(10.0, float, "caps handshake timeout"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.service: Optional[GrpcTensorService] = None
+        self._client: Optional[GrpcTensorClient] = None
+        self._frames = None
+
+    @property
+    def bound_port(self) -> int:
+        return self.service.port if self.service else 0
+
+    def get_src_caps(self) -> Caps:
+        if self.props["server"]:
+            self.service = GrpcTensorService(self.props["host"], self.props["port"])
+            if self.props["caps"]:
+                caps = parse_caps_string(self.props["caps"])
+                self.service.expected_caps = caps  # Send streams must intersect
+                return caps
+            got = self.service.wait_caps(self.props["timeout"])
+            if got is None:
+                raise ElementError(
+                    f"{self.describe()}: no client sent caps within timeout "
+                    "(set the caps property to negotiate before connect)")
+            return got
+        self._client = GrpcTensorClient(self.props["host"], self.props["port"],
+                                        self.props["timeout"])
+        caps, self._frames = self._client.recv_stream()
+        return caps
+
+    def create(self) -> Optional[Buffer]:
+        service = self.service  # stop() may null the attribute concurrently
+        if self.props["server"]:
+            while self.running and service is not None:
+                try:
+                    return service.inbox.get(timeout=0.1)  # None = EOS
+                except _queue.Empty:
+                    continue
+            return None
+        try:
+            return next(self._frames)
+        except StopIteration:
+            return None
+        except Exception as e:  # noqa: BLE001 — stream cancelled / transport err
+            logger.warning("%s: recv stream ended: %s", self.describe(), e)
+            return None
+
+    def stop(self) -> None:
+        # tear the transport down BEFORE joining the task thread: a create()
+        # blocked in next(frames) only wakes when the call is cancelled
+        self._running.clear()
+        if self.service is not None:
+            self.service.stop()
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        super().stop()
+        self.service = None
+
+
+@register_element
+class TensorSinkGrpc(SinkElement):
+    """Send the pipeline's tensor stream over gRPC.
+
+    server=false (default): stream to a remote tensor_src_grpc via Send.
+    server=true: host the service; remote srcs subscribe via Recv.
+    """
+
+    ELEMENT_NAME = "tensor_sink_grpc"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
+    PROPERTIES = {
+        "server": Prop(False, prop_bool, "host the service vs connect out"),
+        "host": Prop("127.0.0.1", str),
+        "port": Prop(0, int, "connect/listen port (0 server = ephemeral)"),
+        "timeout": Prop(10.0, float, "connect timeout"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.service: Optional[GrpcTensorService] = None
+        self._client: Optional[GrpcTensorClient] = None
+
+    @property
+    def bound_port(self) -> int:
+        return self.service.port if self.service else 0
+
+    def set_caps(self, pad, caps: Caps) -> None:
+        if self.props["server"]:
+            if self.service is None:
+                self.service = GrpcTensorService(self.props["host"],
+                                                 self.props["port"])
+            self.service.out_caps = caps
+        else:
+            if self._client is not None:  # renegotiation: end the old stream
+                try:
+                    self._client.finish_send(timeout=2.0)
+                except Exception:  # noqa: BLE001 — best-effort drain
+                    pass
+                self._client.close()
+            self._client = GrpcTensorClient(self.props["host"], self.props["port"],
+                                            self.props["timeout"])
+            self._client.start_send(caps)
+
+    def render(self, buf: Buffer) -> None:
+        if self.props["server"]:
+            self.service.publish(buf)
+        else:
+            self._client.send(buf)
+
+    def handle_eos(self) -> None:
+        if self.props["server"]:
+            if self.service is not None:
+                self.service.publish(None)
+        elif self._client is not None:
+            self._client.finish_send()
+        super().handle_eos()
+
+    def stop(self) -> None:
+        super().stop()
+        if self.service is not None:
+            self.service.stop()
+            self.service = None
+        if self._client is not None:
+            self._client.close()
+            self._client = None

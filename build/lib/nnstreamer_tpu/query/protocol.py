@@ -1,0 +1,71 @@
+"""Tensor-query wire protocol (L5).
+
+Reference analog: the nnstreamer-edge transport consumed by
+``tensor_query_*`` (gst/nnstreamer/tensor_query/tensor_query_client.c:204-692)
+— TCP request/response with a CAPABILITY (caps string) handshake before data
+(:386-460) and per-frame payloads of {ptr,size} memories + kv info. Our wire:
+
+  frame  := magic "NNSQ" | u8 msg_type | u64 payload_len | payload
+  types  := CAPABILITY (utf8 caps string), DATA (core/serialize tensor frame),
+            EOS, ERROR (utf8 message)
+
+Client-id routing meta (reference ``GstMetaQuery``, gst/nnstreamer/
+tensor_meta.c) rides in the DATA frame's meta dict as ``client_id``.
+"""
+from __future__ import annotations
+
+import enum
+import socket
+import struct
+from typing import Optional, Tuple
+
+MAGIC = b"NNSQ"
+_HEADER = struct.Struct("<4sBQ")
+MAX_PAYLOAD = 1 << 34  # sanity bound
+
+
+class MsgType(enum.IntEnum):
+    CAPABILITY = 1
+    DATA = 2
+    EOS = 3
+    ERROR = 4
+
+
+def send_msg(sock: socket.socket, msg_type: MsgType, payload=b"") -> None:
+    """Send one frame; accepts bytes or a memoryview payload. Large payloads
+    go out as a second sendall so a memoryview from ``pack_tensors`` is never
+    copied into a concatenated bytes object."""
+    header = _HEADER.pack(MAGIC, int(msg_type), len(payload))
+    if len(payload) <= 1 << 13:
+        sock.sendall(header + bytes(payload))
+    else:
+        sock.sendall(header)
+        sock.sendall(payload)
+
+
+def recv_msg(sock: socket.socket) -> Optional[Tuple[MsgType, bytes]]:
+    """Blocking read of one frame; None on clean EOF."""
+    header = _recv_exact(sock, _HEADER.size)
+    if header is None:
+        return None
+    magic, msg_type, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise ConnectionError("bad tensor-query frame magic")
+    if length > MAX_PAYLOAD:
+        raise ConnectionError(f"oversized tensor-query payload ({length} bytes)")
+    payload = _recv_exact(sock, length) if length else b""
+    if length and payload is None:
+        return None
+    return MsgType(msg_type), payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)

@@ -1,0 +1,201 @@
+"""Tensor-query server core (L5).
+
+Reference analog: the server side of nnstreamer-edge as used by
+``tensor_query_serversrc``/``serversink`` — a shared per-server-id handle
+(tensor_query_server.c:76-117) accepting clients, performing the CAPABILITY
+handshake, tagging inbound frames with ``client_id`` and routing answers back
+to the right client (tensor_query_serversrc.c:299-315, GstMetaQuery).
+"""
+from __future__ import annotations
+
+import queue as _queue
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..core import Buffer, Caps, parse_caps_string
+from ..core.serialize import pack_tensors, unpack_tensors
+from ..utils.log import logger
+from .protocol import MsgType, recv_msg, send_msg
+
+
+def _shutdown_close(sock: socket.socket) -> None:
+    """shutdown() before close(): close() alone does NOT send FIN while
+    another thread is blocked in recv() on the same fd — the peer would
+    never see EOF and hang."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class QueryServer:
+    """Accepts tensor-query clients; inbound frames land in ``inbox`` with
+    client_id meta; ``send(client_id, buf)`` answers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 caps: Optional[Caps] = None,
+                 accept_caps: Optional[Callable[[Caps], bool]] = None):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        self.caps = caps
+        self.accept_caps = accept_caps
+        self.inbox: _queue.Queue = _queue.Queue()
+        self._clients: Dict[int, socket.socket] = {}
+        self._client_caps: Dict[int, Caps] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._running = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "QueryServer":
+        if self._accept_thread is not None:
+            return self
+        self._running.set()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"qserver:{self.port}", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running.clear()
+        _shutdown_close(self._sock)
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            _shutdown_close(c)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+            self._accept_thread = None
+
+    # -- accept/read --------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._running.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return
+            with self._lock:
+                client_id = self._next_id
+                self._next_id += 1
+                self._clients[client_id] = conn
+            threading.Thread(
+                target=self._client_loop, args=(client_id, conn),
+                name=f"qserver:{self.port}:c{client_id}", daemon=True
+            ).start()
+
+    def _client_loop(self, client_id: int, conn: socket.socket) -> None:
+        try:
+            while self._running.is_set():
+                msg = recv_msg(conn)
+                if msg is None:
+                    break
+                msg_type, payload = msg
+                if msg_type is MsgType.CAPABILITY:
+                    caps = parse_caps_string(payload.decode())
+                    ok = self.accept_caps(caps) if self.accept_caps else True
+                    if ok:
+                        self._client_caps[client_id] = caps
+                        reply = str(self.caps) if self.caps else str(caps)
+                        send_msg(conn, MsgType.CAPABILITY, reply.encode())
+                    else:
+                        send_msg(conn, MsgType.ERROR,
+                                 f"caps rejected: {caps}".encode())
+                elif msg_type is MsgType.DATA:
+                    buf = unpack_tensors(payload)
+                    buf.meta["client_id"] = client_id
+                    self.inbox.put(buf)
+                elif msg_type is MsgType.EOS:
+                    self.inbox.put(("eos", client_id))
+        except (ConnectionError, OSError) as e:
+            logger.info("query server client %d dropped: %s", client_id, e)
+        finally:
+            with self._lock:
+                self._clients.pop(client_id, None)
+                self._client_caps.pop(client_id, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- answer routing -----------------------------------------------------
+    def send(self, client_id: int, buf: Buffer) -> bool:
+        with self._lock:
+            conn = self._clients.get(client_id)
+        if conn is None:
+            logger.warning("query server: no client %d for answer", client_id)
+            return False
+        meta = {k: v for k, v in buf.meta.items() if k != "client_id"}
+        out = buf.with_tensors(buf.as_numpy().tensors)
+        out.meta = meta
+        try:
+            send_msg(conn, MsgType.DATA, pack_tensors(out))
+            return True
+        except OSError:
+            return False
+
+
+# Shared per-id server table (reference tensor_query_server.c:76-117):
+# serversrc and serversink with the same id use one QueryServer.
+_servers: Dict[int, QueryServer] = {}
+_server_refs: Dict[int, int] = {}
+_servers_lock = threading.Lock()
+
+
+def get_shared_server(server_id: int, host: str = "127.0.0.1",
+                      port: int = 0) -> QueryServer:
+    """Acquire the shared server for ``server_id`` (refcounted: serversrc and
+    serversink each acquire in start() and release in stop(), mirroring the
+    reference's shared edge-handle table, tensor_query_server.c:76-117)."""
+    with _servers_lock:
+        srv = _servers.get(server_id)
+        if srv is None:
+            srv = QueryServer(host, port).start()
+            _servers[server_id] = srv
+            _server_refs[server_id] = 0
+        _server_refs[server_id] += 1
+        return srv
+
+
+def lookup_shared_server(server_id: int, timeout: float = 5.0) -> QueryServer:
+    """Acquire the EXISTING server for ``server_id``, waiting for its
+    creator (tensor_query_serversrc) to register it. The serversink must
+    never create the server itself: it doesn't know the host/port, and a
+    sink-first start would pin the listener to an ephemeral port while the
+    src's port= property gets silently ignored (reference: serversink looks
+    up the handle serversrc created, tensor_query_server.c:76-117)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        with _servers_lock:
+            srv = _servers.get(server_id)
+            if srv is not None:
+                _server_refs[server_id] += 1
+                return srv
+        if time.monotonic() >= deadline:
+            raise KeyError(
+                f"no tensor-query server with id {server_id} — is a "
+                "tensor_query_serversrc with the same id running?")
+        time.sleep(0.02)
+
+
+def release_shared_server(server_id: int) -> None:
+    with _servers_lock:
+        if server_id not in _servers:
+            return
+        _server_refs[server_id] -= 1
+        if _server_refs[server_id] > 0:
+            return
+        srv = _servers.pop(server_id)
+        _server_refs.pop(server_id, None)
+    srv.stop()

@@ -1,0 +1,113 @@
+"""Element factory registry (L2).
+
+Reference analog: the gst plugin registration in
+``gst/nnstreamer/registerer/nnstreamer.c:94-121`` where every element factory
+is registered by name. Elements self-register via the ``@register_element``
+decorator at import time; ``load_standard_elements()`` imports the built-in
+element modules (the reference's single ``plugin_init``).
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Dict, List, Type
+
+from ..runtime.element import Element
+
+_FACTORIES: Dict[str, Type[Element]] = {}
+
+
+def register_element(cls: Type[Element]) -> Type[Element]:
+    name = cls.ELEMENT_NAME
+    if not name:
+        raise ValueError(f"{cls.__name__} has no ELEMENT_NAME")
+    _FACTORIES[name] = cls
+    return cls
+
+
+_STANDARD_MODULES = (
+    "nnstreamer_tpu.runtime.queue_factory",
+    "nnstreamer_tpu.elements.src",
+    "nnstreamer_tpu.elements.sink",
+    "nnstreamer_tpu.elements.converter",
+    "nnstreamer_tpu.elements.filter",
+    "nnstreamer_tpu.elements.decoder",
+    "nnstreamer_tpu.elements.transform",
+    "nnstreamer_tpu.elements.aggregator",
+    "nnstreamer_tpu.elements.muxdemux",
+    "nnstreamer_tpu.elements.mergesplit",
+    "nnstreamer_tpu.elements.cond",
+    "nnstreamer_tpu.elements.crop",
+    "nnstreamer_tpu.elements.rate",
+    "nnstreamer_tpu.elements.repo",
+    "nnstreamer_tpu.elements.sparse",
+    "nnstreamer_tpu.elements.debug",
+    "nnstreamer_tpu.elements.join",
+    "nnstreamer_tpu.elements.datarepo",
+    "nnstreamer_tpu.elements.trainer",
+    "nnstreamer_tpu.elements.tee",
+    "nnstreamer_tpu.elements.shard",
+    "nnstreamer_tpu.elements.mqtt",
+    "nnstreamer_tpu.elements.iio",
+    "nnstreamer_tpu.query.elements",
+    "nnstreamer_tpu.query.grpc_io",
+)
+
+_loaded = False
+
+
+def load_standard_elements() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    for mod in _STANDARD_MODULES:
+        try:
+            importlib.import_module(mod)
+        except ModuleNotFoundError as e:
+            # tolerate not-yet-built modules during incremental construction
+            if e.name and e.name.startswith("nnstreamer_tpu"):
+                continue
+            raise
+
+
+def _allowed(factory_name: str) -> bool:
+    """Element restriction allowlist (reference: meson
+    ``enable-element-restriction`` + ``restricted-elements`` — products ship
+    pipelines limited to a vetted element set, nnstreamer_conf's
+    element-restriction check). Config key: ``[common] restricted_elements``
+    = comma-separated allowlist; empty/absent = everything allowed."""
+    from .config import get_config
+
+    allow = get_config().get("common", "restricted_elements", "")
+    if not allow.strip():
+        return True
+    return factory_name in {e.strip() for e in allow.split(",") if e.strip()}
+
+
+def make_element(factory_name: str, name=None, **props) -> Element:
+    load_standard_elements()
+    if factory_name not in _FACTORIES:
+        raise ValueError(
+            f"no such element '{factory_name}' (known: {sorted(_FACTORIES)})"
+        )
+    if not _allowed(factory_name):
+        raise PermissionError(
+            f"element '{factory_name}' is not in the configured "
+            "restricted_elements allowlist"
+        )
+    return _FACTORIES[factory_name](name=name, **props)
+
+
+def element_factories() -> List[str]:
+    load_standard_elements()
+    return sorted(_FACTORIES)
+
+
+def get_factory(factory_name: str) -> Type[Element]:
+    """The element class for a factory name (no instantiation)."""
+    load_standard_elements()
+    if factory_name not in _FACTORIES:
+        raise ValueError(
+            f"no such element '{factory_name}' (known: {sorted(_FACTORIES)})"
+        )
+    return _FACTORIES[factory_name]

@@ -1,0 +1,182 @@
+"""Pipeline container, state machine, and message bus (L0' substrate).
+
+Reference analog: GstPipeline + GstBus. States collapse to the useful subset
+(NULL/PLAYING — the reference's READY/PAUSED exist to stage caps negotiation,
+which in our design is event-driven and needs no separate state).
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import Message, MessageType
+from ..utils.log import logger
+from .element import Element, SinkElement, SourceElement
+
+
+class Bus:
+    """Thread-safe out-of-band message stream from elements to the app."""
+
+    def __init__(self):
+        self._q: _queue.Queue = _queue.Queue()
+
+    def post(self, msg: Message) -> None:
+        self._q.put(msg)
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Message]:
+        try:
+            return self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def wait_for(self, types: Iterable[MessageType], timeout: float = 10.0) -> Optional[Message]:
+        """Block until a message of one of ``types`` arrives (or timeout)."""
+        types = set(types)
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            msg = self.pop(timeout=remaining)
+            if msg is not None and msg.type in types:
+                return msg
+
+
+class Pipeline:
+    """A runnable graph of elements."""
+
+    def __init__(self, name: str = "pipeline"):
+        self.name = name
+        self.elements: Dict[str, Element] = {}
+        self.bus = Bus()
+        self._playing = False
+        self._eos_sinks: Set[str] = set()
+        self._lock = threading.Lock()
+
+    # -- construction -------------------------------------------------------
+    def add(self, *elements: Element) -> "Pipeline":
+        for el in elements:
+            if el.name in self.elements:
+                raise ValueError(f"duplicate element name '{el.name}'")
+            self.elements[el.name] = el
+            el.pipeline = self
+        return self
+
+    def get(self, name: str) -> Element:
+        return self.elements[name]
+
+    def link(self, *chain: Element) -> None:
+        for up, down in zip(chain, chain[1:]):
+            up.link(down)
+
+    @property
+    def sinks(self) -> List[SinkElement]:
+        return [e for e in self.elements.values() if isinstance(e, SinkElement)]
+
+    @property
+    def sources(self) -> List[SourceElement]:
+        return [e for e in self.elements.values() if isinstance(e, SourceElement)]
+
+    # -- state --------------------------------------------------------------
+    def play(self) -> "Pipeline":
+        if self._playing:
+            return self
+        from ..utils import trace
+
+        trace.install_from_env()   # NNS_TRACERS (GST_TRACERS analog)
+        trace.dump_dot(self)       # NNS_DOT_DIR (GST_DEBUG_DUMP_DOT_DIR)
+        self._validate_links()
+        self._playing = True
+        self._eos_sinks.clear()
+        for el in self.elements.values():
+            el.reset_flow()
+        # start non-sources first so queues/filters are ready before data flows
+        for el in self.elements.values():
+            if not isinstance(el, SourceElement):
+                el.start()
+        for el in self.sources:
+            el.start()
+        self.bus.post(Message(MessageType.STATE_CHANGED, self.name, {"state": "playing"}))
+        return self
+
+    def stop(self) -> "Pipeline":
+        if not self._playing:
+            return self
+        self._playing = False
+        for el in self.sources:
+            el.stop()
+        for el in self.elements.values():
+            if not isinstance(el, SourceElement):
+                el.stop()
+        self.bus.post(Message(MessageType.STATE_CHANGED, self.name, {"state": "stopped"}))
+        return self
+
+    @property
+    def playing(self) -> bool:
+        return self._playing
+
+    def _validate_links(self) -> None:
+        for el in self.elements.values():
+            for pad in el.sink_pads:
+                if not pad.is_linked:
+                    logger.warning("%s: unlinked sink pad %s", self.name, pad.full_name)
+
+    # -- EOS / error flow ----------------------------------------------------
+    def _element_error(self, element: Element) -> None:
+        """Fatal element error: halt sources so the graph drains instead of
+        spinning (GStreamer: apps stop the pipeline on a bus ERROR; we stop
+        producing immediately, the app still owns final stop())."""
+        if not self._playing:
+            return
+        threading.Thread(target=self._halt_sources, daemon=True,
+                         name=f"{self.name}:error-halt").start()
+
+    def _halt_sources(self) -> None:
+        for el in self.sources:
+            try:
+                el.stop()
+            except Exception:  # noqa: BLE001 - best-effort halt
+                logger.exception("error stopping %s", el.name)
+
+    def _sink_reached_eos(self, sink: Element) -> None:
+        with self._lock:
+            self._eos_sinks.add(sink.name)
+            done = len(self._eos_sinks) >= len(self.sinks)
+        if done:
+            self.bus.post(Message(MessageType.EOS, self.name, {}))
+
+    def wait(self, timeout: float = 30.0) -> Message:
+        """Run until EOS or ERROR; returns the terminating message."""
+        msg = self.bus.wait_for((MessageType.EOS, MessageType.ERROR), timeout=timeout)
+        if msg is None:
+            raise TimeoutError(f"pipeline '{self.name}' did not reach EOS in {timeout}s")
+        return msg
+
+    def run(self, timeout: float = 30.0) -> Message:
+        """play() + wait() + stop() convenience; raises on ERROR."""
+        self.play()
+        try:
+            msg = self.wait(timeout=timeout)
+        finally:
+            self.stop()
+        if msg.type is MessageType.ERROR:
+            raise RuntimeError(f"pipeline error from {msg.source}: {msg.data.get('error')}")
+        return msg
+
+    # -- introspection -------------------------------------------------------
+    def to_dot(self) -> str:
+        """Graphviz dump (reference: GST_DEBUG_DUMP_DOT_DIR pipeline graphs)."""
+        lines = [f'digraph "{self.name}" {{', "  rankdir=LR;"]
+        for el in self.elements.values():
+            lines.append(f'  "{el.name}" [shape=box,label="{el.describe()}"];')
+        for el in self.elements.values():
+            for pad in el.src_pads:
+                if pad.is_linked:
+                    caps = str(pad.caps) if pad.caps else ""
+                    lines.append(
+                        f'  "{el.name}" -> "{pad.peer.element.name}" [label="{caps}"];'
+                    )
+        lines.append("}")
+        return "\n".join(lines)

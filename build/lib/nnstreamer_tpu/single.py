@@ -1,0 +1,60 @@
+"""Pipeline-less single-shot inference API (L6).
+
+Reference analog: ``tensor_filter_single``
+(gst/nnstreamer/tensor_filter/tensor_filter_single.c — the GObject wrapper
+the ML-Service C API's ``ml_single_open``/``ml_single_invoke`` uses to run a
+model with no pipeline). Usage::
+
+    with SingleShot("jax", "builtin://scaler?factor=2") as s:
+        out = s.invoke(np.ones((2, 2), np.float32))
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .backends.base import (
+    Accelerator,
+    FilterProperties,
+    acquire_backend,
+    release_backend,
+)
+from .core import TensorsInfo
+from .utils.stats import InvokeStats, Timer
+
+
+class SingleShot:
+    def __init__(self, framework: str, model: str, custom: str = "",
+                 accelerator: str = "auto", share_key: str = ""):
+        self._share_key = share_key
+        self.stats = InvokeStats()
+        self.backend = acquire_backend(
+            framework,
+            FilterProperties(model=model, custom=custom,
+                             accelerator=Accelerator(accelerator)),
+            share_key,
+        )
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        return self.backend.get_model_info()
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        return self.backend.set_input_info(in_info)
+
+    def invoke(self, *inputs: Any) -> List[Any]:
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        with Timer(self.stats):
+            return self.backend.invoke(list(inputs))
+
+    def close(self) -> None:
+        if self.backend is not None:
+            release_backend(self.backend, self._share_key)
+            self.backend = None
+
+    def __enter__(self) -> "SingleShot":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

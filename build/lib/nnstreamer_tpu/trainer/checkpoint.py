@@ -1,0 +1,160 @@
+"""Training checkpoint/resume (L4).
+
+Reference analog: SURVEY.md §5.4 — the reference's resume story is
+``tensor_trainer`` model-save-path / model-load-path (params only) plus
+datareposrc's deterministic sample ranges. TPU-native redesign: full
+training-state checkpoints — params, optimizer state, epoch counter, loss/
+accuracy history, and the data-iterator epoch — via orbax when available
+(async-capable, the JAX-ecosystem standard) with a flax-msgpack + JSON
+fallback, retention-managed step directories.
+
+Layout: ``<dir>/step_<n>/state.msgpack`` + ``meta.json`` (fallback) or an
+orbax PyTree checkpoint per step. ``latest_step`` finds the newest complete
+checkpoint; partial writes are ignored (write to tmp dir + atomic rename).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.log import logger
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointManager:
+    """Step-numbered training checkpoints with retention.
+
+    ``save(step, state, meta)`` / ``restore(step=None) -> (state, meta)``
+    where ``state`` is a pytree (params/opt_state/...) and ``meta`` is a
+    small JSON-able dict (epoch, histories, iterator state).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 use_orbax: Optional[bool] = None):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+        if use_orbax is None:
+            use_orbax = self._orbax_usable()
+        self._orbax = use_orbax
+
+    @staticmethod
+    def _orbax_usable() -> bool:
+        try:
+            import orbax.checkpoint  # noqa: F401
+            return True
+        except Exception:  # noqa: BLE001 - any import failure → fallback
+            return False
+
+    # -- write ---------------------------------------------------------------
+    def save(self, step: int, state: Any, meta: Optional[dict] = None) -> str:
+        final = os.path.join(self.directory, f"step_{step}")
+        tmp = final + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            if self._orbax:
+                self._save_orbax(tmp, state)
+            else:
+                self._save_msgpack(tmp, state)
+            with open(os.path.join(tmp, "meta.json"), "w") as fh:
+                json.dump(meta or {}, fh)
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)  # atomic publish: partial writes never visible
+        self._retain()
+        logger.info("checkpoint saved: %s", final)
+        return final
+
+    def _save_orbax(self, path: str, state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(path, "state"), state)
+
+    def _save_msgpack(self, path: str, state: Any) -> None:
+        from flax import serialization
+
+        with open(os.path.join(path, "state.msgpack"), "wb") as fh:
+            fh.write(serialization.to_bytes(state))
+
+    # -- read ----------------------------------------------------------------
+    def steps(self) -> List[int]:
+        out = []
+        for entry in os.listdir(self.directory):
+            m = _STEP_RE.match(entry)
+            if m and os.path.exists(os.path.join(self.directory, entry, "meta.json")):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def read_meta(self, step: int) -> dict:
+        """Just the JSON meta of a step — cheap progress peek, no pytree IO."""
+        with open(os.path.join(self.directory, f"step_{step}",
+                               "meta.json")) as fh:
+            return json.load(fh)
+
+    def restore(self, step: Optional[int] = None,
+                target: Any = None) -> Tuple[Any, dict]:
+        """Restore ``(state, meta)``. ``target`` (a matching pytree of
+        arrays) is required for the msgpack fallback and recommended for
+        orbax (dtype/shape-faithful restore)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        path = os.path.join(self.directory, f"step_{step}")
+        with open(os.path.join(path, "meta.json")) as fh:
+            meta = json.load(fh)
+        orbax_state = os.path.join(path, "state")
+        if os.path.isdir(orbax_state):
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            if target is not None:
+                try:
+                    state = ckptr.restore(orbax_state, item=target)
+                except TypeError:  # newer orbax: args-based API
+                    state = ckptr.restore(orbax_state)
+                state = _restructure(state, target)
+            else:
+                state = ckptr.restore(orbax_state)
+        else:
+            from flax import serialization
+
+            if target is None:
+                raise ValueError("msgpack restore requires a target pytree")
+            with open(os.path.join(path, "state.msgpack"), "rb") as fh:
+                state = serialization.from_bytes(target, fh.read())
+        return state, meta
+
+    def _retain(self) -> None:
+        steps = self.steps()
+        for s in steps[:-self.max_to_keep] if self.max_to_keep > 0 else []:
+            shutil.rmtree(os.path.join(self.directory, f"step_{s}"),
+                          ignore_errors=True)
+
+
+def _restructure(state: Any, target: Any) -> Any:
+    """Rebuild ``target``'s pytree structure (NamedTuples like optax's
+    ScaleByAdamState come back from orbax as plain dicts/lists) from the
+    restored leaves. Leaf counts must match; otherwise the restored state is
+    returned as-is and the caller's structure mismatch surfaces loudly."""
+    import jax
+
+    target_def = jax.tree_util.tree_structure(target)
+    leaves = jax.tree_util.tree_leaves(state)
+    if target_def.num_leaves != len(leaves):
+        return state
+    return jax.tree_util.tree_unflatten(target_def, leaves)

@@ -1,0 +1,288 @@
+"""JAX/optax trainer backend (L4).
+
+Reference analog: the nntrainer backend behind ``tensor_trainer``
+(SURVEY.md §3.5) — redesigned TPU-first: the train step is one jitted
+function with donated params (weights never leave HBM between steps), batches
+are assembled host-side from pushed frames, and checkpoints are flax
+msgpack bytes.
+
+The ``model_config`` file is a python file defining:
+  * ``init(rng, example_inputs) -> params`` — parameter pytree init;
+  * ``loss_fn(params, inputs, labels) -> loss`` or ``(loss, metrics)`` where
+    metrics may contain "accuracy" — jax-traceable.
+Custom options: ``batch:<N>,lr:<f>,optimizer:<adam|sgd|adamw>,
+ckpt_dir:<dir>,ckpt_every:<epochs>`` — ``ckpt_dir`` enables full
+training-state checkpoints (params + optimizer state + epoch + histories,
+trainer/checkpoint.py) with automatic resume from the latest step; the
+reference's model-load-path only restores weights (SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.log import logger
+from .base import TrainerBackend, TrainerProperties, register_trainer
+
+
+@register_trainer
+class OptaxTrainer(TrainerBackend):
+    NAME = "optax"
+    ALIASES = ("jax", "flax")
+
+    def __init__(self):
+        super().__init__()
+        self._q: _queue.Queue = _queue.Queue(maxsize=1024)
+        self._thread: Optional[threading.Thread] = None
+        self._complete = threading.Event()
+        self._running = threading.Event()
+        self.params = None
+        self._train_step = None
+        self.losses: List[float] = []
+        self.accuracies: List[float] = []
+        self.last_saved_path: Optional[str] = None
+        self._state_restored = False
+
+    # -- config -------------------------------------------------------------
+    def configure(self, props: TrainerProperties) -> None:
+        super().configure(props)
+        import optax
+
+        ns: Dict[str, Any] = {"__file__": props.model_config}
+        with open(props.model_config) as fh:
+            exec(compile(fh.read(), props.model_config, "exec"), ns)  # noqa: S102
+        if "init" not in ns or "loss_fn" not in ns:
+            raise ValueError(f"{props.model_config}: must define init() and loss_fn()")
+        self._init_fn = ns["init"]
+        self._loss_fn = ns["loss_fn"]
+        opts = props.custom_dict()
+        self.batch_size = int(opts.get("batch", 16))
+        lr = float(opts.get("lr", 1e-3))
+        name = opts.get("optimizer", "adam")
+        makers = {"adam": optax.adam, "sgd": optax.sgd, "adamw": optax.adamw}
+        if name not in makers:
+            raise ValueError(f"unknown optimizer '{name}' (have {sorted(makers)})")
+        self._tx = makers[name](lr)
+        self._ckpt = None
+        self._ckpt_every = max(int(opts.get("ckpt_every", 1)), 1)
+        ckpt_dir = opts.get("ckpt_dir")
+        if ckpt_dir:
+            from .checkpoint import CheckpointManager
+
+            self._ckpt = CheckpointManager(ckpt_dir)
+            # restore progress meta eagerly so even a zero-data resumed run
+            # (source already past its epochs) reports true progress; the
+            # heavy state restore stays lazy in _build
+            latest = self._ckpt.latest_step()
+            if latest is not None:
+                meta = self._ckpt.read_meta(latest)
+                self.stats.epoch_count = int(meta.get("epoch_count", 0))
+                self.losses = list(meta.get("losses", []))
+                self.accuracies = list(meta.get("accuracies", []))
+                if self.losses:
+                    self.stats.training_loss = self.losses[-1]
+                if self.accuracies:
+                    self.stats.training_accuracy = self.accuracies[-1]
+
+    # -- training thread ----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._running.set()
+        self._complete.clear()
+        self._thread = threading.Thread(target=self._train_loop,
+                                        name="optax-trainer", daemon=True)
+        self._thread.start()
+
+    def push_data(self, inputs: Sequence[Any], labels: Sequence[Any]) -> None:
+        item = ("data", [np.asarray(x) for x in inputs],
+                [np.asarray(y) for y in labels])
+        # bounded put that never deadlocks: once the training thread exits
+        # (epoch target reached) the queue has no consumer — drop instead of
+        # blocking the streaming thread forever
+        while self._running.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return
+            except _queue.Full:
+                if self._thread is None or not self._thread.is_alive():
+                    return
+
+    def end_of_data(self) -> None:
+        try:
+            self._q.put_nowait(("end", None, None))
+        except _queue.Full:
+            pass  # thread already finished its epochs; _complete is/will be set
+
+    def wait_complete(self, timeout: float = 60.0) -> bool:
+        return self._complete.wait(timeout)
+
+    def stop(self) -> None:
+        self._running.clear()
+        # drain so the sentinel always fits and a dead consumer can't block us
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
+        try:
+            self._q.put_nowait(("stop", None, None))
+        except _queue.Full:
+            pass
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join(timeout=10.0)
+        self._thread = None
+
+    # -- core ---------------------------------------------------------------
+    def _build(self, example_inputs, example_labels) -> None:
+        import jax
+
+        rng = jax.random.PRNGKey(0)
+        self.params = self._init_fn(rng, example_inputs)
+        if self.props.model_load_path and os.path.exists(self.props.model_load_path):
+            self._load(self.props.model_load_path)
+        self._opt_state = self._tx.init(self.params)
+        if self._ckpt is not None and self._ckpt.latest_step() is not None:
+            self._resume_from_checkpoint()
+
+        loss_fn = self._loss_fn
+        tx = self._tx
+
+        def step(params, opt_state, inputs, labels):
+            def lossed(p):
+                out = loss_fn(p, inputs, labels)
+                if isinstance(out, tuple):
+                    return out[0], out[1]
+                return out, {}
+
+            (loss, metrics), grads = jax.value_and_grad(lossed, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p + u, params, updates
+            )
+            return params, opt_state, loss, metrics
+
+        # donate params/opt_state: weights stay resident on device across steps
+        self._train_step = jax.jit(step, donate_argnums=(0, 1))
+
+    def _train_loop(self) -> None:
+        try:
+            self._run_epochs()
+        except Exception:  # noqa: BLE001 - surfaced via logger; element watches stats
+            logger.exception("trainer thread failed")
+        finally:
+            self._complete.set()
+
+    def _run_epochs(self) -> None:
+        props = self.props
+        per_epoch = props.num_training_samples or None
+        batch_in: List[List[np.ndarray]] = []
+        batch_lb: List[List[np.ndarray]] = []
+        seen = 0
+        epoch_losses: List[float] = []
+        epoch_accs: List[float] = []
+        ended = False
+
+        def flush_batch():
+            nonlocal batch_in, batch_lb
+            if not batch_in:
+                return
+            inputs = [np.stack([b[i] for b in batch_in]) for i in range(len(batch_in[0]))]
+            labels = [np.stack([b[i] for b in batch_lb]) for i in range(len(batch_lb[0]))]
+            if self.params is None:
+                self._build(inputs, labels)
+            self.params, self._opt_state, loss, metrics = self._train_step(
+                self.params, self._opt_state, inputs, labels
+            )
+            epoch_losses.append(float(loss))
+            if "accuracy" in metrics:
+                epoch_accs.append(float(metrics["accuracy"]))
+            batch_in, batch_lb = [], []
+
+        def end_epoch():
+            nonlocal epoch_losses, epoch_accs, seen
+            flush_batch()
+            if epoch_losses:
+                self.stats.training_loss = float(np.mean(epoch_losses))
+                self.losses.append(self.stats.training_loss)
+            if epoch_accs:
+                self.stats.training_accuracy = float(np.mean(epoch_accs))
+                self.accuracies.append(self.stats.training_accuracy)
+            self.stats.epoch_count += 1
+            epoch_losses, epoch_accs, seen = [], [], 0
+            if self.stats.epoch_count % self._ckpt_every == 0:
+                self.save_checkpoint()  # no-op without ckpt_dir/params
+
+        while self._running.is_set():
+            kind, inputs, labels = self._q.get()
+            if kind == "stop":
+                return
+            if kind == "end":
+                ended = True
+                break
+            batch_in.append(inputs)
+            batch_lb.append(labels)
+            seen += 1
+            if len(batch_in) >= self.batch_size:
+                flush_batch()
+            if per_epoch and seen >= per_epoch:
+                end_epoch()
+                if self.stats.epoch_count >= props.epochs:
+                    break
+        if ended and (seen or epoch_losses or batch_in):
+            end_epoch()
+        if props.model_save_path and self.params is not None:
+            self.save(props.model_save_path)
+
+    # -- checkpointing ------------------------------------------------------
+    def save_checkpoint(self) -> Optional[str]:
+        """Full training state → ckpt_dir/step_<epoch> (params, opt state,
+        epoch counter, loss/accuracy history, data-iterator epoch)."""
+        if self._ckpt is None or self.params is None:
+            return None
+        meta = {
+            "epoch_count": self.stats.epoch_count,
+            "losses": self.losses,
+            "accuracies": self.accuracies,
+            # datareposrc resumes with start-epoch=<data_epoch> (same seed
+            # → identical shuffle stream continuation)
+            "data_epoch": self.stats.epoch_count,
+        }
+        return self._ckpt.save(
+            self.stats.epoch_count,
+            {"params": self.params, "opt_state": self._opt_state}, meta)
+
+    def _resume_from_checkpoint(self) -> None:
+        state, meta = self._ckpt.restore(
+            target={"params": self.params, "opt_state": self._opt_state})
+        self.params = state["params"]
+        self._opt_state = state["opt_state"]
+        self.stats.epoch_count = int(meta.get("epoch_count", 0))
+        self.losses = list(meta.get("losses", []))
+        self.accuracies = list(meta.get("accuracies", []))
+        self._state_restored = True
+        logger.info("trainer resumed at epoch %d from %s",
+                    self.stats.epoch_count, self._ckpt.directory)
+
+    def save(self, path: Optional[str] = None) -> Optional[str]:
+        from flax import serialization
+
+        path = path or (self.props.model_save_path if self.props else None)
+        if not path or self.params is None:
+            return None
+        with open(path, "wb") as fh:
+            fh.write(serialization.to_bytes(self.params))
+        self.last_saved_path = path
+        logger.info("trainer saved model to %s", path)
+        return path
+
+    def _load(self, path: str) -> None:
+        from flax import serialization
+
+        with open(path, "rb") as fh:
+            self.params = serialization.from_bytes(self.params, fh.read())
+        logger.info("trainer resumed from %s", path)

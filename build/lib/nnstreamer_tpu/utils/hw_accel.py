@@ -1,0 +1,94 @@
+"""Runtime accelerator capability probe (L2).
+
+Reference analog: ``gst/nnstreamer/hw_accel.c`` — a runtime check that an
+acceleration target actually exists (``cpu_neon_accel_available`` via
+getauxval) before a subplugin selects it. The TPU equivalent must answer
+"is there a TPU here?" WITHOUT initializing the in-process jax backend:
+TPU init is minutes-to-failure-prone on tunneled rigs and, once failed,
+poisons the process. So the probe runs in a short-lived subprocess with a
+hard timeout and the result is cached per platform.
+
+States: True (devices found), False (init failed / platform absent),
+None (probe timed out — the platform may exist but is too slow to say;
+callers should treat None as "don't block the pipeline on it").
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, Optional
+
+_cache: Dict[str, Optional[bool]] = {}
+_cache_lock = threading.Lock()
+_inflight: Dict[str, threading.Event] = {}
+
+_PROBE_SRC = (
+    "import jax;"
+    "jax.config.update('jax_platforms', {platform!r});"
+    "import sys;"
+    "sys.exit(0 if len(jax.devices()) > 0 else 3)"
+)
+
+
+def accel_available(platform: str, timeout_s: float = 15.0,
+                    refresh: bool = False) -> Optional[bool]:
+    """Probe whether jax can bring up ``platform`` ('cpu', 'tpu', 'gpu',
+    'axon', ...). Cached; pass ``refresh=True`` to re-probe."""
+    platform = platform.lower()
+    while True:
+        with _cache_lock:
+            if not refresh and platform in _cache:
+                return _cache[platform]
+            waiter = _inflight.get(platform)
+            if waiter is None:
+                # we own the probe; concurrent callers wait instead of
+                # racing a second subprocess (an exclusive device like a
+                # TPU would fail the losing probe and cache a false False)
+                _inflight[platform] = threading.Event()
+                break
+        waiter.wait(timeout_s + 5)
+        refresh = False  # pick up whatever the winning probe cached
+    result: Optional[bool] = False
+    try:
+        if platform == "cpu":
+            result = True  # the host interpreter is proof
+        else:
+            env = dict(os.environ, JAX_PLATFORMS=platform)
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", _PROBE_SRC.format(platform=platform)],
+                    env=env, timeout=timeout_s,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+                result = proc.returncode == 0
+            except subprocess.TimeoutExpired:
+                result = None  # unknown: platform init too slow to tell
+            except OSError:
+                result = False
+    finally:
+        # always publish + wake waiters, even on unexpected failure —
+        # a stuck inflight entry would block every future caller
+        with _cache_lock:
+            _cache[platform] = result
+            _inflight.pop(platform).set()
+    return result
+
+
+def available_accelerators(timeout_s: float = 15.0) -> Dict[str, Optional[bool]]:
+    """Probe the platforms this build cares about (cpu always; tpu/axon
+    for the device path). Probes run concurrently so the worst case is
+    ~one timeout, not the sum."""
+    platforms = ("cpu", "tpu", "axon")
+    results: Dict[str, Optional[bool]] = {}
+    threads = []
+    for p in platforms:
+        t = threading.Thread(
+            target=lambda name=p: results.__setitem__(
+                name, accel_available(name, timeout_s)),
+            daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout_s + 10)
+    return {p: results.get(p) for p in platforms}

@@ -1,0 +1,244 @@
+"""Pipeline tracers (L7 observability).
+
+Reference analog: the GstShark/NNShark tracer ecosystem the reference
+delegates to (tools/tracing/README.md — proctime, interlatency, framerate,
+queue-level tracers activated via the ``GST_TRACERS`` env var; SURVEY.md
+§5.1). Own design: lightweight hooks in ``Pad.push`` — zero-cost when
+disabled (one module-global check) — aggregating per-element/per-pad
+metrics, plus a JAX profiler wrapper for device-side traces.
+
+Activation:
+  * env: ``NNS_TRACERS="proctime;framerate;interlatency"`` (GST_TRACERS
+    syntax) — installed automatically at the first ``Pipeline.play()``;
+  * API: ``install_tracers(["proctime"])`` / ``uninstall_tracers()``;
+  * results: ``trace_results()`` → {tracer: {key: metrics}};
+  * graph dumps: ``NNS_DOT_DIR=/tmp`` writes ``<pipeline>.dot`` on play()
+    (the reference's GST_DEBUG_DUMP_DOT_DIR).
+
+Device-side: ``jax_trace(logdir)`` context manager wraps
+``jax.profiler.trace`` so TPU XPlane traces line up with host tracer spans.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+ACTIVE = False  # module-global fast path: Pad.push checks this only
+
+_tracers: List["Tracer"] = []
+_lock = threading.Lock()
+
+
+class Tracer:
+    NAME = ""
+
+    def buffer_flow(self, pad, buf, elapsed_s: float) -> None:
+        """Called after a pad push completed; elapsed covers the downstream
+        element's chain work (inline dataflow)."""
+
+    def results(self) -> dict:
+        return {}
+
+
+class ProcTimeTracer(Tracer):
+    """Per-element processing time (GstShark proctime)."""
+
+    NAME = "proctime"
+
+    def __init__(self):
+        self._acc: Dict[str, list] = defaultdict(lambda: [0, 0.0])
+
+    def buffer_flow(self, pad, buf, elapsed_s: float) -> None:
+        peer = pad.peer
+        if peer is None:
+            return
+        cell = self._acc[peer.element.name]
+        cell[0] += 1
+        cell[1] += elapsed_s
+
+    def results(self) -> dict:
+        return {
+            el: {"buffers": n, "total_s": t, "avg_ms": (t / n) * 1e3 if n else 0.0}
+            for el, (n, t) in self._acc.items()
+        }
+
+
+class FramerateTracer(Tracer):
+    """Per-pad frame rate (GstShark framerate)."""
+
+    NAME = "framerate"
+
+    def __init__(self):
+        self._first: Dict[str, float] = {}
+        self._last: Dict[str, float] = {}
+        self._count: Dict[str, int] = defaultdict(int)
+
+    def buffer_flow(self, pad, buf, elapsed_s: float) -> None:
+        now = time.monotonic()
+        key = pad.full_name
+        self._first.setdefault(key, now)
+        self._last[key] = now
+        self._count[key] += 1
+
+    def results(self) -> dict:
+        out = {}
+        for key, n in self._count.items():
+            span = self._last[key] - self._first[key]
+            out[key] = {"frames": n,
+                        "fps": (n - 1) / span if span > 0 and n > 1 else 0.0}
+        return out
+
+
+class InterLatencyTracer(Tracer):
+    """Source-to-pad latency (GstShark interlatency): each buffer is stamped
+    at its first traced push; downstream pads record the delta."""
+
+    NAME = "interlatency"
+    _STAMP = "_trace_birth"
+
+    def __init__(self):
+        self._acc: Dict[str, list] = defaultdict(lambda: [0, 0.0, 0.0])
+
+    def buffer_flow(self, pad, buf, elapsed_s: float) -> None:
+        now = time.monotonic()
+        birth = buf.meta.get(self._STAMP)
+        if birth is None:
+            buf.meta[self._STAMP] = now
+            return
+        cell = self._acc[pad.full_name]
+        cell[0] += 1
+        cell[1] += now - birth
+        cell[2] = max(cell[2], now - birth)
+
+    def results(self) -> dict:
+        return {
+            pad: {"buffers": n, "avg_ms": (t / n) * 1e3 if n else 0.0,
+                  "max_ms": mx * 1e3}
+            for pad, (n, t, mx) in self._acc.items()
+        }
+
+
+class QueueLevelTracer(Tracer):
+    """Queue occupancy sampled at every flow through a queue's pads
+    (GstShark queue-level)."""
+
+    NAME = "queuelevel"
+
+    def __init__(self):
+        self._acc: Dict[str, list] = defaultdict(lambda: [0, 0, 0])
+
+    def buffer_flow(self, pad, buf, elapsed_s: float) -> None:
+        el = pad.element
+        ch = getattr(el, "_ch", None)
+        if ch is None and pad.peer is not None:
+            el = pad.peer.element
+            ch = getattr(el, "_ch", None)
+        if ch is None:
+            return
+        level = getattr(ch, "_n_bufs", 0)
+        cell = self._acc[el.name]
+        cell[0] += 1
+        cell[1] += level
+        cell[2] = max(cell[2], level)
+
+    def results(self) -> dict:
+        return {
+            el: {"samples": n, "avg_level": s / n if n else 0.0, "max_level": mx}
+            for el, (n, s, mx) in self._acc.items()
+        }
+
+
+_BUILTIN = {t.NAME: t for t in
+            (ProcTimeTracer, FramerateTracer, InterLatencyTracer,
+             QueueLevelTracer)}
+
+
+def install_tracers(names: List[str]) -> List[Tracer]:
+    """Install tracers by name; returns the instances."""
+    global ACTIVE
+    instances = []
+    with _lock:
+        for n in names:
+            n = n.strip()
+            if not n:
+                continue
+            if n not in _BUILTIN:
+                raise ValueError(f"unknown tracer '{n}' (have: {sorted(_BUILTIN)})")
+            inst = _BUILTIN[n]()
+            _tracers.append(inst)
+            instances.append(inst)
+        ACTIVE = bool(_tracers)
+    return instances
+
+
+def install_tracer(tracer: Tracer) -> None:
+    """Install a custom Tracer instance."""
+    global ACTIVE
+    with _lock:
+        _tracers.append(tracer)
+        ACTIVE = True
+
+
+def uninstall_tracers() -> None:
+    global ACTIVE
+    with _lock:
+        _tracers.clear()
+        ACTIVE = False
+
+
+def trace_results() -> dict:
+    with _lock:
+        return {t.NAME or type(t).__name__: t.results() for t in _tracers}
+
+
+_env_checked = False
+
+
+def install_from_env() -> None:
+    """Honor NNS_TRACERS once (called from Pipeline.play)."""
+    global _env_checked
+    if _env_checked:
+        return
+    _env_checked = True
+    spec = os.environ.get("NNS_TRACERS", "")
+    if spec:
+        install_tracers(spec.replace(",", ";").split(";"))
+
+
+def notify_flow(pad, buf, elapsed_s: float) -> None:
+    """Hot-path fan-out (only reached when ACTIVE)."""
+    for t in _tracers:
+        try:
+            t.buffer_flow(pad, buf, elapsed_s)
+        except Exception:  # noqa: BLE001 - tracers must never kill dataflow
+            pass
+
+
+def dump_dot(pipeline, reason: str = "play") -> Optional[str]:
+    """Write <dot_dir>/<pipeline-name>.<reason>.dot when NNS_DOT_DIR is set
+    (GST_DEBUG_DUMP_DOT_DIR analog). Returns the path written."""
+    dot_dir = os.environ.get("NNS_DOT_DIR")
+    if not dot_dir:
+        return None
+    os.makedirs(dot_dir, exist_ok=True)
+    path = os.path.join(dot_dir, f"{pipeline.name}.{reason}.dot")
+    with open(path, "w") as fh:
+        fh.write(pipeline.to_dot())
+    return path
+
+
+@contextlib.contextmanager
+def jax_trace(logdir: str):
+    """Wrap a pipeline run in a JAX profiler trace (XPlane/TensorBoard) so
+    device timelines align with host tracer spans."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
