@@ -152,9 +152,66 @@ class QueueLevelTracer(Tracer):
         }
 
 
+class ChromeTraceTracer(Tracer):
+    """Complete-event trace viewable in chrome://tracing / Perfetto: one
+    'X' span per element chain per buffer, thread-separated, lining up
+    with ``jax_trace`` device XPlanes. Path from NNS_CHROME_TRACE
+    (default nns_trace.json); written by ``save()`` and automatically at
+    interpreter exit when env-activated."""
+
+    NAME = "chrometrace"
+    MAX_EVENTS = 1_000_000  # bound memory on endless streams
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or os.environ.get("NNS_CHROME_TRACE", "nns_trace.json")
+        self._events: List[dict] = []
+        self._t0 = time.perf_counter()
+        self._saved = False
+        if path is None:
+            # env-activated use (NNS_TRACERS=chrometrace) has no code to
+            # call save(); API users pass a path and save() themselves
+            import atexit
+
+            atexit.register(self.save)
+
+    def buffer_flow(self, pad, buf, elapsed_s: float) -> None:
+        peer = pad.peer
+        if peer is None or len(self._events) >= self.MAX_EVENTS:
+            return
+        now = time.perf_counter()
+        self._events.append({
+            "name": peer.element.name,
+            "cat": "element",
+            "ph": "X",
+            "ts": (now - elapsed_s - self._t0) * 1e6,  # µs
+            "dur": elapsed_s * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 1_000_000,
+        })
+
+    def save(self) -> Optional[str]:
+        if self._saved or not self._events:
+            return None
+        import atexit
+        import json
+
+        self._saved = True
+        events, self._events = self._events, []  # release the memory
+        with open(self.path, "w") as fh:
+            json.dump({"traceEvents": events}, fh)
+        try:
+            atexit.unregister(self.save)
+        except Exception:  # noqa: BLE001 - unregister is best-effort
+            pass
+        return self.path
+
+    def results(self) -> dict:
+        return {"events": len(self._events), "path": self.path}
+
+
 _BUILTIN = {t.NAME: t for t in
             (ProcTimeTracer, FramerateTracer, InterLatencyTracer,
-             QueueLevelTracer)}
+             QueueLevelTracer, ChromeTraceTracer)}
 
 
 def install_tracers(names: List[str]) -> List[Tracer]:

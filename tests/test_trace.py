@@ -141,3 +141,30 @@ class TestHwAccelProbe:
         primed = accel_available("nonexistent_accel")  # primes the cache
         with mock.patch.object(sp, "run", side_effect=AssertionError):
             assert accel_available("nonexistent_accel") is primed
+
+
+class TestChromeTrace:
+    def test_spans_written_and_loadable(self, tmp_path):
+        import json
+
+        from nnstreamer_tpu.runtime.parse import parse_launch
+        from nnstreamer_tpu.utils import trace
+
+        tracer = trace.ChromeTraceTracer(path=str(tmp_path / "t.json"))
+        trace.install_tracer(tracer)
+        try:
+            pipe = parse_launch(
+                "tensor_src num-buffers=5 dimensions=4 types=float32 "
+                "! tensor_transform mode=typecast option=float32 name=tt "
+                "! tensor_sink name=out")
+            pipe.run(timeout=20)
+        finally:
+            trace.uninstall_tracers()
+        path = tracer.save()
+        assert path is not None
+        events = json.load(open(path))["traceEvents"]
+        assert len(events) >= 10  # 5 buffers x 2 downstream hops
+        names = {e["name"] for e in events}
+        assert "tt" in names and "out" in names
+        for e in events:
+            assert e["ph"] == "X" and e["dur"] >= 0
