@@ -228,7 +228,9 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
     ``fn(*inputs)`` is jax-traceable; quantized inputs may be fed as their
     integer dtype (dequantized in-graph) or pre-dequantized float32.
     ``options['float_output']`` truthy → skip output re-quantization and
-    emit float32.
+    emit float32. ``options['precision']`` = highest (default; exact
+    fake-quant parity) | default (bf16 MXU passes — faster on TPU, top-1
+    usually stable but byte-exactness is not guaranteed).
     """
     import jax
     import jax.numpy as jnp
@@ -306,9 +308,16 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
             )
         return raw_consts[idx]
 
-    # full-precision accumulation: fake-quant snapping is only faithful when
-    # the MXU doesn't round products to bf16 first
-    precision = jax.lax.Precision.HIGHEST
+    # full-precision accumulation by default: fake-quant snapping is only
+    # faithful when the MXU doesn't round products to bf16 first;
+    # precision:default opts into bf16 throughput at parity risk
+    prec_name = str(options.get("precision", "highest")).lower()
+    try:
+        precision = jax.lax.Precision[prec_name.upper()]
+    except KeyError:
+        raise ValueError(
+            f"tflite import: precision:{prec_name!r} not one of "
+            "highest|high|default")
 
     def fn(*inputs):
         env: Dict[int, Any] = {}

@@ -176,7 +176,8 @@ class ChromeTraceTracer(Tracer):
 
     def buffer_flow(self, pad, buf, elapsed_s: float) -> None:
         peer = pad.peer
-        if peer is None or len(self._events) >= self.MAX_EVENTS:
+        if (peer is None or self._saved
+                or len(self._events) >= self.MAX_EVENTS):
             return
         now = time.perf_counter()
         self._events.append({
@@ -186,7 +187,9 @@ class ChromeTraceTracer(Tracer):
             "ts": (now - elapsed_s - self._t0) * 1e6,  # µs
             "dur": elapsed_s * 1e6,
             "pid": os.getpid(),
-            "tid": threading.get_ident() % 1_000_000,
+            # tids are arbitrary JSON numbers — never fold them (collisions
+            # render as corrupt nesting in Perfetto)
+            "tid": threading.get_ident(),
         })
 
     def save(self) -> Optional[str]:
@@ -195,10 +198,12 @@ class ChromeTraceTracer(Tracer):
         import atexit
         import json
 
-        self._saved = True
-        events, self._events = self._events, []  # release the memory
         with open(self.path, "w") as fh:
-            json.dump({"traceEvents": events}, fh)
+            json.dump({"traceEvents": self._events}, fh)
+        # only a successful write finalizes: a failed open/dump keeps the
+        # events so a retry can still flush them
+        self._saved = True
+        self._events = []
         try:
             atexit.unregister(self.save)
         except Exception:  # noqa: BLE001 - unregister is best-effort

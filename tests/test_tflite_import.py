@@ -158,3 +158,15 @@ class TestSynthesizedOps:
         ref = _run_interp(_interp(str(path)), x_in)[0]
         assert np.abs(ours - ref).max() < 1e-5
         assert np.allclose(ours.sum(), 1.0, atol=1e-5)
+
+
+class TestPrecisionOption:
+    def test_default_precision_runs_and_bad_value_rejected(self):
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = f"{REF_MODELS}/add.tflite"
+        fn, in_info, _ = load_tflite(path, {"precision": "default"})
+        x = np.random.rand(*in_info.specs[0].shape).astype(np.float32)
+        assert np.asarray(fn(x)[0]).shape == in_info.specs[0].shape
+        with pytest.raises(ValueError, match="precision"):
+            load_tflite(path, {"precision": "turbo"})
