@@ -31,6 +31,18 @@ for mp-palm); option8 = "W:H" model input size (palm decode scale,
 default 192:192); option9 = palm anchor params
 "layers:min_scale:max_scale:offset_x:offset_y:stride0:stride1:..."
 (reference option3 tail for mp-palm-detection).
+
+Rendering styles (option10): ``overlay`` (default — this framework's
+design: per-class colors, thickness-2 boxes) or ``classic`` — the
+reference decoder's byte-compatible output (1px 0xFF0000FF outlines,
+integer coordinate math, 8×13 label cells; see ``bbox_classic.py``),
+proven against the reference's own golden fixtures in
+``tests/test_reference_parity.py``. option11 = track (0|1, classic only:
+centroid tracking ids appended to labels, reference option6);
+option12 = yolo scaled-output flag (classic only, reference option3[0]).
+In classic style option7 priors may be the reference's ``box_priors.txt``
+text format (4 lines) as well as ``.npy``.
+
 Output: RGBA video frame with box rectangles drawn (transparent background,
 to be alpha-blended over the source video — the reference's ``compositor``
 pattern); decoded detections also ride in ``buf.meta["detections"]``.
@@ -89,13 +101,36 @@ class BoundingBoxes(Decoder):
         # is smaller — right for real heads (84, 8400) but ambiguous when
         # N < 4+C, hence the override.
         self.layout = self.option(6, "auto")
+        self.style = self.option(10, "overlay")
+        self.track = self.option(11, "0") not in ("0", "", "false")
+        self.yolo_scaled = self.option(12, "0") not in ("0", "", "false")
+        self._tracker = None
+        if self.style == "classic":
+            from . import bbox_classic as bc
+
+            # reference per-mode threshold defaults differ from ours
+            if self.option(4) is None:
+                if self.fmt in ("mobilenet-ssd", "tflite-ssd"):
+                    self.score_threshold = 0.5
+                elif self.fmt in ("mobilenet-ssd-postprocess", "tf-ssd"):
+                    self.score_threshold = float(bc.G_MINFLOAT)
+            if self.option(5) is None and self.fmt in ("yolov5", "yolov8"):
+                self.iou_threshold = 0.45
+            if self.track:
+                self._tracker = bc.CentroidTracker()
         self.anchors = None
         priors = self.option(7)
         if priors:
-            self.anchors = np.load(priors).astype(np.float32)
+            if priors.endswith(".npy"):
+                self.anchors = np.load(priors).astype(np.float32)
+            else:
+                from .bbox_classic import load_priors_txt
+
+                # reference text format, rows [cy, cx, h, w] → (N, 4)
+                self.anchors = load_priors_txt(priors).T
         elif self.fmt in ("mobilenet-ssd", "tflite-ssd"):
             raise ValueError(
-                "bounding_boxes: mobilenet-ssd (raw) needs option7=<priors.npy>")
+                "bounding_boxes: mobilenet-ssd (raw) needs option7=<priors>")
 
     def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
         return Caps.new(VIDEO_MIME, format="RGBA", width=self.width, height=self.height)
@@ -191,8 +226,86 @@ class BoundingBoxes(Decoder):
             return _custom_parsers[fmt](tensors)
         raise ValueError(f"bounding_boxes: unknown format '{self.fmt}'")
 
+    # -- classic (reference-byte-compatible) path ---------------------------
+    def _classic_palm_anchors(self) -> np.ndarray:
+        from . import bbox_classic as bc
+
+        kw = {}
+        params = self.option(9)
+        if params:
+            vals = [p for p in str(params).split(":")]
+            names = ("num_layers", "min_scale", "max_scale", "offset_x", "offset_y")
+            for name, v in zip(names, vals):
+                if v:
+                    kw[name] = int(float(v)) if name == "num_layers" else float(v)
+            strides = [int(float(v)) for v in vals[5:] if v]
+            if strides:
+                kw["strides"] = strides
+        return bc.palm_anchors_classic(**kw)
+
+    def _decode_classic(self, tensors) -> Buffer:
+        from . import bbox_classic as bc
+
+        fmt = self.fmt
+        i_w, i_h = self.in_width, self.in_height
+        if fmt in ("mobilenet-ssd", "tflite-ssd"):
+            dets = bc.parse_mobilenet_ssd(
+                np.asarray(tensors[0]).reshape(-1, 4),
+                np.asarray(tensors[1]),
+                self.anchors.T, i_w, i_h, self.score_threshold)
+            dets = bc.nms_classic(dets, self.iou_threshold)
+        elif fmt in ("mobilenet-ssd-postprocess", "tf-ssd"):
+            # reference default tensor mapping: num=0, classes=1, scores=2,
+            # locations=3 (MOBILENET_SSD_PP_BBOX_IDX_*_DEFAULT); no NMS
+            dets = bc.parse_ssd_pp(
+                np.asarray(tensors[0]), np.asarray(tensors[1]),
+                np.asarray(tensors[2]), np.asarray(tensors[3]),
+                i_w, i_h, self.score_threshold)
+        elif fmt in ("yolov5", "yolov8"):
+            num_info = 5 if fmt == "yolov5" else 4
+            a = np.asarray(tensors[0])
+            a = a.reshape(-1, a.shape[-1]) if a.ndim > 2 else a
+            if fmt == "yolov8" and (
+                self.layout == "coords-first"
+                or (self.layout == "auto" and a.shape[0] < a.shape[1])
+            ):  # (4+C, N) head layout, same rule as the overlay path
+                a = a.T
+            dets = bc.parse_yolo(a, i_w, i_h, num_info,
+                                 self.score_threshold, self.yolo_scaled)
+            dets = bc.nms_classic(dets, self.iou_threshold)
+        elif fmt == "mp-palm-detection":
+            if not hasattr(self, "_classic_anchors"):
+                self._classic_anchors = self._classic_palm_anchors()
+            dets = bc.parse_palm(
+                np.asarray(tensors[0]), np.asarray(tensors[1]),
+                self._classic_anchors, i_w, i_h, self.score_threshold)
+            dets = bc.nms_classic(dets, self.iou_threshold)
+        elif fmt in ("ov-person-detection", "ov-face-detection"):
+            dets = bc.parse_ov(np.asarray(tensors[0]), i_w, i_h,
+                               self.score_threshold)
+        else:
+            raise ValueError(
+                f"bounding_boxes: style=classic unsupported for '{fmt}'")
+        if self._tracker is not None:
+            self._tracker.update(dets)
+        frame, cells = bc.draw_classic(
+            dets, self.width, self.height, i_w, i_h,
+            self.labels or None, track=self.track)
+        out = Buffer([frame])
+        out.meta["detections"] = [
+            {"box": [d.x, d.y, d.width, d.height], "score": d.prob,
+             "class": d.class_id, "tracking_id": d.tracking_id,
+             "label": (self.labels[d.class_id]
+                       if 0 <= d.class_id < len(self.labels) else str(d.class_id))}
+            for d in dets
+        ]
+        out.meta["label_cells"] = cells
+        return out
+
     # -- decode -------------------------------------------------------------
     def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        if self.style == "classic":
+            return self._decode_classic(buf.tensors)
         boxes, scores, classes = self._parse(buf.tensors)
         if self.use_nms:
             keep = nms_numpy(boxes, scores, self.iou_threshold, self.score_threshold)

@@ -16,7 +16,8 @@ from ..registry.subplugin import SubpluginKind, get as get_subplugin
 from ..runtime.element import ElementError, Prop, TransformElement
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 
-_N_OPTIONS = 9  # reference supports option1..option9
+_N_OPTIONS = 12  # reference supports option1..option9; 10-12 are ours
+# (bounding_boxes: option10=style, option11=track, option12=yolo-scaled)
 
 
 def _option_props():
