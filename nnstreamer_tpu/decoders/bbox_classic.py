@@ -29,7 +29,8 @@ match the C code's ``gfloat`` domain.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from functools import lru_cache
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -82,9 +83,16 @@ def parse_mobilenet_ssd(
     i_h: int,
     threshold: float = 0.5,
     scales: Tuple[float, float, float, float] = (10.0, 10.0, 5.0, 5.0),
+    class_select: str = "last",
 ) -> List[DetObject]:
     """Raw SSD heads: boxes (N,4) center offsets, dets (N,C) logits,
-    priors (4,N) [cy,cx,h,w]."""
+    priors (4,N) [cy,cx,h,w].
+
+    ``class_select``: the two reference variants of the same macro —
+    ``"last"`` for bounding_boxes (missing ``highscore`` update, last
+    above-threshold class wins) and ``"first"`` for tensor_region
+    (``break`` after the first above-threshold class,
+    tensordec-tensor_region.c:436-476)."""
     boxes = np.asarray(boxes, np.float32).reshape(-1, boxes.shape[-1])
     dets = np.asarray(dets, np.float32).reshape(boxes.shape[0], -1)
     n = min(len(boxes), MOBILENET_SSD_DETECTION_MAX, priors.shape[1])
@@ -102,7 +110,10 @@ def parse_mobilenet_ssd(
     # above-threshold class overwrites the result: the LAST above-threshold
     # class index wins, not the argmax. Goldens encode this behavior.
     ncls = cls_logits.shape[1]
-    best = ncls - 1 - np.argmax(valid[:, ::-1], axis=1)
+    if class_select == "first":
+        best = np.argmax(valid, axis=1)
+    else:
+        best = ncls - 1 - np.argmax(valid[:, ::-1], axis=1)
     for d in np.nonzero(any_valid)[0]:
         c = int(best[d]) + 1
         score = np.float32(1.0) / (np.float32(1.0) + np.exp(-dets[d, c]))
@@ -194,41 +205,6 @@ def parse_yolo(
     return out
 
 
-def palm_anchors_classic(
-    num_layers: int = 4,
-    min_scale: float = 1.0,
-    max_scale: float = 1.0,
-    offset_x: float = 0.5,
-    offset_y: float = 0.5,
-    strides: Sequence[int] = (8, 16, 16, 16),
-) -> np.ndarray:
-    """(A,4) float32 [x_center, y_center, w, h]; grid hardcoded to the
-    192×192 palm model (reference ``feature_map = ceil(192/stride)``)."""
-    strides = (list(strides) + [strides[-1]] * num_layers)[:num_layers]
-
-    def scale(idx: int) -> float:
-        if num_layers == 1:
-            return (min_scale + max_scale) * 0.5
-        return min_scale + (max_scale - min_scale) * idx / (num_layers - 1.0)
-
-    out = []
-    layer = 0
-    while layer < num_layers:
-        sizes = []
-        last = layer
-        while last < num_layers and strides[last] == strides[layer]:
-            sizes.append(scale(last))
-            sizes.append(scale(last + 1))
-            last += 1
-        fm = int(np.ceil(192.0 / strides[layer]))
-        for y in range(fm):
-            for x in range(fm):
-                for s in sizes:
-                    out.append(((x + offset_x) / fm, (y + offset_y) / fm, s, s))
-        layer = last
-    return np.asarray(out, np.float32)
-
-
 def parse_palm(
     boxes: np.ndarray,
     scores: np.ndarray,
@@ -289,7 +265,11 @@ def parse_ov(a: np.ndarray, i_w: int, i_h: int,
 # NMS + tracking
 
 def iou_classic(a: DetObject, b: DetObject) -> float:
-    """+1-inclusive integer intersection (reference ``iou`` :1559)."""
+    """+1-inclusive integer intersection (reference ``iou`` :1559).
+
+    Scalar spec of the math ``nms_classic`` vectorizes; kept as the
+    readable reference and cross-checked against the vectorized sweep in
+    tests/test_reference_parity.py (TestNmsSpec)."""
     x1 = max(a.x, b.x)
     y1 = max(a.y, b.y)
     x2 = min(a.x + a.width, b.x + b.width)
@@ -405,14 +385,17 @@ class CentroidTracker:
 # ---------------------------------------------------------------------------
 # drawing
 
+@lru_cache(maxsize=None)
 def _glyph_cell(ch: str) -> np.ndarray:
     """(13,8) bool cell for one character, from this framework's 5×7 font
     (reference geometry: full cell overwritten; glyph pixels differ from
-    the reference's unreproduced third-party font)."""
+    the reference's unreproduced third-party font). Cached — the glyph
+    set is tiny and this sits on the per-frame render path."""
     from .font import _glyph_bitmap
 
     cell = np.zeros((CHAR_H, CHAR_W), bool)
     cell[3:10, 1:6] = _glyph_bitmap(ch).astype(bool)
+    cell.setflags(write=False)  # cached and shared across callers
     return cell
 
 

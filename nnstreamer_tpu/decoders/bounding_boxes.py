@@ -227,22 +227,6 @@ class BoundingBoxes(Decoder):
         raise ValueError(f"bounding_boxes: unknown format '{self.fmt}'")
 
     # -- classic (reference-byte-compatible) path ---------------------------
-    def _classic_palm_anchors(self) -> np.ndarray:
-        from . import bbox_classic as bc
-
-        kw = {}
-        params = self.option(9)
-        if params:
-            vals = [p for p in str(params).split(":")]
-            names = ("num_layers", "min_scale", "max_scale", "offset_x", "offset_y")
-            for name, v in zip(names, vals):
-                if v:
-                    kw[name] = int(float(v)) if name == "num_layers" else float(v)
-            strides = [int(float(v)) for v in vals[5:] if v]
-            if strides:
-                kw["strides"] = strides
-        return bc.palm_anchors_classic(**kw)
-
     def _decode_classic(self, tensors) -> Buffer:
         from . import bbox_classic as bc
 
@@ -265,17 +249,22 @@ class BoundingBoxes(Decoder):
             num_info = 5 if fmt == "yolov5" else 4
             a = np.asarray(tensors[0])
             a = a.reshape(-1, a.shape[-1]) if a.ndim > 2 else a
-            if fmt == "yolov8" and (
-                self.layout == "coords-first"
-                or (self.layout == "auto" and a.shape[0] < a.shape[1])
-            ):  # (4+C, N) head layout, same rule as the overlay path
-                a = a.T
-            dets = bc.parse_yolo(a, i_w, i_h, num_info,
-                                 self.score_threshold, self.yolo_scaled)
+            if a.size == 0:  # zero candidates: legal on flexible streams
+                dets = []
+            else:
+                if fmt == "yolov8" and (
+                    self.layout == "coords-first"
+                    or (self.layout == "auto" and a.shape[0] < a.shape[1])
+                ):  # (4+C, N) head layout, same rule as the overlay path
+                    a = a.T
+                dets = bc.parse_yolo(a, i_w, i_h, num_info,
+                                     self.score_threshold, self.yolo_scaled)
             dets = bc.nms_classic(dets, self.iou_threshold)
         elif fmt == "mp-palm-detection":
             if not hasattr(self, "_classic_anchors"):
-                self._classic_anchors = self._classic_palm_anchors()
+                # same grid generator as the overlay path, but pinned to the
+                # reference's hardcoded 192 input (feature_map=ceil(192/stride))
+                self._classic_anchors = _palm_anchors(self.option(9), 192)
             dets = bc.parse_palm(
                 np.asarray(tensors[0]), np.asarray(tensors[1]),
                 self._classic_anchors, i_w, i_h, self.score_threshold)

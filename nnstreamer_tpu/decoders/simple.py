@@ -106,11 +106,24 @@ class OctetStream(Decoder):
 
 @register_decoder
 class TensorRegion(Decoder):
-    """Detections → (N,4) int32 [x,y,w,h] crop regions for tensor_crop.
+    """Detections → (N,4) crop regions [x,y,w,h] for tensor_crop.
 
-    Input: boxes (N,4) normalized [ymin,xmin,ymax,xmax] + scores (N,) or
-    (N,classes). option1 = number of regions to emit (default 1);
-    option2 = "W:H" frame size to denormalize to (default 1:1 = keep norm).
+    Two input modes, dispatched on option3:
+
+    * **simplified** (no option3): boxes (N,4) normalized
+      [ymin,xmin,ymax,xmax] + scores (N,) or (N,classes); option1 =
+      number of regions (default 1), option2 = "W:H" frame size to
+      denormalize to (default 1:1 = keep normalized). Output int32.
+    * **mobilenet-ssd** (option3 = box-priors file, the reference's
+      semantics — ``tensordec-tensor_region.c``): raw SSD heads
+      [boxes (N,4) center offsets; class logits (N,C)]; option1 = number
+      of regions, option2 = labels file (present for reference-CLI
+      compatibility; the decode itself only needs the logits), option4 =
+      input video size "W:H" (default 300:300). Decode matches the
+      reference exactly: first above-threshold class (:436-476 ``break``),
+      +1-inclusive integer NMS at IoU 0.5, zero-padded uint32 output of
+      exactly ``num`` regions — byte-parity proven against the
+      reference's fixture corpus in tests/test_reference_parity.py.
     """
 
     MODE = "tensor_region"
@@ -118,13 +131,36 @@ class TensorRegion(Decoder):
     def init(self, options):
         super().init(options)
         self.num = int(self.option(1, "1"))
-        wh = self.option(2, "1:1").split(":")
-        self.frame_w, self.frame_h = int(wh[0]), int(wh[1])
+        self.priors = None
+        priors = self.option(3)
+        if priors:
+            from .bbox_classic import load_priors_txt
+
+            self.priors = (np.load(priors).astype(np.float32).T
+                           if priors.endswith(".npy") else load_priors_txt(priors))
+            wh = self.option(4, "300:300").split(":")
+            self.in_width, self.in_height = int(wh[0]), int(wh[1])
+        else:
+            wh = self.option(2, "1:1").split(":")
+            self.frame_w, self.frame_h = int(wh[0]), int(wh[1])
 
     def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
         return caps_from_tensors_info(TensorsInfo((), TensorFormat.FLEXIBLE))
 
     def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        if self.priors is not None:
+            from . import bbox_classic as bc
+
+            dets = bc.parse_mobilenet_ssd(
+                np.asarray(buf.tensors[0]).reshape(-1, 4),
+                np.asarray(buf.tensors[1]),
+                self.priors, self.in_width, self.in_height,
+                class_select="first")
+            dets = bc.nms_classic(dets, 0.5)
+            out = np.zeros((self.num, 4), np.uint32)
+            for i, d in enumerate(dets[: self.num]):
+                out[i] = (d.x, d.y, d.width, d.height)
+            return Buffer([out])
         boxes = np.asarray(buf.tensors[0]).reshape(-1, 4).astype(np.float32)
         scores = np.asarray(buf.tensors[1]).astype(np.float32) if buf.num_tensors > 1 else None
         if scores is not None:
