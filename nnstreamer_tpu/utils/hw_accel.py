@@ -75,6 +75,76 @@ def accel_available(platform: str, timeout_s: float = 15.0,
     return result
 
 
+_DEFAULT_PROBE_SRC = (
+    "import os, sys;"
+    "os.environ.pop('JAX_PLATFORMS', None);"
+    "import jax;\n"
+    "try:\n"
+    "    jax.config.update('jax_platforms', None)\n"
+    "except Exception:\n"
+    "    pass\n"
+    "sys.stdout.write(jax.devices()[0].platform)"
+)
+
+
+def default_platform(
+    timeout_s: float = 300.0,
+    cache_path: Optional[str] = None,
+    cache_ttl_s: float = 1800.0,
+) -> Optional[str]:
+    """Which platform jax's DEFAULT selection would pick, probed in a
+    bounded subprocess.
+
+    Returns the platform name (e.g. ``'axon'``, ``'tpu'``, ``'cpu'``),
+    ``''`` if default init raised, or ``None`` if it timed out (on
+    tunneled rigs a dead TPU can block init for 25+ minutes without
+    raising — measured r2). Unlike :func:`accel_available` this preserves
+    jax's own priority order, so a working non-axon accelerator is still
+    found. ``cache_path`` (best-effort JSON file) amortizes the probe
+    across processes in one driver round — the healthy path would
+    otherwise pay the multi-minute init twice (probe + in-process).
+    """
+    import json
+    import time
+
+    # failures/timeouts are cached with a shorter TTL: long enough that
+    # the next process in the same driver round (entry after bench) skips
+    # a second multi-minute timeout, short enough to re-probe a tunnel
+    # that comes back
+    fail_ttl_s = min(cache_ttl_s / 3.0, 600.0)
+    if cache_path:
+        try:
+            with open(cache_path) as fh:
+                entry = json.load(fh)
+            ttl = cache_ttl_s if entry["platform"] else fail_ttl_s
+            if time.time() - entry["ts"] <= ttl:
+                return entry["platform"]
+        except (OSError, ValueError, KeyError):
+            pass
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _DEFAULT_PROBE_SRC], env=env,
+            timeout=timeout_s, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        result: Optional[str] = (
+            proc.stdout.decode().strip() if proc.returncode == 0 else "")
+    except subprocess.TimeoutExpired:
+        result = None
+    except OSError:
+        result = ""
+    if cache_path:
+        try:
+            tmp = f"{cache_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump({"platform": result, "ts": time.time()}, fh)
+            os.replace(tmp, cache_path)
+        except (OSError, TypeError):
+            pass
+    return result
+
+
 def available_accelerators(timeout_s: float = 15.0) -> Dict[str, Optional[bool]]:
     """Probe the platforms this build cares about (cpu always; tpu/axon
     for the device path). Probes run concurrently so the worst case is

@@ -204,6 +204,8 @@ class TestNmsSpec:
 REGION = "/root/reference/tests/nnstreamer_decoder_tensor_region"
 
 
+@pytest.mark.skipif(not os.path.isdir(REGION),
+                    reason="tensor_region fixture corpus not mounted")
 class TestTensorRegion:
     """reference: tensor_region option1=1 option2=labels option3=box_priors
     over raw SSD fixtures; its golden (tensor_region_orange.txt) is the
