@@ -59,27 +59,10 @@ def main() -> None:
         # bench sat 1504s in init). A hang is indistinguishable from progress
         # to the driver and forfeits the whole measurement window; a probed
         # failure turns it into a CPU number with the true cause attached.
-        # The probe result is file-cached so entry() in the same driver
-        # round doesn't pay the init (or the timeout) a second time.
-        init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
-        _log(f"probing default jax platform in a subprocess "
-             f"(timeout {init_timeout:.0f}s; init can take minutes)")
-        from nnstreamer_tpu.utils.hw_accel import default_platform
+        # Probe policy (timeout/cache) is shared with __graft_entry__.
+        from nnstreamer_tpu.utils.hw_accel import configure_default_platform
 
-        plat = default_platform(
-            timeout_s=init_timeout,
-            cache_path=os.environ.get(
-                "NNS_TPU_PROBE_CACHE", "/tmp/nns_tpu_probe_cache.json"))
-        if plat:
-            _log(f"probe says default platform = {plat}")
-            jax.config.update("jax_platforms", plat)
-        else:
-            tpu_error = (
-                "device platform probe timed out after %.0fs (init hang — tunnel stuck)"
-                % init_timeout if plat is None
-                else "device platform probe failed (backend init error)")
-            _log(f"TPU unavailable: {tpu_error}; falling back to CPU")
-            jax.config.update("jax_platforms", "cpu")
+        tpu_error = configure_default_platform(log=_log)
 
     _log("initializing jax backend in-process")
     try:
@@ -109,7 +92,6 @@ def main() -> None:
     from nnstreamer_tpu.runtime.parse import parse_launch
     from nnstreamer_tpu.single import SingleShot
 
-    total_frames = (WARMUP_BATCHES + MEASURE_BATCHES) * BATCH
     # Topology: batch RAW uint8 on host (aggregator, numpy) → one H2D copy
     # per batch → normalization + forward fused in a single jitted program
     # (models.mobilenet_v2:filter_model_u8). The queue decouples host
@@ -117,16 +99,6 @@ def main() -> None:
     # of batch N. Normalize-then-batch per frame (the reference topology)
     # would ship 4x the bytes and pay per-frame dispatch round-trips.
     model = "nnstreamer_tpu.models.mobilenet_v2:filter_model_u8"
-    pipe = parse_launch(
-        f"tensor_src num-buffers={total_frames} dimensions=3:224:224:1 "
-        "types=uint8 pattern=random "
-        f"! tensor_aggregator frames-out={BATCH} frames-dim=0 concat=true "
-        "! queue max-size-buffers=4 "
-        f"! tensor_filter framework=jax model={model} "
-        "shared-tensor-filter-key=bench name=f sync-invoke=false "
-        "! queue max-size-buffers=4 name=outq "
-        "! tensor_sink name=out max-stored=1"
-    )
 
     # Pre-compile the EXACT executable the pipeline will run: the shared
     # tensor-filter key resolves SingleShot and the pipeline filter to one
@@ -140,6 +112,49 @@ def main() -> None:
         warm[0].block_until_ready()
         compile_s = time.monotonic() - t_c
         _log(f"compile done in {compile_s:.1f}s")
+
+        # On an accelerator, the best batch size is not knowable in advance
+        # (depends on chip generation + HBM): sweep a few sizes through the
+        # same shared backend (its compile cache is per-shape) and run the
+        # pipeline at the winner. The driver gives us one shot per round —
+        # spend ~1 compile per candidate to not leave throughput on the
+        # table. Skipped when BENCH_BATCH pins the size or on CPU.
+        if (platform != "cpu" or os.environ.get("BENCH_FORCE_SWEEP")) \
+                and "BENCH_BATCH" not in os.environ \
+                and not os.environ.get("BENCH_NO_SWEEP"):
+            candidates = [int(b) for b in os.environ.get(
+                "BENCH_SWEEP", "64,128,256").split(",")]
+            best_b, best_fps = BATCH, 0.0
+            for b in candidates:
+                try:
+                    xb = np.zeros((b, 224, 224, 3), np.uint8)
+                    t0 = time.monotonic()
+                    single.invoke(xb)[0].block_until_ready()  # compile
+                    _log(f"sweep batch={b}: compiled in {time.monotonic() - t0:.1f}s")
+                    t0 = time.monotonic()
+                    outs = [single.invoke(xb) for _ in range(8)]
+                    outs[-1][0].block_until_ready()
+                    fps_b = 8 * b / (time.monotonic() - t0)
+                    _log(f"sweep batch={b}: {fps_b:.0f} fps (direct invoke)")
+                except Exception as e:  # e.g. HBM OOM at large batch
+                    _log(f"sweep batch={b}: failed ({e}); skipping")
+                    continue
+                if fps_b > best_fps:
+                    best_b, best_fps = b, fps_b
+            BATCH = best_b
+            _log(f"sweep winner: batch={BATCH} ({best_fps:.0f} fps direct)")
+
+        total_frames = (WARMUP_BATCHES + MEASURE_BATCHES) * BATCH
+        pipe = parse_launch(
+            f"tensor_src num-buffers={total_frames} dimensions=3:224:224:1 "
+            "types=uint8 pattern=random "
+            f"! tensor_aggregator frames-out={BATCH} frames-dim=0 concat=true "
+            "! queue max-size-buffers=4 "
+            f"! tensor_filter framework=jax model={model} "
+            "shared-tensor-filter-key=bench name=f sync-invoke=false "
+            "! queue max-size-buffers=4 name=outq "
+            "! tensor_sink name=out max-stored=1"
+        )
 
         sink = pipe.get("out")
         times = []

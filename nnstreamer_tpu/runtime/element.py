@@ -9,6 +9,7 @@ sink pads of an element carry fixed caps, the element computes its source caps
 """
 from __future__ import annotations
 
+import os
 import threading
 import traceback
 from dataclasses import dataclass
@@ -92,10 +93,44 @@ class Element:
         if key == "name":
             self.name = str(value)
             return
+        if key == "config_file":
+            # reference: generic key=value property file, applied in file
+            # order at the point the property is set (gst_tensor_parse_
+            # config_file, nnstreamer_plugin_api_impl.c:1867; exposed by
+            # tensor_decoder and tensor_filter, here by every element)
+            self._apply_config_file(str(value))
+            return
         if key not in self._prop_defs:
             raise ElementError(f"{self.describe()}: unknown property '{key}'")
         conv = self._prop_defs[key].convert
         self.props[key] = conv(value) if conv is not None else value
+
+    def _apply_config_file(self, path: str) -> None:
+        # cycle guard: a config file naming itself (or a pair naming each
+        # other) must fail as an ElementError, not a RecursionError
+        real = os.path.realpath(path)
+        applying = getattr(self, "_config_files_applying", None)
+        if applying is None:
+            applying = self._config_files_applying = set()
+        if real in applying:
+            raise ElementError(
+                f"{self.describe()}: config-file cycle via '{path}'")
+        try:
+            with open(path) as fh:
+                lines = fh.read().splitlines()
+        except OSError as e:
+            raise ElementError(
+                f"{self.describe()}: cannot read config-file '{path}': {e}")
+        applying.add(real)
+        try:
+            for ln in lines:
+                ln = ln.strip()
+                if not ln or ln.startswith("#") or "=" not in ln:
+                    continue
+                k, v = ln.split("=", 1)
+                self.set_property(k.strip(), v.strip())
+        finally:
+            applying.discard(real)
 
     def get_property(self, key: str) -> Any:
         return self.props[key.replace("-", "_")]

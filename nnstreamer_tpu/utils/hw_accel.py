@@ -83,14 +83,17 @@ _DEFAULT_PROBE_SRC = (
     "    jax.config.update('jax_platforms', None)\n"
     "except Exception:\n"
     "    pass\n"
-    "sys.stdout.write(jax.devices()[0].platform)"
+    # sentinel line: import-time noise (sitecustomize, plugin/absl logs)
+    # may share stdout, so the reader greps for this marker instead of
+    # trusting the whole stream
+    "sys.stdout.write('\\nNNS_PLATFORM=' + jax.devices()[0].platform + '\\n')"
 )
 
 
 def default_platform(
     timeout_s: float = 300.0,
     cache_path: Optional[str] = None,
-    cache_ttl_s: float = 1800.0,
+    cache_ttl_s: float = 600.0,
 ) -> Optional[str]:
     """Which platform jax's DEFAULT selection would pick, probed in a
     bounded subprocess.
@@ -103,15 +106,19 @@ def default_platform(
     found. ``cache_path`` (best-effort JSON file) amortizes the probe
     across processes in one driver round — the healthy path would
     otherwise pay the multi-minute init twice (probe + in-process).
+    The success TTL is deliberately short: a cached "healthy" steers the
+    caller into UNBOUNDED in-process init, so it must only bridge the
+    processes of one driver round, not survive a tunnel dying later.
     """
     import json
+    import re
     import time
 
-    # failures/timeouts are cached with a shorter TTL: long enough that
-    # the next process in the same driver round (entry after bench) skips
-    # a second multi-minute timeout, short enough to re-probe a tunnel
-    # that comes back
-    fail_ttl_s = min(cache_ttl_s / 3.0, 600.0)
+    # failures/timeouts are cached with a shorter TTL still: long enough
+    # that the next process in the same driver round (entry after bench)
+    # skips a second multi-minute timeout, short enough to re-probe a
+    # tunnel that comes back
+    fail_ttl_s = min(cache_ttl_s / 2.0, 300.0)
     if cache_path:
         try:
             with open(cache_path) as fh:
@@ -128,8 +135,9 @@ def default_platform(
             [sys.executable, "-c", _DEFAULT_PROBE_SRC], env=env,
             timeout=timeout_s, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL)
-        result: Optional[str] = (
-            proc.stdout.decode().strip() if proc.returncode == 0 else "")
+        m = re.findall(r"^NNS_PLATFORM=(\w+)\s*$",
+                       proc.stdout.decode(errors="replace"), re.MULTILINE)
+        result: Optional[str] = m[-1] if proc.returncode == 0 and m else ""
     except subprocess.TimeoutExpired:
         result = None
     except OSError:
@@ -143,6 +151,39 @@ def default_platform(
         except (OSError, TypeError):
             pass
     return result
+
+
+def configure_default_platform(log=None) -> Optional[str]:
+    """Single policy for bench.py / __graft_entry__: probe the default
+    platform (bounded, cached via NNS_TPU_PROBE_CACHE) and point
+    jax.config at the result — CPU when the probe failed or timed out.
+
+    Returns the error description when falling back, else None. Honors
+    BENCH_INIT_TIMEOUT (seconds, default 300).
+    """
+    import jax
+
+    def _log(msg):
+        if log:
+            log(msg)
+
+    timeout_s = float(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
+    _log(f"probing default jax platform in a subprocess "
+         f"(timeout {timeout_s:.0f}s; init can take minutes)")
+    plat = default_platform(
+        timeout_s=timeout_s,
+        cache_path=os.environ.get(
+            "NNS_TPU_PROBE_CACHE", "/tmp/nns_tpu_probe_cache.json"))
+    if plat:
+        _log(f"probe says default platform = {plat}")
+        jax.config.update("jax_platforms", plat)
+        return None
+    err = ("device platform probe timed out after %.0fs (init hang — tunnel stuck)"
+           % timeout_s if plat is None
+           else "device platform probe failed (backend init error)")
+    _log(f"TPU unavailable: {err}; falling back to CPU")
+    jax.config.update("jax_platforms", "cpu")
+    return err
 
 
 def available_accelerators(timeout_s: float = 15.0) -> Dict[str, Optional[bool]]:

@@ -275,6 +275,44 @@ class TestTensorRegion:
         assert np.array_equal(rgbx, gold)
 
 
+class TestConfigFile:
+    """reference: tensor_decoder/tensor_filter accept config-file=<path>
+    of key=value lines applied as properties (gst_tensor_parse_config_file,
+    runTest.sh cases 'with config_file.0'). Same golden case as
+    TestMobilenetSSD but configured entirely from a file."""
+
+    def test_ssd_golden_via_config_file(self, tmp_path):
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        cfg = tmp_path / "decoder.conf"
+        cfg.write_text(
+            "# reference-style decoder config\n"
+            "mode=bounding_boxes\n"
+            "option1=mobilenet-ssd\n"
+            "option2=160:120\n"
+            f"option3={REF}/coco_labels_list.txt\n"
+            f"option7={REF}/box_priors.txt\n"
+            "option8=300:300\n"
+            "option10=classic\n")
+        pipe = parse_launch(
+            "tensor_mux name=mux sync-mode=nosync "
+            f"! tensor_decoder config-file={cfg} ! tensor_sink name=out "
+            "appsrc name=b caps=other/tensors,format=static,dimensions=4:1917,types=float32 ! mux.sink_0 "
+            "appsrc name=d caps=other/tensors,format=static,dimensions=91:1917,types=float32 ! mux.sink_1 ")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        pipe.get("b").push_buffer(fixture("mobilenetssd_tensors.0.0").reshape(-1, 4))
+        pipe.get("d").push_buffer(fixture("mobilenetssd_tensors.1.0").reshape(-1, 91))
+        pipe.get("b").end_of_stream()
+        pipe.get("d").end_of_stream()
+        pipe.wait(timeout=20)
+        pipe.stop()
+        frame, cells = np.asarray(got[0].tensors[0]), got[0].meta["label_cells"]
+        gold = golden("mobilenetssd_golden.0", 120, 160)
+        assert np.array_equal(masked(to_bgrx(frame), cells), masked(gold, cells))
+
+
 class TestClassicPipeline:
     """classic style through a real pipeline: mux of two appsrc branches →
     tensor_decoder → tensor_sink (the reference runTest.sh topology)."""
