@@ -34,7 +34,11 @@ class ImageSegment(Decoder):
     def init(self, options):
         super().init(options)
         self.fmt = self.option(1, "tflite-deeplab")
-        self.pal = _palette()
+        # option2 = max class labels except background (reference
+        # tensordec-imagesegment.c option2, default 20/Pascal); palette
+        # gets one color per class + background
+        max_labels = self.option(2)
+        self.pal = _palette(int(max_labels) + 1) if max_labels else _palette()
 
     def _hw(self, in_info: TensorsInfo):
         shape = in_info.specs[0].shape if in_info.specs else None
@@ -64,8 +68,29 @@ class ImageSegment(Decoder):
         return out
 
 
-# COCO-17 skeleton edges (the reference draws a similar fixed skeleton)
-_EDGES = [
+# Default keypoint set: the 14-joint human skeleton the reference ships
+# (tensordec-pose.c pose_metadata_default :150-185 — anatomical topology,
+# written here in our own structure). Connections are symmetric; draw loops
+# emit each edge once (k > i).
+_POSE_DEFAULT = [
+    ("top", (1,)),
+    ("neck", (0, 2, 5, 8, 11)),
+    ("r_shoulder", (1, 3)),
+    ("r_elbow", (2, 4)),
+    ("r_wrist", (3,)),
+    ("l_shoulder", (1, 6)),
+    ("l_elbow", (5, 7)),
+    ("l_wrist", (6,)),
+    ("r_hip", (1, 9)),
+    ("r_knee", (8, 10)),
+    ("r_ankle", (9,)),
+    ("l_hip", (1, 12)),
+    ("l_knee", (11, 13)),
+    ("l_ankle", (12,)),
+]
+
+# COCO-17 skeleton edges (used when the stream carries 17 keypoints)
+_EDGES_COCO17 = [
     (0, 1), (0, 2), (1, 3), (2, 4), (5, 6), (5, 7), (7, 9), (6, 8), (8, 10),
     (5, 11), (6, 12), (11, 12), (11, 13), (13, 15), (12, 14), (14, 16),
 ]
@@ -73,8 +98,25 @@ _EDGES = [
 
 @register_decoder
 class PoseEstimation(Decoder):
-    """option1 = "W:H" output size; option2 = input mode: "heatmap" (H,W,K
-    keypoint heatmaps, posenet-style) or "coords" ((K,2|3) normalized x,y[,s]).
+    """Keypoint heatmaps/coords → skeleton overlay (L4).
+
+    Reference analog: ``tensordec-pose.c`` — same option numbering and
+    decode semantics; rendering is this framework's own style.
+
+    option1 = "W:H" output video size (default 320:240);
+    option2 = "W:H" input model size (keypoints are scaled input→output
+    with the reference's integer math; defaults to the output size;
+    the legacy value "heatmap"/"coords" is accepted as a mode alias);
+    option3 = keypoint label file, one label per line (default: the
+    14-joint skeleton above);
+    option4 = mode: "heatmap-only" (default — argmax per keypoint grid,
+    reference :765-800), "heatmap-offset" (posenet: sigmoid scores +
+    per-cell offset tensor input[1], reference :774-798), or "coords"
+    ((K,2|3) normalized x,y[,score] rows — our extension).
+
+    Keypoints with score < 0.5 are invalid and not drawn (reference
+    :693-697); decoded keypoints ride in ``meta["keypoints"]`` with
+    scores, validity, and labels.
     """
 
     MODE = "pose_estimation"
@@ -83,37 +125,100 @@ class PoseEstimation(Decoder):
         super().init(options)
         wh = self.option(1, "320:240").split(":")
         self.width, self.height = int(wh[0]), int(wh[1])
-        self.mode = self.option(2, "heatmap")
+        opt2 = self.option(2, "")
+        self.mode = self.option(4, "heatmap-only")
+        if opt2 and ":" not in opt2:
+            # legacy API: option2 carried the mode
+            self.mode = {"heatmap": "heatmap-only"}.get(opt2, opt2)
+            opt2 = ""
+        # without an explicit input size the heatmap GRID is normalized to
+        # the output frame (legacy behavior); with one, keypoints scale
+        # input→output with the reference's integer math
+        self._in_size_given = bool(opt2)
+        if opt2:
+            iwh = opt2.split(":")
+            self.in_width, self.in_height = int(iwh[0]), int(iwh[1])
+        else:
+            self.in_width, self.in_height = self.width, self.height
+        self.labels = [n for n, _ in _POSE_DEFAULT]
+        self.connections = {i: c for i, (_, c) in enumerate(_POSE_DEFAULT)}
+        path = self.option(3)
+        if path:
+            with open(path) as fh:
+                labels = [ln.strip() for ln in fh if ln.strip()]
+            if labels:
+                self.labels = labels
+                if len(labels) != len(_POSE_DEFAULT):
+                    self.connections = {}
 
     def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
         return Caps.new(VIDEO_MIME, format="RGBA", width=self.width, height=self.height)
 
-    def _keypoints(self, t: np.ndarray) -> np.ndarray:
+    def _decode_points(self, tensors):
+        """→ (pts (K,2) int output px, scores (K,), valid (K,) bool)."""
+        t = np.asarray(tensors[0]).astype(np.float32)
         if self.mode == "coords":
-            k = t.reshape(-1, t.shape[-1])[:, :2]
-            return k  # normalized (x, y)
-        a = t[0] if t.ndim == 4 else t  # (H,W,K)
-        hh, ww, kk = a.shape
-        flat = a.reshape(-1, kk)
-        idx = flat.argmax(0)
-        ys, xs = np.unravel_index(idx, (hh, ww))
-        return np.stack([xs / max(ww - 1, 1), ys / max(hh - 1, 1)], axis=1)
+            k = t.reshape(-1, t.shape[-1])
+            xs = np.clip(k[:, 0] * (self.width - 1), 0, self.width - 1)
+            ys = np.clip(k[:, 1] * (self.height - 1), 0, self.height - 1)
+            scores = k[:, 2] if k.shape[1] > 2 else np.ones(len(k), np.float32)
+            pts = np.stack([xs, ys], axis=1).astype(np.int64)
+            return pts, scores, scores >= 0.5
+        a = t[0] if t.ndim == 4 else t  # (gy, gx, K)
+        gy, gx, n = a.shape  # decode every channel; labels only name them
+        heat = a
+        if self.mode == "heatmap-offset":
+            heat = 1.0 / (1.0 + np.exp(-heat))
+        flat = heat.reshape(-1, n)
+        idx = flat.argmax(0)  # first max in (gy, gx) scan order, like the ref
+        scores = flat[idx, np.arange(n)]
+        my, mx = np.unravel_index(idx, (gy, gx))
+        if self.mode == "heatmap-offset":
+            if len(tensors) < 2:
+                raise ValueError(
+                    "pose_estimation: heatmap-offset needs a second tensor "
+                    "of per-cell offsets (gy, gx, 2K); got a single-tensor "
+                    "frame — mux the offsets stream or use heatmap-only")
+            off = np.asarray(tensors[1]).astype(np.float32)
+            off = off[0] if off.ndim == 4 else off  # (gy, gx, 2K)
+            oy = off[my, mx, np.arange(n)]
+            ox = off[my, mx, n + np.arange(n)]
+            posx = mx / max(gx - 1, 1) * self.in_width + ox
+            posy = my / max(gy - 1, 1) * self.in_height + oy
+            xs = (posx * self.width / self.in_width).astype(np.int64)
+            ys = (posy * self.height / self.in_height).astype(np.int64)
+        elif not self._in_size_given:
+            # legacy normalization: grid corners map to frame corners
+            xs = (mx / max(gx - 1, 1) * (self.width - 1)).astype(np.int64)
+            ys = (my / max(gy - 1, 1) * (self.height - 1)).astype(np.int64)
+        else:
+            xs = mx * self.width // self.in_width
+            ys = my * self.height // self.in_height
+        xs = np.clip(xs, 0, self.width - 1)
+        ys = np.clip(ys, 0, self.height - 1)
+        return np.stack([xs, ys], axis=1), scores, scores >= 0.5
 
     def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
-        kps = self._keypoints(np.asarray(buf.tensors[0]).astype(np.float32))
+        pts, scores, valid = self._decode_points(buf.tensors)
         frame = np.zeros((self.height, self.width, 4), np.uint8)
-        pts = np.stack(
-            [np.clip(kps[:, 0] * (self.width - 1), 0, self.width - 1),
-             np.clip(kps[:, 1] * (self.height - 1), 0, self.height - 1)],
-            axis=1,
-        ).astype(np.int64)
-        for x, y in pts:
-            frame[max(y - 2, 0):y + 3, max(x - 2, 0):x + 3] = (0, 255, 0, 255)
-        for a, b in _EDGES:
-            if a < len(pts) and b < len(pts):
+        n = len(pts)
+        if n == 17:  # COCO keypoint set, not the 14-joint default skeleton
+            edges = _EDGES_COCO17
+        else:
+            edges = [(i, k) for i, conns in self.connections.items()
+                     for k in conns if i < k < n]
+        for a, b in edges:
+            if a < n and b < n and valid[a] and valid[b]:
                 _draw_line(frame, pts[a], pts[b], (255, 255, 0, 255))
+        for i, (x, y) in enumerate(pts):
+            if valid[i]:
+                frame[max(y - 2, 0):y + 3, max(x - 2, 0):x + 3] = (0, 255, 0, 255)
         out = Buffer([frame])
-        out.meta["keypoints"] = kps
+        out.meta["keypoints"] = [
+            {"x": int(x), "y": int(y), "score": float(s), "valid": bool(v),
+             "label": self.labels[i] if i < len(self.labels) else str(i)}
+            for i, ((x, y), s, v) in enumerate(zip(pts, scores, valid))
+        ]
         return out
 
 

@@ -115,10 +115,10 @@ class TensorFilter(TransformElement):
         "input_types": Prop("", str, "force model input dtypes 'uint8,...'"),
         "output_dims": Prop("", str, "force model output dims (reference output)"),
         "output_types": Prop("", str, "force model output dtypes"),
-        "config_file": Prop("", str,
-                            "file of extra custom options, one k:v per line "
-                            "(reference config-file prop)"),
     }
+    # config-file: the generic key=value property file lives in Element
+    # (reference gst_tensor_parse_config_file); _apply_config_file below
+    # additionally routes non-property lines into custom options.
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -169,17 +169,37 @@ class TensorFilter(TransformElement):
             f"'{model}' (candidates {candidates}, available {sorted(available)})"
         )
 
+    def _apply_config_file(self, path: str) -> None:
+        """Reference semantics (key=value lines become properties) plus a
+        filter extension: lines that are NOT properties (``factor:5``
+        custom-option style) merge into the ``custom`` string."""
+        try:
+            with open(path) as fh:
+                lines = fh.read().splitlines()
+        except OSError as e:
+            from ..runtime.element import ElementError
+
+            raise ElementError(
+                f"{self.describe()}: cannot read config-file '{path}': {e}")
+        extra = getattr(self, "_config_custom", None)
+        if extra is None:
+            extra = self._config_custom = []
+        for ln in lines:
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            key = ln.split("=", 1)[0].strip().replace("-", "_")
+            if "=" in ln and (key in self._prop_defs or key == "name"):
+                k, v = ln.split("=", 1)
+                self.set_property(k.strip(), v.strip())
+            else:
+                extra.append(ln)
+
     def _custom_with_config_file(self) -> str:
         custom = self.props["custom"]
-        path = self.props["config_file"]
-        if not path:
+        extra = getattr(self, "_config_custom", [])
+        if not extra:
             return custom
-        extra = []
-        with open(path) as fh:
-            for ln in fh:
-                ln = ln.strip()
-                if ln and not ln.startswith("#"):
-                    extra.append(ln)
         joined = ",".join(extra)
         return f"{custom},{joined}" if custom else joined
 

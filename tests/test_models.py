@@ -156,8 +156,9 @@ class TestPoseNet:
         frame = np.asarray(got[0].tensors[0])
         assert frame.shape == (64, 64, 4)
         kps = got[0].meta["keypoints"]
-        assert kps.shape == (17, 2)
-        assert kps.min() >= 0.0 and kps.max() <= 1.0
+        assert len(kps) == 17
+        assert all(0 <= k["x"] < 64 and 0 <= k["y"] < 64 for k in kps)
+        assert kps[0]["label"] == "top"  # named from the default skeleton
 
     def test_device_keypoints_match_host_argmax(self):
         from nnstreamer_tpu.models.posenet import build_posenet
