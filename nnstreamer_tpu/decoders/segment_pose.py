@@ -38,7 +38,14 @@ class ImageSegment(Decoder):
         # tensordec-imagesegment.c option2, default 20/Pascal); palette
         # gets one color per class + background
         max_labels = self.option(2)
-        self.pal = _palette(int(max_labels) + 1) if max_labels else _palette()
+        if max_labels is not None:
+            if int(max_labels) < 1:
+                raise ValueError(
+                    f"image_segment: option2 (max labels) must be >= 1, "
+                    f"got {max_labels}")
+            self.pal = _palette(int(max_labels) + 1)
+        else:
+            self.pal = _palette()
 
     def _hw(self, in_info: TensorsInfo):
         shape = in_info.specs[0].shape if in_info.specs else None
@@ -89,7 +96,12 @@ _POSE_DEFAULT = [
     ("l_ankle", (12,)),
 ]
 
-# COCO-17 skeleton edges (used when the stream carries 17 keypoints)
+# COCO-17 keypoint set (used when the stream carries 17 keypoints)
+_COCO17_LABELS = [
+    "nose", "l_eye", "r_eye", "l_ear", "r_ear", "l_shoulder", "r_shoulder",
+    "l_elbow", "r_elbow", "l_wrist", "r_wrist", "l_hip", "r_hip", "l_knee",
+    "r_knee", "l_ankle", "r_ankle",
+]
 _EDGES_COCO17 = [
     (0, 1), (0, 2), (1, 3), (2, 4), (5, 6), (5, 7), (7, 9), (6, 8), (8, 10),
     (5, 11), (6, 12), (11, 12), (11, 13), (13, 15), (12, 14), (14, 16),
@@ -202,11 +214,16 @@ class PoseEstimation(Decoder):
         pts, scores, valid = self._decode_points(buf.tensors)
         frame = np.zeros((self.height, self.width, 4), np.uint8)
         n = len(pts)
-        if n == 17:  # COCO keypoint set, not the 14-joint default skeleton
+        default_labels = self.labels == [nm for nm, _ in _POSE_DEFAULT]
+        if n == 17 and default_labels:
+            # COCO keypoint set, not the 14-joint default skeleton:
+            # edges AND names switch together (label file overrides both)
             edges = _EDGES_COCO17
+            labels = _COCO17_LABELS
         else:
             edges = [(i, k) for i, conns in self.connections.items()
                      for k in conns if i < k < n]
+            labels = self.labels
         for a, b in edges:
             if a < n and b < n and valid[a] and valid[b]:
                 _draw_line(frame, pts[a], pts[b], (255, 255, 0, 255))
@@ -216,7 +233,7 @@ class PoseEstimation(Decoder):
         out = Buffer([frame])
         out.meta["keypoints"] = [
             {"x": int(x), "y": int(y), "score": float(s), "valid": bool(v),
-             "label": self.labels[i] if i < len(self.labels) else str(i)}
+             "label": labels[i] if i < len(labels) else str(i)}
             for i, ((x, y), s, v) in enumerate(zip(pts, scores, valid))
         ]
         return out

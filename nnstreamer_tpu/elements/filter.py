@@ -169,31 +169,20 @@ class TensorFilter(TransformElement):
             f"'{model}' (candidates {candidates}, available {sorted(available)})"
         )
 
-    def _apply_config_file(self, path: str) -> None:
-        """Reference semantics (key=value lines become properties) plus a
-        filter extension: lines that are NOT properties (``factor:5``
-        custom-option style) merge into the ``custom`` string."""
-        try:
-            with open(path) as fh:
-                lines = fh.read().splitlines()
-        except OSError as e:
-            from ..runtime.element import ElementError
+    def _config_file_begin(self) -> None:
+        # a fresh top-level config-file apply replaces previously merged
+        # custom options (re-setting the property must not duplicate them)
+        self._config_custom = []
 
-            raise ElementError(
-                f"{self.describe()}: cannot read config-file '{path}': {e}")
+    def _config_file_other_line(self, ln: str) -> None:
+        """Filter extension to the generic config-file: lines that are not
+        properties (``factor:5`` custom-option style) merge into the
+        ``custom`` string; property lines — including nested config-file=
+        — are handled by Element with its cycle guard."""
         extra = getattr(self, "_config_custom", None)
         if extra is None:
             extra = self._config_custom = []
-        for ln in lines:
-            ln = ln.strip()
-            if not ln or ln.startswith("#"):
-                continue
-            key = ln.split("=", 1)[0].strip().replace("-", "_")
-            if "=" in ln and (key in self._prop_defs or key == "name"):
-                k, v = ln.split("=", 1)
-                self.set_property(k.strip(), v.strip())
-            else:
-                extra.append(ln)
+        extra.append(ln)
 
     def _custom_with_config_file(self) -> str:
         custom = self.props["custom"]

@@ -1,0 +1,189 @@
+"""Autoregressive KV-cache decoding for the transformer LM.
+
+The training side (``models/transformer.py``) runs full sequences; this is
+the inference side: a prefill pass that fills a per-layer K/V cache, a
+single-token decode step that attends against the cache, and a
+``lax.scan`` generation loop — all jittable with static shapes (the cache
+is allocated at ``max_seq`` and written with ``dynamic_update_slice``,
+positions masked by index, per XLA's no-dynamic-shapes rule).
+
+For DENSE configs cached decode is exact: it picks the same greedy tokens
+as re-running the full forward each step (asserted in test_decoding.py).
+For MoE configs it is not bit-identical to a full-sequence rerun: switch
+routing capacity is per-call (``C = ceil(T/E·cf)``), so a decode step
+routing B tokens can overflow/passthrough differently than a forward over
+B·S — inherent to capacity-based MoE serving, not a cache artifact.
+
+Sharding: the cache is (B, H, max_seq, Dh) per layer, sharded
+``P("dp", "tp", None, None)`` — batch over data parallel, heads over
+tensor parallel, matching the training-side head sharding so decode reuses
+the same weight layout with zero resharding. (Sequence stays unsharded in
+decode: each step reads the whole cache; context-parallel decode would
+psum partial attention over ``sp`` — noted as the scaling extension.)
+
+No reference analog: the reference has no generative/LLM path at all
+(SURVEY.md §5.7); this is TPU-native capability beyond parity.
+"""
+from __future__ import annotations
+
+from .transformer import TransformerConfig, _rmsnorm
+
+
+def init_cache(cfg: TransformerConfig, batch: int):
+    """Zeroed K/V cache: list of {"k","v"} (B, H, max_seq, head_dim)."""
+    import jax.numpy as jnp
+
+    shape = (batch, cfg.heads, cfg.max_seq, cfg.head_dim)
+    return [
+        {"k": jnp.zeros(shape, jnp.float32), "v": jnp.zeros(shape, jnp.float32)}
+        for _ in range(cfg.layers)
+    ]
+
+
+def cache_pspecs(cfg: TransformerConfig):
+    from jax.sharding import PartitionSpec as P
+
+    return [{"k": P("dp", "tp", None, None), "v": P("dp", "tp", None, None)}
+            for _ in range(cfg.layers)]
+
+
+def _split_heads(cfg: TransformerConfig, t):
+    B, S = t.shape[0], t.shape[1]
+    return t.reshape(B, S, cfg.heads, cfg.head_dim).transpose(0, 2, 1, 3)
+
+
+def _ffn(blk, h, mesh, cfg: TransformerConfig):
+    import jax
+
+    if "moe" in blk:
+        from ..parallel.moe import moe_ffn
+
+        y, _aux = moe_ffn(blk["moe"], h, mesh, ep_axis="tp",
+                          capacity_factor=cfg.moe_capacity_factor,
+                          return_aux=True)
+        return y
+    return jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
+
+
+def prefill(cfg: TransformerConfig, params, tokens, cache, mesh=None):
+    """Run the prompt (B, S) through the model, filling cache[:, :, :S].
+
+    Returns (logits_last (B, V), cache, next_pos). Attention inside the
+    prompt is causal, identical math to the training ``forward``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, S = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:S][None, :, :]
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    for li, blk in enumerate(params["blocks"]):
+        h = _rmsnorm(x, blk["ln1"])
+        q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
+        q, k, v = (_split_heads(cfg, t) for t in (q, k, v))  # (B,H,S,Dh)
+        cache[li] = {
+            "k": jax.lax.dynamic_update_slice(
+                cache[li]["k"], k, (0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                cache[li]["v"], v, (0, 0, 0, 0)),
+        }
+        att = (q @ k.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+        att = jnp.where(mask[None, None], att, -1e30)
+        att = jax.nn.softmax(att, axis=-1)
+        o = (att @ v).transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        x = x + o @ blk["wo"]
+        x = x + _ffn(blk, _rmsnorm(x, blk["ln2"]), mesh, cfg)
+    x = _rmsnorm(x[:, -1], params["out_norm"])       # last position only
+    return x @ params["embed"].T, cache, jnp.asarray(S, jnp.int32)
+
+
+def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None):
+    """One token (B,) at position ``pos`` (scalar int32) → (logits (B, V),
+    cache). Attends against cache[:, :, :pos+1]; positions > pos are
+    masked by index so the fixed-size cache stays jit-static."""
+    import jax
+    import jax.numpy as jnp
+
+    B = token.shape[0]
+    x = params["embed"][token] + jax.lax.dynamic_index_in_dim(
+        params["pos"], pos, axis=0, keepdims=False)  # (B, D)
+    x = x[:, None, :]                                # (B, 1, D)
+    positions = jnp.arange(cfg.max_seq)
+    visible = (positions <= pos)[None, None, None, :]  # (1,1,1,max_seq)
+    for li, blk in enumerate(params["blocks"]):
+        h = _rmsnorm(x, blk["ln1"])
+        q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
+        q, k, v = (_split_heads(cfg, t) for t in (q, k, v))  # (B,H,1,Dh)
+        ck = jax.lax.dynamic_update_slice(cache[li]["k"], k, (0, 0, pos, 0))
+        cv = jax.lax.dynamic_update_slice(cache[li]["v"], v, (0, 0, pos, 0))
+        cache[li] = {"k": ck, "v": cv}
+        att = (q @ ck.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+        att = jnp.where(visible, att, -1e30)          # (B,H,1,max_seq)
+        att = jax.nn.softmax(att, axis=-1)
+        o = (att @ cv).transpose(0, 2, 1, 3).reshape(B, 1, cfg.dim)
+        x = x + o @ blk["wo"]
+        x = x + _ffn(blk, _rmsnorm(x, blk["ln2"]), mesh, cfg)
+    x = _rmsnorm(x[:, 0], params["out_norm"])
+    return x @ params["embed"].T, cache
+
+
+def make_generate(cfg: TransformerConfig, mesh=None,
+                  temperature: float = 0.0):
+    """Build ``generate(params, prompt (B, S), steps, [rng]) -> (B, S+steps)``
+    — jitted prefill + ``lax.scan`` over decode_step. ``temperature`` 0 =
+    greedy (deterministic); >0 = categorical sampling (pass ``rng``).
+
+    ``steps`` is static (bakes the scan length). With ``mesh``, params keep
+    their training PartitionSpecs and the cache shards per
+    :func:`cache_pspecs`; XLA inserts the tp all-reduces per step.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    def _constrain_cache(cache):
+        if mesh is None:
+            return cache
+        from jax.sharding import NamedSharding
+
+        shardings = [
+            {k: NamedSharding(mesh, s) for k, s in layer.items()}
+            for layer in cache_pspecs(cfg)
+        ]
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, cache, shardings)
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def generate(params, prompt, steps, rng=None):
+        B, S = prompt.shape
+        if S + steps > cfg.max_seq:
+            raise ValueError(
+                f"prompt ({S}) + steps ({steps}) exceeds max_seq {cfg.max_seq}")
+        cache = _constrain_cache(init_cache(cfg, B))
+        logits, cache, pos = prefill(cfg, params, prompt, cache, mesh)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        def pick(logits, key):
+            if temperature > 0.0:
+                return jax.random.categorical(
+                    key, logits / temperature, axis=-1).astype(jnp.int32)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        first = pick(logits, rng)
+
+        def body(carry, key):
+            token, pos, cache = carry
+            logits, cache = decode_step(cfg, params, token, pos, cache, mesh)
+            cache = _constrain_cache(cache)
+            nxt = pick(logits, key)
+            return (nxt, pos + 1, cache), nxt
+
+        keys = jax.random.split(jax.random.fold_in(rng, 1), steps - 1)
+        _, rest = jax.lax.scan(
+            body, (first, pos, cache), keys, length=steps - 1)
+        generated = jnp.concatenate([first[:, None], rest.T], axis=1)
+        return jnp.concatenate([prompt, generated], axis=1)
+
+    return generate

@@ -99,6 +99,7 @@ class Element:
             # config_file, nnstreamer_plugin_api_impl.c:1867; exposed by
             # tensor_decoder and tensor_filter, here by every element)
             self._apply_config_file(str(value))
+            self.props["config_file"] = str(value)  # introspectable
             return
         if key not in self._prop_defs:
             raise ElementError(f"{self.describe()}: unknown property '{key}'")
@@ -121,16 +122,34 @@ class Element:
         except OSError as e:
             raise ElementError(
                 f"{self.describe()}: cannot read config-file '{path}': {e}")
+        if not applying:  # top-level apply (not a nested config-file line)
+            self._config_file_begin()
         applying.add(real)
         try:
             for ln in lines:
                 ln = ln.strip()
-                if not ln or ln.startswith("#") or "=" not in ln:
+                if not ln or ln.startswith("#"):
                     continue
-                k, v = ln.split("=", 1)
-                self.set_property(k.strip(), v.strip())
+                key = ln.split("=", 1)[0].strip().replace("-", "_")
+                if "=" in ln and (key in self._prop_defs
+                                  or key in ("name", "config_file")):
+                    k, v = ln.split("=", 1)
+                    self.set_property(k.strip(), v.strip())
+                else:
+                    self._config_file_other_line(ln)
         finally:
             applying.discard(real)
+
+    def _config_file_begin(self) -> None:
+        """Hook: a fresh top-level config-file apply starts (subclasses
+        reset any state accumulated from a previous apply)."""
+
+    def _config_file_other_line(self, ln: str) -> None:
+        """Hook for config-file lines that are not known properties.
+        Default: unknown ``key=value`` is an error; anything else is
+        ignored. tensor_filter overrides to merge into custom options."""
+        if "=" in ln:
+            self.set_property(*(p.strip() for p in ln.split("=", 1)))
 
     def get_property(self, key: str) -> Any:
         return self.props[key.replace("-", "_")]

@@ -158,7 +158,7 @@ class TestPoseNet:
         kps = got[0].meta["keypoints"]
         assert len(kps) == 17
         assert all(0 <= k["x"] < 64 and 0 <= k["y"] < 64 for k in kps)
-        assert kps[0]["label"] == "top"  # named from the default skeleton
+        assert kps[0]["label"] == "nose"  # 17 keypoints -> COCO names
 
     def test_device_keypoints_match_host_argmax(self):
         from nnstreamer_tpu.models.posenet import build_posenet
