@@ -1,0 +1,234 @@
+"""File-feeding sources and image decode.
+
+Reference analogs: GStreamer ``filesrc`` / ``multifilesrc`` — the standard
+fixture feeders of every reference SSAT pipeline (e.g.
+``multifilesrc location=tensors.0.%d caps=application/octet-stream !
+tensor_converter input-dim=... input-type=...``,
+tests/nnstreamer_decoder_boundingbox/runTest.sh) — and the ``pngdec``
+role (compressed image bytes → raw video frame), gated on Pillow.
+
+Both sources default to ``application/octet-stream`` caps so a
+downstream ``tensor_converter input-dim=... input-type=...`` gives the
+bytes their tensor shape, exactly like the reference pipelines.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, parse_caps_string
+from ..core.caps import OCTET_MIME, VIDEO_MIME
+from ..registry.elements import register_element
+from ..runtime.element import Element, ElementError, Prop, SourceElement
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+
+_OCTET_CAPS = Caps.new(OCTET_MIME)
+
+
+class _FileSourceBase(SourceElement):
+    """Shared bits of filesrc/multifilesrc: required location, optional
+    caps override."""
+
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _OCTET_CAPS),)
+    PROPERTIES = {
+        "location": Prop(None, str, "file path / printf-style pattern"),
+        "caps": Prop(None, lambda v: v, "override output caps string"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if not self.props["location"]:
+            raise ElementError(f"{self.describe()}: location is required")
+
+    def get_src_caps(self) -> Caps:
+        if self.props["caps"]:
+            return parse_caps_string(self.props["caps"])
+        return _OCTET_CAPS
+
+
+@register_element
+class FileSrc(_FileSourceBase):
+    """Single-file source: pushes the file's bytes, then EOS.
+
+    ``blocksize`` splits the file into chunks (-1 = whole file in one
+    buffer, the reference tests' ``blocksize=-1`` idiom). The file is
+    opened once and read sequentially (no per-buffer reopen races).
+    """
+
+    ELEMENT_NAME = "filesrc"
+    PROPERTIES = {
+        "blocksize": Prop(-1, int, "bytes per buffer (<0 = whole file)"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if self.props["blocksize"] == 0:
+            raise ElementError(
+                f"{self.describe()}: blocksize must be nonzero "
+                "(use -1 for the whole file)")
+        self._fh = None
+        self._offset = 0
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._close()
+        self._offset = 0
+
+    def _close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None
+
+    def stop(self) -> None:
+        super().stop()
+        self._close()
+
+    def create(self) -> Optional[Buffer]:
+        path = self.props["location"]
+        if self._fh is None:
+            try:
+                self._fh = open(path, "rb")
+            except OSError as e:
+                raise ElementError(
+                    f"{self.describe()}: cannot open '{path}': {e}")
+        block = self.props["blocksize"]
+        data = self._fh.read() if block < 0 else self._fh.read(block)
+        if not data:  # EOF — forward progress guaranteed: read(n>0) or EOF
+            self._close()
+            return None
+        buf = Buffer([np.frombuffer(data, np.uint8)], offset=self._offset)
+        self._offset += len(data)
+        return buf
+
+
+@register_element
+class MultiFileSrc(_FileSourceBase):
+    """Per-frame file source: ``location`` is a printf-style pattern
+    (``frame.%d``, ``out_%03d.raw``); one file becomes one buffer.
+
+    ``start-index``/``stop-index`` bound the range (stop -1 = until the
+    first missing file), matching the reference tests' usage. A location
+    with no ``%``-conversion requires an explicit ``stop-index`` (the
+    same fixed file each frame) — otherwise it's almost certainly a
+    pattern typo and would stream forever.
+    """
+
+    ELEMENT_NAME = "multifilesrc"
+    PROPERTIES = {
+        "start_index": Prop(0, int, "first index"),
+        "stop_index": Prop(-1, int, "last index (-1 = until missing file)"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        pattern = self.props["location"]
+        try:
+            self._literal = (pattern % 0) == (pattern % 1)
+        except TypeError:
+            # "not all arguments converted": no conversion specifier at all
+            self._literal = True
+        except ValueError as e:
+            raise ElementError(
+                f"{self.describe()}: bad location pattern '{pattern}' ({e}); "
+                "escape literal percent signs as %%")
+        if self._literal and self.props["stop_index"] < 0:
+            raise ElementError(
+                f"{self.describe()}: location '{pattern}' has no %d "
+                "conversion — set stop-index for a fixed-file stream, or "
+                "fix the pattern")
+        self._index = self.props["start_index"]
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._index = self.props["start_index"]
+
+    def create(self) -> Optional[Buffer]:
+        stop = self.props["stop_index"]
+        if stop >= 0 and self._index > stop:
+            return None
+        pattern = self.props["location"]
+        path = pattern if self._literal else pattern % self._index
+        if not os.path.exists(path):
+            if stop >= 0:
+                raise ElementError(
+                    f"{self.describe()}: missing '{path}' before stop-index")
+            return None  # open-ended range: first gap is EOS
+        with open(path, "rb") as fh:
+            data = fh.read()
+        buf = Buffer([np.frombuffer(data, np.uint8)],
+                     offset=self._index - self.props["start_index"])
+        self._index += 1
+        return buf
+
+
+_IMAGE_ACCUM_MAX = 128 << 20  # refuse to buffer more than 128 MB of stream
+
+
+@register_element
+class ImageDec(Element):
+    """Compressed image bytes (png/jpeg/bmp…) → ``video/raw`` RGB frame.
+
+    The reference pipelines lean on GStreamer's ``pngdec``; here Pillow
+    plays that role (gated: a clear error at construction when absent).
+    Like pngdec this parses a byte STREAM: chunked upstream delivery
+    (``filesrc blocksize=N``) accumulates until the bytes decode; EOS
+    with undecodable leftover bytes is an error, not a silent drop.
+    """
+
+    ELEMENT_NAME = "imagedec"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _OCTET_CAPS),)
+    SRC_TEMPLATES = (PadTemplate(
+        "src", PadDirection.SRC, Caps.new(VIDEO_MIME, format="RGB")),)
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        try:
+            from PIL import Image  # noqa: F401
+        except ImportError as e:
+            raise ElementError(
+                f"{self.describe()}: Pillow is required for image decode "
+                f"({e}); feed raw video instead")
+        self._pending = bytearray()
+        self._pending_meta: Optional[Buffer] = None
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        return Caps.new(VIDEO_MIME, format="RGB")
+
+    def _try_decode(self) -> bool:
+        import io
+
+        from PIL import Image
+
+        try:
+            img = Image.open(io.BytesIO(bytes(self._pending)))
+            frame = np.asarray(img.convert("RGB"), np.uint8)
+        except Exception:
+            return False
+        out = Buffer([frame])
+        if self._pending_meta is not None:
+            out.copy_metadata_from(self._pending_meta)
+        self._pending.clear()
+        self._pending_meta = None
+        self.push(out)
+        return True
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        if not self._pending:
+            self._pending_meta = buf
+        self._pending += bytes(np.asarray(buf.as_numpy().tensors[0]).reshape(-1))
+        if len(self._pending) > _IMAGE_ACCUM_MAX:
+            raise ElementError(
+                f"{self.describe()}: {len(self._pending)} bytes buffered "
+                "without a decodable image — not an image stream?")
+        self._try_decode()
+
+    def handle_eos(self) -> None:
+        if self._pending and not self._try_decode():
+            raise ElementError(
+                f"{self.describe()}: stream ended with {len(self._pending)} "
+                "undecodable bytes")
+        self.send_eos()

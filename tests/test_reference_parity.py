@@ -313,6 +313,37 @@ class TestConfigFile:
         assert np.array_equal(masked(to_bgrx(frame), cells), masked(gold, cells))
 
 
+class TestReferenceTopology:
+    """The reference's ACTUAL launch shape — multifilesrc feeding raw
+    fixture files through tensor_converter input-dim/input-type into a
+    mux → decoder — runs unchanged (modulo our option numbering) and
+    byte-matches both golden frames."""
+
+    def test_multifilesrc_palm_pipeline(self):
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        pipe = parse_launch(
+            "tensor_mux name=mux sync-mode=nosync "
+            "! tensor_decoder mode=bounding_boxes option1=mp-palm-detection "
+            "option2=160:120 option4=0.5 option5=0.05 option8=300:300 "
+            "option9=4:1.0:1.0:0.5:0.5:8:16:16:16 option10=classic "
+            "! tensor_sink name=out "
+            f"multifilesrc location={REF}/palm_detection_input_0.%d "
+            "start-index=0 stop-index=1 "
+            "! tensor_converter input-dim=18:2016:1:1 input-type=float32 ! mux.sink_0 "
+            f"multifilesrc location={REF}/palm_detection_input_1.%d "
+            "start-index=0 stop-index=1 "
+            "! tensor_converter input-dim=1:2016:1:1 input-type=float32 ! mux.sink_1 ")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.run(timeout=30)
+        assert len(got) == 2
+        for i, buf in enumerate(got):
+            frame = np.asarray(buf.tensors[0]).reshape(120, 160, 4)
+            assert np.array_equal(
+                frame, golden(f"palm_detection_result_golden.{i}", 120, 160))
+
+
 class TestClassicPipeline:
     """classic style through a real pipeline: mux of two appsrc branches →
     tensor_decoder → tensor_sink (the reference runTest.sh topology)."""
