@@ -19,7 +19,7 @@ from typing import Optional
 import numpy as np
 
 from ..core import Buffer, Caps, parse_caps_string
-from ..core.caps import OCTET_MIME, VIDEO_MIME
+from ..core.caps import OCTET_MIME, VIDEO_MIME, any_media_caps
 from ..registry.elements import register_element
 from ..runtime.element import Element, ElementError, Prop, SourceElement
 from ..runtime.pad import Pad, PadDirection, PadTemplate
@@ -29,9 +29,10 @@ _OCTET_CAPS = Caps.new(OCTET_MIME)
 
 class _FileSourceBase(SourceElement):
     """Shared bits of filesrc/multifilesrc: required location, optional
-    caps override."""
+    caps override (template must stay open for the override to link —
+    the AppSrc pattern)."""
 
-    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _OCTET_CAPS),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, any_media_caps()),)
     PROPERTIES = {
         "location": Prop(None, str, "file path / printf-style pattern"),
         "caps": Prop(None, lambda v: v, "override output caps string"),
@@ -100,8 +101,10 @@ class FileSrc(_FileSourceBase):
         if not data:  # EOF — forward progress guaranteed: read(n>0) or EOF
             self._close()
             return None
+        # offset is the CHUNK sequence number (Buffer.offset is a frame
+        # counter consumed by e.g. shard re-join, not a byte position)
         buf = Buffer([np.frombuffer(data, np.uint8)], offset=self._offset)
-        self._offset += len(data)
+        self._offset += 1
         return buf
 
 
@@ -128,9 +131,15 @@ class MultiFileSrc(_FileSourceBase):
         pattern = self.props["location"]
         try:
             self._literal = (pattern % 0) == (pattern % 1)
-        except TypeError:
-            # "not all arguments converted": no conversion specifier at all
-            self._literal = True
+        except TypeError as e:
+            if "not all arguments converted" in str(e):
+                self._literal = True  # no conversion specifier at all
+            else:
+                # e.g. "%d_%d": has conversions but needs >1 argument —
+                # a malformed pattern, not a literal filename
+                raise ElementError(
+                    f"{self.describe()}: location pattern '{pattern}' needs "
+                    f"exactly one integer conversion ({e})")
         except ValueError as e:
             raise ElementError(
                 f"{self.describe()}: bad location pattern '{pattern}' ({e}); "
@@ -167,6 +176,16 @@ class MultiFileSrc(_FileSourceBase):
 
 _IMAGE_ACCUM_MAX = 128 << 20  # refuse to buffer more than 128 MB of stream
 
+# signature → (end-of-image marker, trailing bytes after the marker).
+# PNG: IEND chunk = len(4) + "IEND" + CRC(4) → image ends 8 bytes past the
+# marker start; JPEG: EOI = FFD9, ends with it. Used both to avoid
+# re-attempting a full decode on every chunk (quadratic otherwise) and to
+# split concatenated image streams at the right byte.
+_END_MARKERS = {
+    b"\x89PNG\r\n\x1a\n": (b"IEND", 8),
+    b"\xff\xd8": (b"\xff\xd9", 2),
+}
+
 
 @register_element
 class ImageDec(Element):
@@ -175,8 +194,10 @@ class ImageDec(Element):
     The reference pipelines lean on GStreamer's ``pngdec``; here Pillow
     plays that role (gated: a clear error at construction when absent).
     Like pngdec this parses a byte STREAM: chunked upstream delivery
-    (``filesrc blocksize=N``) accumulates until the bytes decode; EOS
-    with undecodable leftover bytes is an error, not a silent drop.
+    (``filesrc blocksize=N``) accumulates until an end-of-image marker
+    arrives, concatenated PNG/JPEG streams split into successive frames,
+    and EOS with undecodable leftover bytes is an error, not a silent
+    drop. Formats without a known end marker decode whole-buffer.
     """
 
     ELEMENT_NAME = "imagedec"
@@ -194,27 +215,74 @@ class ImageDec(Element):
                 f"({e}); feed raw video instead")
         self._pending = bytearray()
         self._pending_meta: Optional[Buffer] = None
+        self._scan_from = 0  # resume marker search here (no rescans)
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._pending.clear()
+        self._pending_meta = None
+        self._scan_from = 0
 
     def transform_caps(self, src_pad: Pad) -> Caps:
         return Caps.new(VIDEO_MIME, format="RGB")
 
-    def _try_decode(self) -> bool:
+    def _decode_bytes(self, data: bytes):
         import io
 
         from PIL import Image
 
         try:
-            img = Image.open(io.BytesIO(bytes(self._pending)))
-            frame = np.asarray(img.convert("RGB"), np.uint8)
+            img = Image.open(io.BytesIO(data))
+            return np.asarray(img.convert("RGB"), np.uint8)
         except Exception:
-            return False
+            return None
+
+    def _emit(self, frame: np.ndarray) -> None:
         out = Buffer([frame])
         if self._pending_meta is not None:
             out.copy_metadata_from(self._pending_meta)
-        self._pending.clear()
         self._pending_meta = None
         self.push(out)
-        return True
+
+    def _drain(self, at_eos: bool) -> None:
+        while self._pending:
+            marker = None
+            for sig, m in _END_MARKERS.items():
+                if self._pending.startswith(sig):
+                    marker = m
+                    break
+            if marker is None:
+                # unknown container: no split knowledge — try the whole
+                # accumulation (per-buffer images / exotic formats)
+                frame = self._decode_bytes(bytes(self._pending))
+                if frame is not None:
+                    self._pending.clear()
+                    self._scan_from = 0
+                    self._emit(frame)
+                return
+            end_tag, tail = marker
+            # scan forward from where the last search stopped; a marker hit
+            # that fails to decode (e.g. embedded-thumbnail EOI) moves the
+            # scan window past it and waits for the true end
+            while True:
+                i = self._pending.find(end_tag, self._scan_from)
+                if i < 0:
+                    self._scan_from = max(0, len(self._pending) - len(end_tag) + 1)
+                    return  # incomplete: wait for more bytes
+                end = i + tail
+                if end > len(self._pending):
+                    self._scan_from = i
+                    return  # marker tail not fully arrived yet
+                frame = self._decode_bytes(bytes(self._pending[:end]))
+                if frame is not None:
+                    del self._pending[:end]
+                    self._scan_from = 0
+                    self._emit(frame)
+                    break  # outer loop: maybe another image follows
+                self._scan_from = i + 1  # false marker: keep looking
+                if at_eos:
+                    continue
+                return
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
         if not self._pending:
@@ -224,10 +292,11 @@ class ImageDec(Element):
             raise ElementError(
                 f"{self.describe()}: {len(self._pending)} bytes buffered "
                 "without a decodable image — not an image stream?")
-        self._try_decode()
+        self._drain(at_eos=False)
 
     def handle_eos(self) -> None:
-        if self._pending and not self._try_decode():
+        self._drain(at_eos=True)
+        if self._pending:
             raise ElementError(
                 f"{self.describe()}: stream ended with {len(self._pending)} "
                 "undecodable bytes")

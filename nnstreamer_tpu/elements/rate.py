@@ -31,7 +31,6 @@ class TensorRate(TransformElement):
     PROPERTIES = {
         "framerate": Prop(0.0, _parse_rate, "target output rate (fps or 'n/d'; 0 = off)"),
         "throttle": Prop(False, prop_bool, "send QoS throttle events upstream"),
-        "silent": Prop(True, prop_bool),
     }
 
     def __init__(self, name=None, **props):

@@ -53,7 +53,11 @@ class Element:
     ELEMENT_NAME: str = ""
     SINK_TEMPLATES: Sequence[PadTemplate] = ()
     SRC_TEMPLATES: Sequence[PadTemplate] = ()
-    PROPERTIES: Dict[str, Prop] = {}
+    PROPERTIES: Dict[str, Prop] = {
+        # reference: every tensor element carries `silent` (verbose
+        # per-buffer logging when false, e.g. gsttensor_converter.c:263)
+        "silent": Prop(True, prop_bool, "suppress per-buffer flow logging"),
+    }
 
     _instance_count = 0
     _count_lock = threading.Lock()
@@ -231,6 +235,11 @@ class Element:
 
     # -- data flow ----------------------------------------------------------
     def _chain_guarded(self, pad: Pad, buf: Buffer) -> None:
+        if not self.props["silent"]:
+            logger.info(
+                "%s: buffer on %s pts=%s tensors=%d",
+                self.describe(), pad.name, buf.pts,
+                getattr(buf, "num_tensors", len(buf.tensors)))
         try:
             self.chain(pad, buf)
         except Exception as e:  # noqa: BLE001 - becomes a pipeline ERROR message

@@ -532,3 +532,45 @@ class TestFileSources:
             f"filesrc location={p} blocksize=64 ! imagedec ! tensor_sink name=out")
         assert len(got) == 1
         np.testing.assert_array_equal(np.asarray(got[0].tensors[0]), rgb)
+
+    def test_imagedec_concatenated_pngs(self, tmp_path):
+        pytest.importorskip("PIL")
+        import io
+        from PIL import Image
+
+        frames = [
+            np.random.default_rng(i).integers(0, 255, (6, 8, 3)).astype(np.uint8)
+            for i in range(3)
+        ]
+        blob = b""
+        for f in frames:
+            b = io.BytesIO()
+            Image.fromarray(f).save(b, "PNG")
+            blob += b.getvalue()
+        p = tmp_path / "strip.bin"
+        p.write_bytes(blob)
+        # chunked so image boundaries land mid-buffer
+        got = run_collect(
+            f"filesrc location={p} blocksize=100 ! imagedec ! tensor_sink name=out")
+        assert len(got) == 3
+        for want, b in zip(frames, got):
+            np.testing.assert_array_equal(np.asarray(b.tensors[0]), want)
+
+    def test_filesrc_caps_override_links_typed_downstream(self, tmp_path):
+        data = np.arange(6, dtype=np.float32)
+        p = tmp_path / "t.raw"
+        p.write_bytes(data.tobytes())
+        # overriding caps must pass link-time template intersection
+        got = run_collect(
+            f"filesrc location={p} "
+            "caps=application/octet-stream "
+            "! tensor_converter input-dim=6 input-type=float32 "
+            "! tensor_sink name=out")
+        assert len(got) == 1
+
+    def test_multifilesrc_double_percent_pattern_rejected(self):
+        from nnstreamer_tpu.runtime.element import ElementError
+
+        with pytest.raises(ElementError, match="exactly one"):
+            parse_launch("multifilesrc location=/tmp/f_%d_%d.raw stop-index=1 "
+                         "! tensor_sink name=out")
