@@ -63,9 +63,12 @@ def _cmd_inspect(args) -> int:
         print(f"    sink  {t.name_template}: {t.caps}")
     for t in cls.SRC_TEMPLATES:
         print(f"    src   {t.name_template}: {t.caps}")
-    if cls.PROPERTIES:
+    merged = {}
+    for klass in reversed(cls.__mro__):  # same merge as Element.__init__
+        merged.update(getattr(klass, "PROPERTIES", {}) or {})
+    if merged:
         print("  properties:")
-        for k, p in cls.PROPERTIES.items():
+        for k, p in merged.items():
             detail = f" — {p.doc}" if getattr(p, "doc", None) else ""
             print(f"    {k.replace('_', '-')}: default={p.default!r}{detail}")
     return 0

@@ -36,7 +36,11 @@ def make_anchors(image_size: int, strides: Sequence[int]) -> np.ndarray:
     time — constants folded into the XLA program."""
     all_boxes: List[np.ndarray] = []
     for scale, stride in zip(_ANCHOR_SCALES, strides):
-        fm = image_size // stride
+        # the backbone's SAME-padded stride-2 convs yield ceil-sized
+        # feature maps (iterated ceil-div-2 == ceil(size/stride)); floor
+        # here desyncs the grid whenever stride doesn't divide the size
+        # (e.g. 224/64: head 4x4 vs floor 3x3 — 3135 vs 3114 anchors)
+        fm = -(-image_size // stride)
         centers = (np.arange(fm, dtype=np.float32) + 0.5) / fm
         cy, cx = np.meshgrid(centers, centers, indexing="ij")
         for ar in _ASPECTS:

@@ -112,7 +112,9 @@ def launch_to_description(launch: str) -> dict:
             entry = {"factory": el.ELEMENT_NAME, "name": name}
             props = {}
             for k, v in el.props.items():
-                default = el.PROPERTIES[k].default if k in el.PROPERTIES else None
+                # _prop_defs is the MRO-merged table (class PROPERTIES
+                # dicts shadow, e.g. the universal `silent`)
+                default = el._prop_defs[k].default if k in el._prop_defs else None
                 if v != default:
                     props[k.replace("_", "-")] = v
             if props:

@@ -1,0 +1,206 @@
+"""Benchmark suite: every BASELINE.md headline config, one JSON line each.
+
+``bench.py`` stays the driver gate (ONE line: MobileNet-v2 pipeline fps);
+this suite is the full evidence set for the remaining headline configs:
+
+  1. mobilenet_v2 image_labeling  (classification, batched, fused u8)
+  2. ssd_mobilenet bounding_boxes (detection + decoder post-processing)
+  3. posenet pose_estimation      (keypoints + skeleton render)
+  4. deeplab image_segment        (segmentation + palette render)
+  5. tensor_query sharded inference (2 loopback workers, tensor_shard →
+     query clients → ordered re-join — the among-device config)
+
+Run:  python tools/bench_suite.py            (TPU when up, CPU fallback)
+      BENCHS_FRAMES=64 BENCHS_BATCH=8 ...    (size knobs; CPU defaults
+      are small so the whole suite finishes in a few minutes)
+
+Each config prints {"config", "fps", "frames", "batch", "platform"} on
+stdout; a summary table goes to stderr.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+_T0 = time.monotonic()
+
+
+def _log(msg: str) -> None:
+    print(f"[suite +{time.monotonic() - _T0:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _run_fps(pipe, sink_name: str, want: int, warmup: int,
+             deadline_s: float) -> tuple:
+    """Play `pipe`, time buffers at the sink; returns (fps, measured)."""
+    from nnstreamer_tpu.core import MessageType
+
+    warmup = min(warmup, max(1, want - 2))  # tiny smoke runs still measure
+    sink = pipe.get(sink_name)
+    times = []
+
+    def on_buf(b):
+        for t in b.tensors:
+            if hasattr(t, "block_until_ready"):
+                t.block_until_ready()
+        times.append(time.monotonic())
+
+    sink.connect(on_buf)
+    pipe.play()
+    deadline = time.monotonic() + deadline_s
+    while len(times) < want and time.monotonic() < deadline:
+        msg = pipe.bus.pop(timeout=0.05)
+        if msg is not None and msg.type is MessageType.ERROR:
+            pipe.stop()
+            raise RuntimeError(f"pipeline ERROR: {msg.data.get('error')}")
+        if msg is not None and msg.type is MessageType.EOS:
+            break
+    pipe.stop()
+    if len(times) < warmup + 1:  # need >=1 measured interval past warmup
+        raise RuntimeError(f"only {len(times)}/{want} buffers before deadline")
+    span = times[-1] - times[warmup - 1]
+    return (len(times) - warmup) / span if span > 0 else 0.0, len(times) - warmup
+
+
+def main() -> None:
+    import numpy as np  # noqa: F401
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from nnstreamer_tpu.utils.hw_accel import configure_default_platform
+
+        configure_default_platform(log=_log)
+    platform = jax.devices()[0].platform
+    _log(f"platform: {platform}")
+
+    on_cpu = platform == "cpu"
+    size = int(os.environ.get("BENCHS_SIZE", "96" if on_cpu else "224"))
+    batch = int(os.environ.get("BENCHS_BATCH", "8" if on_cpu else "64"))
+    frames = int(os.environ.get("BENCHS_FRAMES", "64" if on_cpu else "2048"))
+    deadline = float(os.environ.get("BENCHS_DEADLINE", "240"))
+    warmup_batches = 2
+
+    from nnstreamer_tpu.runtime.parse import parse_launch
+
+    results = []
+
+    def record(name, fps, measured, per_batch):
+        row = {"config": name, "fps": round(fps, 1),
+               "measured_frames": measured * per_batch,
+               "batch": per_batch, "platform": platform}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    # -- 1. classification: the bench.py topology + label decode ------------
+    name = "mobilenet_v2_image_labeling"
+    _log(f"{name}: size=224 batch={batch} frames={frames}")
+    try:
+        labels = "/tmp/nns_bench_labels.txt"
+        with open(labels, "w") as fh:
+            fh.write("\n".join(f"class{i}" for i in range(1001)))
+        pipe = parse_launch(
+            f"tensor_src num-buffers={frames} dimensions=3:224:224:1 "
+            "types=uint8 pattern=random "
+            f"! tensor_aggregator frames-out={batch} frames-dim=0 concat=true "
+            "! queue max-size-buffers=4 "
+            "! tensor_filter framework=jax "
+            "model=nnstreamer_tpu.models.mobilenet_v2:filter_model_u8 "
+            "sync-invoke=false "
+            f"! tensor_decoder mode=image_labeling option1={labels} "
+            "! tensor_sink name=out max-stored=1")
+        fps_b, n = _run_fps(pipe, "out", frames // batch, warmup_batches, deadline)
+        record(name, fps_b * batch, n, batch)
+    except Exception as e:
+        _log(f"{name} FAILED: {e}")
+        record(name, 0.0, 0, batch)
+
+    # -- 2-4. detection / pose / segmentation (per-frame decoders) ----------
+    per_frame = [
+        # SSD's anchor grid is baked for its 224 input; pose/segment heads
+        # are fully convolutional and follow BENCHS_SIZE
+        ("ssd_mobilenet_bounding_boxes", 224,
+         "nnstreamer_tpu.models.ssd_mobilenet:filter_model",
+         "tensor_decoder mode=bounding_boxes "
+         "option1=mobilenet-ssd-postprocess option2=224:224 option4=0.3"),
+        ("posenet_pose_estimation", size,
+         "nnstreamer_tpu.models.posenet:filter_model",
+         f"tensor_decoder mode=pose_estimation option1={size}:{size} "
+         "option2=heatmap"),
+        ("deeplab_image_segment", size,
+         "nnstreamer_tpu.models.deeplab:filter_model",
+         "tensor_decoder mode=image_segment option1=tflite-deeplab"),
+    ]
+    for name, in_size, model, dec in per_frame:
+        _log(f"{name}: size={in_size} frames={frames}")
+        try:
+            pipe = parse_launch(
+                f"tensor_src num-buffers={frames} "
+                f"dimensions=3:{in_size}:{in_size}:1 "
+                "types=float32 pattern=random "
+                f"! tensor_filter framework=jax model={model} sync-invoke=false "
+                "! queue max-size-buffers=8 "
+                f"! {dec} ! tensor_sink name=out max-stored=1")
+            fps, n = _run_fps(pipe, "out", frames, warmup_batches * 4, deadline)
+            record(name, fps, n, 1)
+        except Exception as e:
+            _log(f"{name} FAILED: {e}")
+            record(name, 0.0, 0, 1)
+
+    # -- 5. among-device: sharded stream over 2 loopback query workers ------
+    name = "tensor_query_sharded_x2"
+    _log(f"{name}: 2 loopback workers, frames={frames}")
+    servers = []
+    try:
+        ports = []
+        for i in range(2):
+            srv = parse_launch(
+                f"tensor_query_serversrc name=ssrc id={i} port=0 "
+                f"caps=other/tensors,format=static,dimensions=3:{size}:{size}:1,"
+                "types=float32 "
+                "! tensor_filter framework=jax "
+                "model=nnstreamer_tpu.models.deeplab:filter_model "
+                f"! tensor_query_serversink id={i}")
+            srv.play()
+            servers.append(srv)
+            ssrc = srv.get("ssrc")
+            bind_deadline = time.monotonic() + 5
+            while ssrc.bound_port == 0 and time.monotonic() < bind_deadline:
+                time.sleep(0.01)
+            if ssrc.bound_port == 0:
+                raise RuntimeError(f"worker {i} never bound a port")
+            ports.append(ssrc.bound_port)
+        client = parse_launch(
+            f"tensor_src num-buffers={frames} dimensions=3:{size}:{size}:1 "
+            "types=float32 pattern=random "
+            "! tensor_shard name=s "
+            f"s.src_0 ! queue ! tensor_query_client host=127.0.0.1 "
+            f"port={ports[0]} ! u.sink_0 "
+            f"s.src_1 ! queue ! tensor_query_client host=127.0.0.1 "
+            f"port={ports[1]} ! u.sink_1 "
+            "tensor_unshard name=u ! tensor_sink name=out max-stored=1")
+        fps, n = _run_fps(client, "out", frames, warmup_batches * 4, deadline)
+        record(name, fps, n, 1)
+    except Exception as e:
+        _log(f"{name} FAILED: {e}")
+        record(name, 0.0, 0, 1)
+    finally:
+        for srv in servers:
+            srv.stop()
+
+    _log("---- summary ----")
+    for row in results:
+        _log(f"{row['config']:34s} {row['fps']:10.1f} fps  ({row['platform']})")
+
+
+if __name__ == "__main__":
+    main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)  # skip axon teardown aborts (same stance as bench.py)
