@@ -40,11 +40,83 @@ def init_cache(cfg: TransformerConfig, batch: int):
     ]
 
 
-def cache_pspecs(cfg: TransformerConfig):
+def cache_pspecs(cfg: TransformerConfig, context_parallel: bool = False):
+    """Cache PartitionSpecs; with ``context_parallel`` the sequence axis
+    shards over ``sp`` (each chip holds max_seq/sp cache positions)."""
     from jax.sharding import PartitionSpec as P
 
-    return [{"k": P("dp", "tp", None, None), "v": P("dp", "tp", None, None)}
+    seq_axis = "sp" if context_parallel else None
+    return [{"k": P("dp", "tp", seq_axis, None),
+             "v": P("dp", "tp", seq_axis, None)}
             for _ in range(cfg.layers)]
+
+
+def make_sp_cache_attention(cfg: TransformerConfig, mesh):
+    """Context-parallel cached attention: the KV cache's sequence axis is
+    sharded over ``sp``; each shard scores its local cache slice and the
+    partial online-softmax statistics combine with ``pmax``/``psum`` —
+    the decode-side counterpart of the training ring attention
+    (parallel/context.py). Cache memory per chip drops by the sp factor,
+    which is what lets max_seq exceed one chip's HBM.
+
+    Returns ``attn(q, k_new, v_new, ck, cv, pos) -> (o, ck, cv)`` with
+    q/k_new/v_new (B, H, 1, Dh), cache (B, H, max_seq, Dh) [sp-sharded],
+    pos scalar int32.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+        extra_kw = {}
+    except ImportError:  # older jax: experimental API needs check_rep off
+        from jax.experimental.shard_map import shard_map
+        extra_kw = {"check_rep": False}
+
+    if "sp" not in dict(mesh.shape):
+        raise ValueError(
+            "context-parallel decoding needs an 'sp' axis in the mesh "
+            f"(got axes {list(dict(mesh.shape))})")
+    sp = dict(mesh.shape)["sp"]
+    if cfg.max_seq % sp:
+        raise ValueError(
+            f"max_seq {cfg.max_seq} must divide by the sp axis size {sp}")
+    local = cfg.max_seq // sp
+    scale = jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+
+    def shard_fn(q, k_new, v_new, ck, cv, pos):
+        # ck/cv here are the LOCAL (B, H, local, Dh) slices
+        start = jax.lax.axis_index("sp") * local
+        lp = pos - start
+        in_range = (lp >= 0) & (lp < local)
+        lpc = jnp.clip(lp, 0, local - 1)
+        ck = jnp.where(in_range,
+                       jax.lax.dynamic_update_slice(ck, k_new, (0, 0, lpc, 0)),
+                       ck)
+        cv = jnp.where(in_range,
+                       jax.lax.dynamic_update_slice(cv, v_new, (0, 0, lpc, 0)),
+                       cv)
+        scores = (q @ ck.transpose(0, 1, 3, 2)) / scale   # (B,H,1,local)
+        visible = (start + jnp.arange(local)) <= pos
+        scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
+        m = jnp.max(scores, axis=-1)                      # (B,H,1) local max
+        gm = jax.lax.pmax(m, "sp")                        # global max
+        # exp(-inf - gm) == 0: fully-masked shards contribute nothing
+        p = jnp.exp(scores - gm[..., None])
+        p = jnp.where(visible[None, None, None, :], p, 0.0)
+        denom = jax.lax.psum(jnp.sum(p, axis=-1), "sp")   # (B,H,1)
+        num = jax.lax.psum(p @ cv, "sp")                  # (B,H,1,Dh)
+        return num / denom[..., None], ck, cv
+
+    qspec = P("dp", "tp", None, None)
+    cspec = P("dp", "tp", "sp", None)
+    return shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(qspec, qspec, qspec, cspec, cspec, P()),
+        out_specs=(qspec, cspec, cspec),
+        **extra_kw,
+    )
 
 
 def _split_heads(cfg: TransformerConfig, t):
@@ -65,42 +137,88 @@ def _ffn(blk, h, mesh, cfg: TransformerConfig):
     return jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
 
 
-def prefill(cfg: TransformerConfig, params, tokens, cache, mesh=None):
+def prefill(cfg: TransformerConfig, params, tokens, cache, mesh=None,
+            context_parallel: bool = False):
     """Run the prompt (B, S) through the model, filling cache[:, :, :S].
 
     Returns (logits_last (B, V), cache, next_pos). Attention inside the
-    prompt is causal, identical math to the training ``forward``.
+    prompt is causal, identical math to the training ``forward``. With
+    ``context_parallel`` the prompt's activations/K/V are sequence-sharded
+    over ``sp`` and attention runs through the ring schedule
+    (parallel/context.py) — the prompt never materializes unsharded, so
+    long prompts scale with the sp factor just like the cache does.
     """
     import jax
     import jax.numpy as jnp
 
+    ctx_attn = None
+    constrain = lambda x, *spec: x  # noqa: E731
+    if context_parallel:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.context import make_context_attention
+
+        ctx_attn = make_context_attention(mesh, impl="ring")
+
+        def constrain(x, *spec):  # noqa: F811
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+
     B, S = tokens.shape
+    S_real = S
+    if ctx_attn is not None:
+        # ring attention shards the sequence over sp: pad the prompt to a
+        # multiple. Pad K/V slots sit at positions >= S_real, which causal
+        # masking hides from every real token and which the decode loop
+        # overwrites (position p is written before it first becomes
+        # visible), so the padding never leaks into results.
+        sp = dict(mesh.shape)["sp"]
+        pad = (-S) % sp
+        if S + pad > cfg.max_seq:
+            raise ValueError(
+                f"prompt ({S}) padded to the sp multiple ({S + pad}) "
+                f"exceeds max_seq {cfg.max_seq}")
+        if pad:
+            tokens = jnp.pad(tokens, ((0, 0), (0, pad)))
+            S = S + pad
     x = params["embed"][tokens] + params["pos"][:S][None, :, :]
-    mask = jnp.tril(jnp.ones((S, S), bool))
+    x = constrain(x, "dp", "sp", None)
+    mask = None if ctx_attn is not None else jnp.tril(jnp.ones((S, S), bool))
     for li, blk in enumerate(params["blocks"]):
         h = _rmsnorm(x, blk["ln1"])
         q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
         q, k, v = (_split_heads(cfg, t) for t in (q, k, v))  # (B,H,S,Dh)
+        if ctx_attn is not None:
+            k = constrain(k, "dp", "tp", "sp", None)
+            v = constrain(v, "dp", "tp", "sp", None)
         cache[li] = {
             "k": jax.lax.dynamic_update_slice(
                 cache[li]["k"], k, (0, 0, 0, 0)),
             "v": jax.lax.dynamic_update_slice(
                 cache[li]["v"], v, (0, 0, 0, 0)),
         }
-        att = (q @ k.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
-        att = jnp.where(mask[None, None], att, -1e30)
-        att = jax.nn.softmax(att, axis=-1)
-        o = (att @ v).transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        if ctx_attn is not None:
+            o = ctx_attn(q, k, v)
+            o = o.transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
+        else:
+            att = (q @ k.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+            att = jnp.where(mask[None, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1)
+            o = (att @ v).transpose(0, 2, 1, 3).reshape(B, S, cfg.dim)
         x = x + o @ blk["wo"]
         x = x + _ffn(blk, _rmsnorm(x, blk["ln2"]), mesh, cfg)
-    x = _rmsnorm(x[:, -1], params["out_norm"])       # last position only
-    return x @ params["embed"].T, cache, jnp.asarray(S, jnp.int32)
+        x = constrain(x, "dp", "sp", None)
+    x = _rmsnorm(x[:, S_real - 1], params["out_norm"])  # last REAL position
+    return x @ params["embed"].T, cache, jnp.asarray(S_real, jnp.int32)
 
 
-def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None):
+def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None,
+                sp_attn=None):
     """One token (B,) at position ``pos`` (scalar int32) → (logits (B, V),
     cache). Attends against cache[:, :, :pos+1]; positions > pos are
-    masked by index so the fixed-size cache stays jit-static."""
+    masked by index so the fixed-size cache stays jit-static. With
+    ``sp_attn`` (from :func:`make_sp_cache_attention`) the cache stays
+    sequence-sharded and attention combines per-shard partials."""
     import jax
     import jax.numpy as jnp
 
@@ -114,13 +232,18 @@ def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None):
         h = _rmsnorm(x, blk["ln1"])
         q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
         q, k, v = (_split_heads(cfg, t) for t in (q, k, v))  # (B,H,1,Dh)
-        ck = jax.lax.dynamic_update_slice(cache[li]["k"], k, (0, 0, pos, 0))
-        cv = jax.lax.dynamic_update_slice(cache[li]["v"], v, (0, 0, pos, 0))
-        cache[li] = {"k": ck, "v": cv}
-        att = (q @ ck.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
-        att = jnp.where(visible, att, -1e30)          # (B,H,1,max_seq)
-        att = jax.nn.softmax(att, axis=-1)
-        o = (att @ cv).transpose(0, 2, 1, 3).reshape(B, 1, cfg.dim)
+        if sp_attn is not None:
+            o, ck, cv = sp_attn(q, k, v, cache[li]["k"], cache[li]["v"], pos)
+            cache[li] = {"k": ck, "v": cv}
+            o = o.transpose(0, 2, 1, 3).reshape(B, 1, cfg.dim)
+        else:
+            ck = jax.lax.dynamic_update_slice(cache[li]["k"], k, (0, 0, pos, 0))
+            cv = jax.lax.dynamic_update_slice(cache[li]["v"], v, (0, 0, pos, 0))
+            cache[li] = {"k": ck, "v": cv}
+            att = (q @ ck.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+            att = jnp.where(visible, att, -1e30)      # (B,H,1,max_seq)
+            att = jax.nn.softmax(att, axis=-1)
+            o = (att @ cv).transpose(0, 2, 1, 3).reshape(B, 1, cfg.dim)
         x = x + o @ blk["wo"]
         x = x + _ffn(blk, _rmsnorm(x, blk["ln2"]), mesh, cfg)
     x = _rmsnorm(x[:, 0], params["out_norm"])
@@ -128,19 +251,27 @@ def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None):
 
 
 def make_generate(cfg: TransformerConfig, mesh=None,
-                  temperature: float = 0.0):
+                  temperature: float = 0.0, context_parallel: bool = False):
     """Build ``generate(params, prompt (B, S), steps, [rng]) -> (B, S+steps)``
     — jitted prefill + ``lax.scan`` over decode_step. ``temperature`` 0 =
     greedy (deterministic); >0 = categorical sampling (pass ``rng``).
 
     ``steps`` is static (bakes the scan length). With ``mesh``, params keep
     their training PartitionSpecs and the cache shards per
-    :func:`cache_pspecs`; XLA inserts the tp all-reduces per step.
+    :func:`cache_pspecs`; XLA inserts the tp all-reduces per step. With
+    ``context_parallel`` the cache sequence axis additionally shards over
+    ``sp`` and attention runs via :func:`make_sp_cache_attention`.
     """
     import functools
 
     import jax
     import jax.numpy as jnp
+
+    sp_attn = None
+    if context_parallel:
+        if mesh is None:
+            raise ValueError("context_parallel decoding needs a mesh")
+        sp_attn = make_sp_cache_attention(cfg, mesh)
 
     def _constrain_cache(cache):
         if mesh is None:
@@ -149,7 +280,7 @@ def make_generate(cfg: TransformerConfig, mesh=None,
 
         shardings = [
             {k: NamedSharding(mesh, s) for k, s in layer.items()}
-            for layer in cache_pspecs(cfg)
+            for layer in cache_pspecs(cfg, context_parallel)
         ]
         return jax.tree_util.tree_map(
             jax.lax.with_sharding_constraint, cache, shardings)
@@ -161,7 +292,8 @@ def make_generate(cfg: TransformerConfig, mesh=None,
             raise ValueError(
                 f"prompt ({S}) + steps ({steps}) exceeds max_seq {cfg.max_seq}")
         cache = _constrain_cache(init_cache(cfg, B))
-        logits, cache, pos = prefill(cfg, params, prompt, cache, mesh)
+        logits, cache, pos = prefill(cfg, params, prompt, cache, mesh,
+                                     context_parallel=context_parallel)
         if rng is None:
             rng = jax.random.PRNGKey(0)
 
@@ -175,7 +307,8 @@ def make_generate(cfg: TransformerConfig, mesh=None,
 
         def body(carry, key):
             token, pos, cache = carry
-            logits, cache = decode_step(cfg, params, token, pos, cache, mesh)
+            logits, cache = decode_step(cfg, params, token, pos, cache, mesh,
+                                        sp_attn=sp_attn)
             cache = _constrain_cache(cache)
             nxt = pick(logits, key)
             return (nxt, pos + 1, cache), nxt

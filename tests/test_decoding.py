@@ -119,6 +119,41 @@ class TestShardedDecode:
         want = np.asarray(make_generate(CFG)(params, prompt, 5))
         np.testing.assert_array_equal(got, want)
 
+    def test_context_parallel_generate_matches_unsharded(self, params):
+        """sp-sharded KV cache (pmax/psum online-softmax combine) must pick
+        exactly the same greedy tokens as the plain cache."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from nnstreamer_tpu.models.transformer import param_pspecs
+        from nnstreamer_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(jax.devices()[:8], {"dp": 2, "tp": 2, "sp": 2})
+        shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), param_pspecs(CFG),
+            is_leaf=lambda x: isinstance(x, P))
+        sp = jax.device_put(params, shardings)
+        prompt = jnp.asarray(np.array([[1, 2, 3], [7, 6, 5]], np.int32))
+        prompt = jax.device_put(prompt, NamedSharding(mesh, P("dp", None)))
+        gen_cp = make_generate(CFG, mesh=mesh, context_parallel=True)
+        got = np.asarray(gen_cp(sp, prompt, 6))
+        want = np.asarray(make_generate(CFG)(params, prompt, 6))
+        np.testing.assert_array_equal(got, want)
+
+    def test_context_parallel_requires_mesh_and_divisibility(self):
+        import jax
+
+        from nnstreamer_tpu.parallel.mesh import make_mesh
+
+        with pytest.raises(ValueError, match="mesh"):
+            make_generate(CFG, context_parallel=True)
+        cfg_bad = TransformerConfig(vocab=8, dim=8, heads=2, layers=1,
+                                    max_seq=7)
+        mesh = make_mesh(jax.devices()[:4], {"dp": 1, "tp": 2, "sp": 2})
+        with pytest.raises(ValueError, match="divide"):
+            make_generate(cfg_bad, mesh=mesh, context_parallel=True)
+
     def test_moe_generate_on_mesh(self):
         import jax
         import jax.numpy as jnp
