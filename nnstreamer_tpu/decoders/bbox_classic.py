@@ -187,7 +187,9 @@ def parse_yolo(
     cls = a[:, num_info:]
     max_conf = cls.max(axis=1) if cls.size else np.zeros(len(a), np.float32)
     max_idx = cls.argmax(axis=1) if cls.size else np.zeros(len(a), np.int64)
-    prob = max_conf * a[:, 4] if num_info == 5 else max_conf
+    with np.errstate(invalid="ignore"):  # NaN rows (corrupt streams) score
+        prob = max_conf * a[:, 4] if num_info == 5 else max_conf
+    # NaN compares False against the threshold below -> row skipped
     out: List[DetObject] = []
     fw, fh = np.float32(i_w), np.float32(i_h)
     for d in np.nonzero(prob > thr)[0]:

@@ -1,0 +1,88 @@
+"""tensor_fault: deterministic fault injection for chaos testing (L3).
+
+The reference has no systematic fault-injection harness (SURVEY.md §5.3:
+negative-path unit tests only); this element goes beyond parity: a
+passthrough that — driven by a SEEDED rng, so every chaos run is exactly
+reproducible — drops, delays, duplicates, or corrupts buffers with
+configured probabilities. Used by tests/test_chaos.py to prove the
+pipeline's failure-handling properties (streams survive loss, ordered
+re-join declares gaps, decoders tolerate garbage bytes, QoS sheds load)
+under randomized adversity.
+
+Properties: ``drop-prob``, ``dup-prob``, ``corrupt-prob`` (flip a random
+byte span in a COPY of the tensor — upstream data is never mutated),
+``delay-ms`` (uniform 0..delay per affected buffer, ``delay-prob``
+gated), ``seed``. Counters ride on the element: ``.stats`` dict.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core import Buffer
+from ..core.caps import any_media_caps
+from ..registry.elements import register_element
+from ..runtime.element import Element, Prop
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+
+
+@register_element
+class TensorFault(Element):
+    ELEMENT_NAME = "tensor_fault"
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, any_media_caps()),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, any_media_caps()),)
+    PROPERTIES = {
+        "drop_prob": Prop(0.0, float, "probability a buffer is dropped"),
+        "dup_prob": Prop(0.0, float, "probability a buffer is sent twice"),
+        "corrupt_prob": Prop(0.0, float,
+                             "probability a buffer's bytes are corrupted "
+                             "(copy-on-write; shapes/dtypes preserved)"),
+        "delay_prob": Prop(0.0, float, "probability a buffer is delayed"),
+        "delay_ms": Prop(0.0, float, "max delay (uniform 0..delay-ms)"),
+        "seed": Prop(0, int, "rng seed — identical runs inject identical faults"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._rng = np.random.default_rng(self.props["seed"])
+        self.stats = {"passed": 0, "dropped": 0, "duplicated": 0,
+                      "corrupted": 0, "delayed": 0}
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._rng = np.random.default_rng(self.props["seed"])
+        self.stats = {k: 0 for k in self.stats}
+
+    def _corrupt(self, buf: Buffer) -> Buffer:
+        tensors = []
+        for t in buf.as_numpy().tensors:
+            a = np.array(t, copy=True)
+            flat = a.reshape(-1).view(np.uint8)
+            if flat.size:
+                span = max(1, flat.size // 16)
+                start = int(self._rng.integers(0, max(flat.size - span, 1)))
+                flat[start:start + span] = self._rng.integers(
+                    0, 256, min(span, flat.size - start), dtype=np.uint8)
+            tensors.append(a)
+        out = Buffer(tensors).copy_metadata_from(buf)
+        return out
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        r = self._rng.random(4)
+        if r[0] < self.props["drop_prob"]:
+            self.stats["dropped"] += 1
+            return
+        if r[1] < self.props["delay_prob"] and self.props["delay_ms"] > 0:
+            self.stats["delayed"] += 1
+            time.sleep(float(self._rng.random()) * self.props["delay_ms"] / 1e3)
+        if r[2] < self.props["corrupt_prob"]:
+            self.stats["corrupted"] += 1
+            buf = self._corrupt(buf)
+        self.stats["passed"] += 1
+        self.push(buf)
+        if r[3] < self.props["dup_prob"]:
+            self.stats["duplicated"] += 1
+            # a fresh Buffer object: downstream elements that stamp buffers
+            # in place (tensor_shard seq/offset) must not alias the first
+            self.push(Buffer(list(buf.tensors)).copy_metadata_from(buf))

@@ -1,0 +1,129 @@
+"""Chaos suite: pipeline failure properties under seeded fault injection.
+
+Goes beyond the reference (SURVEY.md §5.3: negative-path unit tests, no
+systematic chaos harness). tensor_fault injects drops/dups/corruption/
+delay deterministically; these tests pin down the INVARIANTS the runtime
+promises under adversity, and the seeds make every failure reproducible.
+"""
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+def run_all(launch, sink="out", timeout=30.0):
+    pipe = parse_launch(launch)
+    got = []
+    pipe.get(sink).connect(got.append)
+    pipe.run(timeout=timeout)
+    return pipe, got
+
+
+class TestStreamSurvivesLoss:
+    def test_drops_thin_the_stream_but_never_stall_it(self):
+        pipe, got = run_all(
+            "tensor_src num-buffers=200 dimensions=4 types=float32 pattern=counter "
+            "! tensor_fault name=f drop-prob=0.3 seed=7 "
+            "! tensor_sink name=out max-stored=256")
+        f = pipe.get("f").stats
+        assert f["dropped"] > 0 and f["passed"] == len(got)
+        assert f["dropped"] + f["passed"] == 200
+        # survivors arrive in order (counter pattern is monotonic)
+        vals = [float(np.asarray(b.tensors[0])[0]) for b in got]
+        assert vals == sorted(vals)
+
+    def test_filter_stage_processes_surviving_frames(self):
+        pipe, got = run_all(
+            "tensor_src num-buffers=60 dimensions=4 types=float32 pattern=ones "
+            "! tensor_fault name=f drop-prob=0.4 seed=3 "
+            "! tensor_filter framework=jax model=builtin://scaler custom=factor:2 "
+            "! tensor_sink name=out max-stored=64")
+        assert len(got) == pipe.get("f").stats["passed"]
+        for b in got:
+            np.testing.assert_allclose(np.asarray(b.tensors[0]), 2.0)
+
+
+class TestCorruptionTolerance:
+    def test_classic_bbox_decoder_survives_garbage_bytes(self):
+        """Corrupted float tensors must yield garbage boxes, never a
+        crashed pipeline — the decode path is total on its input domain."""
+        pipe, got = run_all(
+            "tensor_src num-buffers=30 dimensions=85:100 types=float32 "
+            "pattern=random "
+            "! tensor_fault corrupt-prob=1.0 seed=11 "
+            "! tensor_decoder mode=bounding_boxes option1=yolov5 "
+            "option2=64:64 option8=64:64 option10=classic "
+            "! tensor_sink name=out max-stored=64")
+        assert len(got) == 30  # every frame decoded, none crashed
+        for b in got:
+            assert np.asarray(b.tensors[0]).shape == (64, 64, 4)
+
+    def test_corruption_never_mutates_upstream_copy(self):
+        from nnstreamer_tpu.core import Buffer
+        from nnstreamer_tpu.elements.fault import TensorFault
+
+        f = TensorFault(corrupt_prob=1.0, seed=5)
+        src = np.zeros(64, np.float32)
+        captured = []
+        f.src_pads[0].push = captured.append  # type: ignore[assignment]
+        f.chain(f.sink_pads[0], Buffer([src]))
+        assert captured and not np.array_equal(
+            np.asarray(captured[0].tensors[0]), src)
+        assert not src.any()  # upstream array untouched
+
+
+class TestDuplicatesAndReorder:
+    def test_unshard_declares_gaps_under_branch_loss(self):
+        """One shard branch drops frames: the ordered re-join must declare
+        ONLY the truly-lost sequence numbers and deliver every surviving
+        frame in order instead of stalling. (max-buffered is the bounded
+        reorder window: sized >= the stream here so thread-racing between
+        branches can't force premature loss declarations — the small-window
+        tradeoff is covered by the latency-skew test in test_shard.py.)"""
+        pipe, got = run_all(
+            "tensor_src num-buffers=40 dimensions=1 types=float32 pattern=counter "
+            "! tensor_shard name=s "
+            "s.src_0 ! queue ! tensor_fault drop-prob=0.5 seed=13 ! u.sink_0 "
+            "s.src_1 ! queue ! u.sink_1 "
+            "tensor_unshard name=u max-buffered=64 ! tensor_sink name=out max-stored=64")
+        # all of branch 1's 20 frames must come through; branch 0 thinned
+        vals = [float(np.asarray(b.tensors[0])[0]) for b in got]
+        odd = [v for v in vals if int(v) % 2 == 1]
+        assert len(odd) == 20
+        assert vals == sorted(vals)  # re-join order preserved
+
+    def test_duplicates_pass_through_queues_without_reorder(self):
+        pipe, got = run_all(
+            "tensor_src num-buffers=50 dimensions=1 types=float32 pattern=counter "
+            "! tensor_fault name=f dup-prob=0.3 seed=17 "
+            "! queue max-size-buffers=4 ! tensor_sink name=out max-stored=128")
+        f = pipe.get("f").stats
+        assert len(got) == 50 + f["duplicated"]
+        vals = [float(np.asarray(b.tensors[0])[0]) for b in got]
+        assert vals == sorted(vals)  # dups are adjacent, order monotone
+
+
+class TestDelayBackpressure:
+    def test_leaky_queue_sheds_under_injected_latency(self):
+        pipe, got = run_all(
+            "tensor_src num-buffers=60 dimensions=2 types=float32 pattern=counter "
+            "! queue max-size-buffers=2 leaky=downstream name=q "
+            "! tensor_fault delay-prob=1.0 delay-ms=5 seed=23 "
+            "! tensor_sink name=out max-stored=128",
+            timeout=60.0)
+        # slow consumer + leaky queue: some frames shed, stream completes,
+        # survivors stay ordered
+        assert 0 < len(got) <= 60
+        vals = [float(np.asarray(b.tensors[0])[0]) for b in got]
+        assert vals == sorted(vals)
+
+    def test_determinism_same_seed_same_faults(self):
+        outs = []
+        for _ in range(2):
+            pipe, got = run_all(
+                "tensor_src num-buffers=80 dimensions=2 types=float32 "
+                "pattern=counter "
+                "! tensor_fault drop-prob=0.25 dup-prob=0.1 seed=42 "
+                "! tensor_sink name=out max-stored=128")
+            outs.append([float(np.asarray(b.tensors[0])[0]) for b in got])
+        assert outs[0] == outs[1]
