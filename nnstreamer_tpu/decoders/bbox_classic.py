@@ -55,8 +55,11 @@ class DetObject:
 
 
 def _trunc(a: np.ndarray) -> np.ndarray:
-    """C ``(int)`` cast: truncate toward zero."""
-    return np.asarray(a, np.float32).astype(np.int32)
+    """C ``(int)`` cast: truncate toward zero. NaN/inf from corrupted
+    streams cast to INT32_MIN garbage without warnings/raises — the
+    decode path stays total (chaos-tested); garbage boxes draw nothing."""
+    with np.errstate(invalid="ignore"):
+        return np.asarray(a, np.float32).astype(np.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -185,25 +188,27 @@ def parse_yolo(
     a = np.asarray(a, np.float32).reshape(-1, a.shape[-1])
     thr = np.float32(conf_threshold)
     cls = a[:, num_info:]
-    max_conf = cls.max(axis=1) if cls.size else np.zeros(len(a), np.float32)
-    max_idx = cls.argmax(axis=1) if cls.size else np.zeros(len(a), np.int64)
-    with np.errstate(invalid="ignore"):  # NaN rows (corrupt streams) score
+    # corrupted streams carry NaN/inf: NaN probs compare False against the
+    # threshold (row skipped); inf coordinates truncate to garbage boxes
+    # that draw nothing — either way the decode stays total (chaos-tested)
+    with np.errstate(invalid="ignore", over="ignore"):
+        max_conf = cls.max(axis=1) if cls.size else np.zeros(len(a), np.float32)
+        max_idx = cls.argmax(axis=1) if cls.size else np.zeros(len(a), np.int64)
         prob = max_conf * a[:, 4] if num_info == 5 else max_conf
-    # NaN compares False against the threshold below -> row skipped
-    out: List[DetObject] = []
-    fw, fh = np.float32(i_w), np.float32(i_h)
-    for d in np.nonzero(prob > thr)[0]:
-        cx, cy, w, h = a[d, 0], a[d, 1], a[d, 2], a[d, 3]
-        if not scaled_output:
-            cx, cy, w, h = cx * fw, cy * fh, w * fw, h * fh
-        out.append(DetObject(
-            class_id=int(max_idx[d]),
-            x=int(_trunc(max(np.float32(0), cx - w / np.float32(2)))),
-            y=int(_trunc(max(np.float32(0), cy - h / np.float32(2)))),
-            width=int(_trunc(min(fw, w))),
-            height=int(_trunc(min(fh, h))),
-            prob=float(prob[d]),
-        ))
+        out: List[DetObject] = []
+        fw, fh = np.float32(i_w), np.float32(i_h)
+        for d in np.nonzero(prob > thr)[0]:
+            cx, cy, w, h = a[d, 0], a[d, 1], a[d, 2], a[d, 3]
+            if not scaled_output:
+                cx, cy, w, h = cx * fw, cy * fh, w * fw, h * fh
+            out.append(DetObject(
+                class_id=int(max_idx[d]),
+                x=int(_trunc(max(np.float32(0), cx - w / np.float32(2)))),
+                y=int(_trunc(max(np.float32(0), cy - h / np.float32(2)))),
+                width=int(_trunc(min(fw, w))),
+                height=int(_trunc(min(fh, h))),
+                prob=float(prob[d]),
+            ))
     return out
 
 
