@@ -298,9 +298,12 @@ class JaxBackend(FilterBackend):
                 # on the WRONG chip (upstream pinned stage) onto ours —
                 # device-to-device (ICI on TPU), never through host. Sharded
                 # multi-device arrays pass through untouched (pjit stages).
+                # A fully-automatic backend makes no move either: host inputs
+                # follow jax's configured default, and forcing devices[0]
+                # here could split the call across two devices.
                 devs = x.devices()
-                if (self._device is not None and len(devs) == 1
-                        and devs != {self._device}):
+                if (self._device is not None and not self._device_is_default
+                        and len(devs) == 1 and devs != {self._device}):
                     x = jax.device_put(x, self._device)
             elif self._device is not None and not self._device_is_default:
                 # pinned stage: stage the host array onto our chip explicitly

@@ -415,6 +415,30 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
                 env[outs[0]] = jax.nn.sigmoid(_in(env, ins[0]))
             elif code == "TANH":
                 env[outs[0]] = jnp.tanh(_in(env, ins[0]))
+            elif code in ("MAXIMUM", "MINIMUM"):
+                env[outs[0]] = (jnp.maximum if code == "MAXIMUM" else jnp.minimum)(
+                    _in(env, ins[0]), _in(env, ins[1]))
+            elif code == "SHAPE":
+                # static under XLA: emit a CONCRETE numpy constant so the
+                # shape-manipulation ops below stay compile-time
+                env[outs[0]] = np.asarray(_in(env, ins[0]).shape, np.int32)
+            elif code == "BROADCAST_ARGS":
+                # shape operands may be prior SHAPE outputs (in env) or
+                # stored flatbuffer constants
+                a = env[ins[0]] if ins[0] in env else np.asarray(_const(ins[0]))
+                b = env[ins[1]] if ins[1] in env else np.asarray(_const(ins[1]))
+                if not (isinstance(a, np.ndarray) and isinstance(b, np.ndarray)):
+                    raise NotImplementedError(
+                        "tflite import: BROADCAST_ARGS with traced shapes")
+                env[outs[0]] = np.asarray(
+                    np.broadcast_shapes(tuple(a), tuple(b)), np.int32)
+            elif code == "BROADCAST_TO":
+                shp = env[ins[1]] if ins[1] in env else np.asarray(_const(ins[1]))
+                shape = np.asarray(shp).reshape(-1).tolist()
+                env[outs[0]] = jnp.broadcast_to(_in(env, ins[0]), shape)
+            elif code == "TRANSPOSE":
+                perm = np.asarray(_const(ins[1])).reshape(-1).tolist()
+                env[outs[0]] = jnp.transpose(_in(env, ins[0]), perm)
             elif code in ("DEQUANTIZE", "QUANTIZE"):
                 t = tensors[ins[0]]
                 x = _in(env, ins[0])
