@@ -13,6 +13,12 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
+# __graft_entry__.entry() probes the default platform in a bounded
+# subprocess (deliberately ignoring JAX_PLATFORMS to mirror the driver's
+# bare environment) — inside the test suite that's minutes of wasted
+# axon-tunnel timeout; the in-process cpu config below already decides
+# the platform, so skip the probe.
+os.environ["NNS_ENTRY_NO_PROBE"] = "1"
 
 import jax
 
