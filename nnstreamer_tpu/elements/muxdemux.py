@@ -33,8 +33,11 @@ class TensorMux(Element):
       * ``slowest`` (default) / ``nosync``: one frame from every pad per
         output (queue-per-pad, pop one each — the pipeline advances at the
         slowest producer);
-      * ``basepad``: emit on every frame of pad 0, combining the most recent
-        frame from the other pads;
+      * ``basepad``: emit on every frame of the base pad (``sync-option``
+        selects which, reference ``sink_id[:duration]``; default 0),
+        combining the most recent frame from the other pads — frames are
+        skipped when a companion's latest lags the base by more than the
+        optional max pts gap;
       * ``refresh``: emit whenever *any* pad receives, reusing the last frame
         from the others.
     """
@@ -47,6 +50,11 @@ class TensorMux(Element):
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
     PROPERTIES = {
         "sync_mode": Prop("slowest", str, "slowest | nosync | basepad | refresh"),
+        # reference sync-option for basepad: "sink_id[:duration]" — which
+        # pad drives emission, and (our redesign of the GstCollectPads
+        # base_time window) the max pts distance in SECONDS another pad's
+        # latest frame may lag before the output frame is skipped
+        "sync_option": Prop(None, str, "basepad: base sink index[:max pts gap s]"),
     }
 
     def __init__(self, name=None, **props):
@@ -62,6 +70,26 @@ class TensorMux(Element):
             specs.extend(info.specs)
         return caps_from_tensors_info(TensorsInfo.of(*specs))
 
+    def _basepad_option(self):
+        """Parsed-once (base_idx, max_gap) from sync-option; malformed
+        values fail at first use with one clear error, not per-buffer."""
+        cached = getattr(self, "_basepad_opt_cache", None)
+        if cached is not None:
+            return cached
+        base_idx, max_gap = 0, None
+        opt = self.props["sync_option"]
+        if opt:
+            try:
+                parts_opt = str(opt).split(":", 1)
+                base_idx = int(parts_opt[0]) if parts_opt[0] else 0
+                if len(parts_opt) > 1 and parts_opt[1]:
+                    max_gap = float(parts_opt[1])
+            except ValueError:
+                raise ValueError(
+                    f"sync-option '{opt}' is not 'sink_id[:max_gap_s]'")
+        self._basepad_opt_cache = (base_idx, max_gap)
+        return self._basepad_opt_cache
+
     def chain(self, pad: Pad, buf: Buffer) -> None:
         mode = self.props["sync_mode"]
         with self._mux_lock:
@@ -73,11 +101,21 @@ class TensorMux(Element):
                     return
                 parts = [self._queues[p.name].pop(0) for p in self.sink_pads if p.is_linked]
             elif mode == "basepad":
-                if pad is not self.sink_pads[0]:
+                base_idx, max_gap = self._basepad_option()
+                linked = [p for p in self.sink_pads if p.is_linked]
+                if not 0 <= base_idx < len(linked):
+                    raise ValueError(
+                        f"sync-option base index {base_idx} out of range "
+                        f"({len(linked)} linked pads)")
+                if pad is not linked[base_idx]:
                     return
-                parts = [self._latest.get(p.name) for p in self.sink_pads if p.is_linked]
+                parts = [self._latest.get(p.name) for p in linked]
                 if any(p is None for p in parts):
                     return
+                if max_gap is not None and buf.pts is not None:
+                    for part in parts:
+                        if part.pts is not None and abs(part.pts - buf.pts) > max_gap:
+                            return  # stale companion: skip this output frame
             else:  # refresh
                 parts = [self._latest.get(p.name) for p in self.sink_pads if p.is_linked]
                 if any(p is None for p in parts):

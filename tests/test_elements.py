@@ -574,3 +574,64 @@ class TestFileSources:
         with pytest.raises(ElementError, match="exactly one"):
             parse_launch("multifilesrc location=/tmp/f_%d_%d.raw stop-index=1 "
                          "! tensor_sink name=out")
+
+
+class TestMuxBasepadOption:
+    """sync-option for basepad (reference 'sink_id[:duration]'): selectable
+    base pad + max pts gap window."""
+
+    def _pipe(self, opt=""):
+        from nnstreamer_tpu.core import Buffer
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        pipe = parse_launch(
+            f"tensor_mux name=mux sync-mode=basepad {opt} "
+            "! tensor_sink name=out max-stored=32 "
+            "appsrc name=a caps=other/tensors,format=static,dimensions=1,types=float32 ! mux.sink_0 "
+            "appsrc name=b caps=other/tensors,format=static,dimensions=1,types=float32 ! mux.sink_1 ")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        return pipe, got, Buffer
+
+    @staticmethod
+    def _settle(predicate, timeout=5.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while not predicate() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert predicate()
+
+    def test_base_pad_selectable(self):
+        import numpy as np
+
+        pipe, got, Buffer = self._pipe("sync-option=1")
+        a, b = pipe.get("a"), pipe.get("b")
+        mux = pipe.get("mux")
+        a.push_buffer(Buffer([np.array([0.0], np.float32)], pts=0.0))
+        self._settle(lambda: "sink_0" in mux._latest)  # companion seen first
+        b.push_buffer(Buffer([np.array([10.0], np.float32)], pts=0.0))
+        self._settle(lambda: len(got) == 1)
+        b.push_buffer(Buffer([np.array([11.0], np.float32)], pts=0.1))
+        a.end_of_stream(); b.end_of_stream()
+        pipe.wait(timeout=10); pipe.stop()
+        # pad 1 drives: two frames out, both carrying pad0's latest (0.0)
+        assert len(got) == 2
+        assert [float(np.asarray(x.tensors[1])[0]) for x in got] == [10.0, 11.0]
+        assert all(float(np.asarray(x.tensors[0])[0]) == 0.0 for x in got)
+
+    def test_max_gap_skips_stale_companion(self):
+        import numpy as np
+
+        pipe, got, Buffer = self._pipe("sync-option=0:0.5")
+        a, b = pipe.get("a"), pipe.get("b")
+        mux = pipe.get("mux")
+        b.push_buffer(Buffer([np.array([1.0], np.float32)], pts=0.0))
+        self._settle(lambda: "sink_1" in mux._latest)
+        a.push_buffer(Buffer([np.array([0.0], np.float32)], pts=0.1))   # gap .1 ok
+        self._settle(lambda: len(got) == 1)
+        a.push_buffer(Buffer([np.array([2.0], np.float32)], pts=5.0))   # gap 5 stale
+        a.end_of_stream(); b.end_of_stream()
+        pipe.wait(timeout=10); pipe.stop()
+        assert len(got) == 1  # second base frame skipped (companion stale)
