@@ -20,6 +20,7 @@ from ..core import (
     TensorFormat,
     TensorsInfo,
     caps_from_tensors_info,
+    clock_now,
 )
 from ..core.caps import (
     AUDIO_MIME,
@@ -32,7 +33,7 @@ from ..core.caps import (
 from ..core.tensors import TensorSpec
 from ..registry.elements import register_element
 from ..registry.subplugin import SubpluginKind, get as get_subplugin
-from ..runtime.element import ElementError, Prop, TransformElement
+from ..runtime.element import ElementError, Prop, TransformElement, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 
 from ..core.caps import FLATBUF_MIME, PROTOBUF_MIME
@@ -62,6 +63,9 @@ class TensorConverter(TransformElement):
         "input_dim": Prop(None, str, "dim string for octet/text input"),
         "input_type": Prop("uint8", str, "dtype for octet/text input"),
         "subplugin": Prop(None, str, "external converter subplugin name"),
+        "set_timestamp": Prop(True, prop_bool,
+                              "stamp untimestamped media with running time "
+                              "(reference set-timestamp)"),
         "subplugin_option": Prop(None, str,
                                  "option string handed to the subplugin "
                                  "(e.g. python3 converter .py file)"),
@@ -74,6 +78,7 @@ class TensorConverter(TransformElement):
         self._pending: List[Buffer] = []
         self._frame_spec: Optional[TensorSpec] = None
         self._ext = None  # external converter subplugin instance
+        self._t0: Optional[float] = None  # set-timestamp epoch
 
     # -- negotiation --------------------------------------------------------
     def set_caps(self, pad: Pad, caps: Caps) -> None:
@@ -127,6 +132,19 @@ class TensorConverter(TransformElement):
 
     # -- chain --------------------------------------------------------------
     def transform(self, buf: Buffer) -> Optional[Buffer]:
+        out = self._transform_inner(buf)
+        if (out is not None and out.pts is None
+                and self.props["set_timestamp"]):
+            # reference set-timestamp: stamp untimestamped media with the
+            # running clock so downstream sync policies have a pts. Stamped
+            # on the OUTPUT buffer — the input may be tee-shared and must
+            # not be mutated.
+            if self._t0 is None:
+                self._t0 = clock_now()
+            out.pts = clock_now() - self._t0
+        return out
+
+    def _transform_inner(self, buf: Buffer) -> Optional[Buffer]:
         if self._mode == "external":
             return self._ext.convert(buf)
         arrays = [self._to_array(t) for t in buf.as_numpy().tensors]
@@ -167,6 +185,7 @@ class TensorConverter(TransformElement):
     def reset_flow(self) -> None:
         super().reset_flow()
         self._pending = []
+        self._t0 = None
 
     def handle_eos(self) -> None:
         # flush partial chunk (reference drops it; we also drop — a partial

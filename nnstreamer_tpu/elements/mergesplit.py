@@ -42,13 +42,23 @@ class TensorMerge(Element):
     PROPERTIES = {
         "mode": Prop("linear", str, "only 'linear' (axis concat) exists"),
         "option": Prop(0, int, "concat axis"),
-        "sync_mode": Prop("slowest", str, "slowest | nosync"),
+        "sync_mode": Prop("slowest", str,
+                          "slowest | nosync | basepad | refresh (reference "
+                          "sync policies, tensor_mux semantics)"),
+        "sync_option": Prop(None, str, "basepad: base sink index[:max pts gap s]"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._queues: Dict[str, List[Buffer]] = {}
+        self._latest: Dict[str, Buffer] = {}
         self._merge_lock = threading.Lock()
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        with self._merge_lock:
+            self._queues.clear()
+            self._latest.clear()
 
     def transform_caps(self, src_pad: Pad) -> Caps:
         axis = self.props["option"]
@@ -64,12 +74,12 @@ class TensorMerge(Element):
         )
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
+        from .muxdemux import collect_sync
+
         with self._merge_lock:
-            self._queues.setdefault(pad.name, []).append(buf)
-            linked = [p for p in self.sink_pads if p.is_linked]
-            if not all(self._queues.get(p.name) for p in linked):
+            parts = collect_sync(self, pad, buf)
+            if parts is None:
                 return
-            parts = [self._queues[p.name].pop(0) for p in linked]
         axis = self.props["option"]
         merged = np.concatenate([np.asarray(p.tensors[0]) for p in parts], axis=axis)
         out = Buffer([merged]).copy_metadata_from(parts[0])
@@ -94,7 +104,31 @@ class TensorSplit(Element):
     PROPERTIES = {
         "axis": Prop(0, int, "split axis"),
         "tensorseg": Prop(None, str, "chunk sizes along axis, ','-separated"),
+        # reference tensorpick: emit only the chosen segment indices, in
+        # order, one per linked src pad
+        "tensorpick": Prop(None, str, "segment indices to emit (default all)"),
     }
+
+    def _picked(self, nsegs: int) -> List[int]:
+        v = self.props["tensorpick"]
+        if not v:
+            return list(range(nsegs))
+        if not self.props["tensorseg"]:
+            raise ElementError(
+                f"{self.describe()}: tensorpick needs tensorseg to define "
+                "the segments being picked")
+        picks = [int(p) for p in str(v).split(",") if p.strip()]
+        for p in picks:
+            if not 0 <= p < nsegs:
+                raise ElementError(
+                    f"{self.describe()}: tensorpick {p} out of range "
+                    f"({nsegs} segments)")
+        linked = len(self._linked_pads())
+        if linked and len(picks) != linked:
+            raise ElementError(
+                f"{self.describe()}: tensorpick selects {len(picks)} "
+                f"segments but {linked} src pads are linked")
+        return picks
 
     def _segments(self, total: int) -> List[int]:
         v = self.props["tensorseg"]
@@ -119,8 +153,9 @@ class TensorSplit(Element):
         axis = self.props["axis"]
         segs = self._segments(spec.shape[axis])
         idx = self._linked_pads().index(src_pad)
+        seg_idx = self._picked(len(segs))[idx]
         shape = list(spec.shape)
-        shape[axis] = segs[idx]
+        shape[axis] = segs[seg_idx]
         return caps_from_tensors_info(
             TensorsInfo.of(TensorSpec(tuple(shape), spec.dtype))
         )
@@ -129,9 +164,9 @@ class TensorSplit(Element):
         axis = self.props["axis"]
         a = np.asarray(buf.tensors[0])
         segs = self._segments(a.shape[axis])
-        offset = 0
-        for seg, src in zip(segs, self._linked_pads()):
+        offsets = [sum(segs[:i]) for i in range(len(segs))]
+        picked = self._picked(len(segs))
+        for seg_idx, src in zip(picked, self._linked_pads()):
             sl = [slice(None)] * a.ndim
-            sl[axis] = slice(offset, offset + seg)
-            offset += seg
+            sl[axis] = slice(offsets[seg_idx], offsets[seg_idx] + segs[seg_idx])
             src.push(Buffer([a[tuple(sl)]]).copy_metadata_from(buf))

@@ -63,6 +63,12 @@ class TensorMux(Element):
         self._latest: Dict[str, Buffer] = {}
         self._mux_lock = threading.Lock()
 
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        with self._mux_lock:
+            self._queues.clear()
+            self._latest.clear()
+
     def transform_caps(self, src_pad: Pad) -> Caps:
         specs = []
         for pad in self.sink_pads:
@@ -70,61 +76,73 @@ class TensorMux(Element):
             specs.extend(info.specs)
         return caps_from_tensors_info(TensorsInfo.of(*specs))
 
-    def _basepad_option(self):
-        """Parsed-once (base_idx, max_gap) from sync-option; malformed
-        values fail at first use with one clear error, not per-buffer."""
-        cached = getattr(self, "_basepad_opt_cache", None)
-        if cached is not None:
-            return cached
-        base_idx, max_gap = 0, None
-        opt = self.props["sync_option"]
-        if opt:
-            try:
-                parts_opt = str(opt).split(":", 1)
-                base_idx = int(parts_opt[0]) if parts_opt[0] else 0
-                if len(parts_opt) > 1 and parts_opt[1]:
-                    max_gap = float(parts_opt[1])
-            except ValueError:
-                raise ValueError(
-                    f"sync-option '{opt}' is not 'sink_id[:max_gap_s]'")
-        self._basepad_opt_cache = (base_idx, max_gap)
-        return self._basepad_opt_cache
-
     def chain(self, pad: Pad, buf: Buffer) -> None:
-        mode = self.props["sync_mode"]
         with self._mux_lock:
-            self._latest[pad.name] = buf
-            if mode in ("slowest", "nosync"):
-                self._queues.setdefault(pad.name, []).append(buf)
-                ready = all(self._queues.get(p.name) for p in self.sink_pads if p.is_linked)
-                if not ready:
-                    return
-                parts = [self._queues[p.name].pop(0) for p in self.sink_pads if p.is_linked]
-            elif mode == "basepad":
-                base_idx, max_gap = self._basepad_option()
-                linked = [p for p in self.sink_pads if p.is_linked]
-                if not 0 <= base_idx < len(linked):
-                    raise ValueError(
-                        f"sync-option base index {base_idx} out of range "
-                        f"({len(linked)} linked pads)")
-                if pad is not linked[base_idx]:
-                    return
-                parts = [self._latest.get(p.name) for p in linked]
-                if any(p is None for p in parts):
-                    return
-                if max_gap is not None and buf.pts is not None:
-                    for part in parts:
-                        if part.pts is not None and abs(part.pts - buf.pts) > max_gap:
-                            return  # stale companion: skip this output frame
-            else:  # refresh
-                parts = [self._latest.get(p.name) for p in self.sink_pads if p.is_linked]
-                if any(p is None for p in parts):
-                    return
+            parts = collect_sync(self, pad, buf)
+            if parts is None:
+                return
         tensors = [t for part in parts for t in part.tensors]
         out = Buffer(tensors).copy_metadata_from(parts[0])
         # timestamp = latest of the combined frames (reference collects pts)
         out.pts = max((p.pts for p in parts if p.pts is not None), default=None)
         self.push(out)
+
+
+def _basepad_option(el) -> tuple:
+    """Parsed-once (base_idx, max_gap) from sync-option; malformed values
+    fail at first use with one clear error, not per-buffer."""
+    cached = getattr(el, "_basepad_opt_cache", None)
+    if cached is not None:
+        return cached
+    base_idx, max_gap = 0, None
+    opt = el.props["sync_option"]
+    if opt:
+        try:
+            parts_opt = str(opt).split(":", 1)
+            base_idx = int(parts_opt[0]) if parts_opt[0] else 0
+            if len(parts_opt) > 1 and parts_opt[1]:
+                max_gap = float(parts_opt[1])
+        except ValueError:
+            raise ValueError(
+                f"sync-option '{opt}' is not 'sink_id[:max_gap_s]'")
+    el._basepad_opt_cache = (base_idx, max_gap)
+    return el._basepad_opt_cache
+
+
+def collect_sync(el, pad: Pad, buf: Buffer):
+    """Shared N-pad synchronization (reference sync policies, used by
+    tensor_mux AND tensor_merge): returns the per-pad buffer list to
+    combine, or None when this arrival doesn't complete a frame. Caller
+    holds the element's lock. Needs ``el._queues``/``el._latest`` dicts
+    and the sync_mode/sync_option props."""
+    mode = el.props["sync_mode"]
+    el._latest[pad.name] = buf
+    linked = [p for p in el.sink_pads if p.is_linked]
+    if mode in ("slowest", "nosync"):
+        el._queues.setdefault(pad.name, []).append(buf)
+        if not all(el._queues.get(p.name) for p in linked):
+            return None
+        return [el._queues[p.name].pop(0) for p in linked]
+    if mode == "basepad":
+        base_idx, max_gap = _basepad_option(el)
+        if not 0 <= base_idx < len(linked):
+            raise ValueError(
+                f"sync-option base index {base_idx} out of range "
+                f"({len(linked)} linked pads)")
+        if pad is not linked[base_idx]:
+            return None
+        parts = [el._latest.get(p.name) for p in linked]
+        if any(p is None for p in parts):
+            return None
+        if max_gap is not None and buf.pts is not None:
+            for part in parts:
+                if part.pts is not None and abs(part.pts - buf.pts) > max_gap:
+                    return None  # stale companion: skip this output frame
+        return parts
+    if mode == "refresh":
+        parts = [el._latest.get(p.name) for p in linked]
+        return None if any(p is None for p in parts) else parts
+    raise ValueError(f"unknown sync-mode '{mode}'")
 
 
 @register_element

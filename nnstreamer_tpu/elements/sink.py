@@ -39,6 +39,11 @@ class TensorSink(SinkElement):
     PROPERTIES = {
         "sync": Prop(False, prop_bool, "honor buffer pts against the clock (unused yet)"),
         "max_stored": Prop(256, int, "keep last N buffers for pull() (0 = unbounded)"),
+        # reference props: emit-signal gates callbacks entirely;
+        # signal-rate > 0 emits at most that many callbacks per second
+        # of buffer pts (frames in between are stored but not signalled)
+        "emit_signal": Prop(True, prop_bool, "invoke new-data callbacks"),
+        "signal_rate": Prop(0, int, "max callback emissions per second (0 = every buffer)"),
     }
 
     def __init__(self, name=None, **props):
@@ -52,11 +57,30 @@ class TensorSink(SinkElement):
         """Register a new-data callback (``g_signal_connect`` analog)."""
         self._callbacks.append(callback)
 
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        # replayed pipelines restart pts at 0: a stale signal-rate epoch
+        # would suppress every callback until pts passed the old run's
+        if hasattr(self, "_last_signal_pts"):
+            del self._last_signal_pts
+
     def render(self, buf: Buffer) -> None:
         with self._lock:
             self._count += 1
-        for cb in self._callbacks:
-            cb(buf)
+        emit = self.props["emit_signal"]
+        rate = self.props["signal_rate"]
+        if emit and rate > 0:
+            # reference gst_tensor_sink_render: emit when at least 1/rate
+            # of stream time passed since the last signalled buffer
+            now = buf.pts if buf.pts is not None else None
+            last = getattr(self, "_last_signal_pts", None)
+            if now is not None and last is not None and (now - last) < 1.0 / rate:
+                emit = False
+            elif now is not None:
+                self._last_signal_pts = now
+        if emit:
+            for cb in self._callbacks:
+                cb(buf)
         maxn = self.props["max_stored"]
         if maxn > 0:
             while self._q.qsize() >= maxn:

@@ -22,7 +22,7 @@ from ..core import (
 from ..core.tensors import TensorSpec
 from ..ops.transform_ops import parse_transform_options
 from ..registry.elements import register_element
-from ..runtime.element import ElementError, Prop, TransformElement
+from ..runtime.element import ElementError, Prop, TransformElement, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 
 
@@ -34,6 +34,13 @@ class TensorTransform(TransformElement):
     PROPERTIES = {
         "mode": Prop(None, str, "dimchg|typecast|arithmetic|transpose|stand|clamp|padding"),
         "option": Prop("", str, "mode-specific option string"),
+        # reference `apply`: comma-separated tensor indices the transform
+        # applies to (others pass through untouched); default all
+        "apply": Prop(None, str, "tensor indices to apply to (default all)"),
+        # reference `acceleration` toggles ORC SIMD; here XLA fusion is
+        # always on — accepted for launch-line compatibility, ignored
+        "acceleration": Prop(True, prop_bool,
+                             "accepted for reference compat (XLA always on)"),
     }
 
     def __init__(self, name=None, **props):
@@ -43,14 +50,29 @@ class TensorTransform(TransformElement):
         self._fn: Callable = parse_transform_options(
             self.props["mode"], self.props["option"]
         )
+        apply_s = self.props["apply"]
+        self._apply = (None if not apply_s else
+                       {int(v) for v in str(apply_s).split(",") if v.strip()})
         self._jit = None
         self._out_info: Optional[TensorsInfo] = None
+
+    def _applies(self, i: int) -> bool:
+        return self._apply is None or i in self._apply
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
         import jax
 
         in_info = tensors_info_from_caps(caps)
-        self._jit = jax.jit(lambda *xs: tuple(self._fn(x) for x in xs))
+        if (self._apply and in_info.format is TensorFormat.STATIC
+                and in_info.specs):
+            bad = [i for i in self._apply if not 0 <= i < len(in_info.specs)]
+            if bad:
+                raise ElementError(
+                    f"{self.describe()}: apply={sorted(bad)} out of range "
+                    f"for a {len(in_info.specs)}-tensor stream")
+        self._jit = jax.jit(lambda *xs: tuple(
+            self._fn(x) if self._applies(i) else x
+            for i, x in enumerate(xs)))
         if in_info.format is TensorFormat.STATIC and in_info.specs:
             outs = jax.eval_shape(
                 self._jit,

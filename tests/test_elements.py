@@ -635,3 +635,93 @@ class TestMuxBasepadOption:
         a.end_of_stream(); b.end_of_stream()
         pipe.wait(timeout=10); pipe.stop()
         assert len(got) == 1  # second base frame skipped (companion stale)
+
+
+class TestReferencePropParity:
+    """Props from the reference's per-element tables added in round 2:
+    transform apply, sink emit-signal/signal-rate, split tensorpick,
+    merge sync-mode breadth, converter set-timestamp."""
+
+    def test_transform_apply_selected_tensors(self):
+        got = run_collect(
+            "tensor_src num-buffers=1 dimensions=2.2 types=float32 pattern=ones "
+            "! tensor_transform mode=arithmetic option=mul:3 apply=1 "
+            "! tensor_sink name=out")
+        t0, t1 = (np.asarray(t) for t in got[0].tensors)
+        np.testing.assert_allclose(t0, 1.0)  # untouched
+        np.testing.assert_allclose(t1, 3.0)  # transformed
+
+    def test_sink_emit_signal_false_still_stores(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=3 dimensions=2 types=float32 "
+            "! tensor_sink name=out emit-signal=false")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.run(timeout=10)
+        assert got == []  # callbacks gated
+        assert pipe.get("out").pull(timeout=1) is not None  # pull still works
+
+    def test_sink_signal_rate_thins_callbacks(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=20 framerate=100 dimensions=2 "
+            "types=float32 ! tensor_sink name=out signal-rate=20")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.run(timeout=20)
+        # 100 fps stream, 20 signals/s cap -> roughly every 5th frame
+        assert 2 <= len(got) <= 8
+
+    def test_split_tensorpick(self):
+        got_a, got_b = [], []
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=6,types=float32 "
+            "! tensor_split name=s axis=0 tensorseg=2,2,2 tensorpick=0,2 "
+            "s.src_0 ! tensor_sink name=a "
+            "s.src_1 ! tensor_sink name=b")
+        pipe.get("a").connect(got_a.append)
+        pipe.get("b").connect(got_b.append)
+        pipe.play()
+        pipe.get("in").push_buffer(np.arange(6, dtype=np.float32))
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+        np.testing.assert_allclose(np.asarray(got_a[0].tensors[0]), [0, 1])
+        np.testing.assert_allclose(np.asarray(got_b[0].tensors[0]), [4, 5])
+
+    def test_merge_refresh_mode(self):
+        from nnstreamer_tpu.core import Buffer
+
+        pipe = parse_launch(
+            "tensor_merge name=m mode=linear option=0 sync-mode=refresh "
+            "! tensor_sink name=out max-stored=16 "
+            "appsrc name=a caps=other/tensors,format=static,dimensions=2,types=float32 ! m.sink_0 "
+            "appsrc name=b caps=other/tensors,format=static,dimensions=2,types=float32 ! m.sink_1 ")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        import time
+        pipe.get("a").push_buffer(Buffer([np.zeros(2, np.float32)]))
+        time.sleep(0.1)
+        pipe.get("b").push_buffer(Buffer([np.ones(2, np.float32)]))
+        time.sleep(0.1)
+        pipe.get("b").push_buffer(Buffer([np.full(2, 2.0, np.float32)]))
+        pipe.get("a").end_of_stream(); pipe.get("b").end_of_stream()
+        pipe.wait(timeout=10); pipe.stop()
+        # refresh: emits on the 2nd and 3rd arrival (both pads seen)
+        assert len(got) == 2
+        assert np.asarray(got[1].tensors[0]).tolist() == [0, 0, 2, 2]
+
+    def test_converter_set_timestamp(self):
+        from nnstreamer_tpu.core import Buffer
+
+        pipe = parse_launch(
+            "appsrc name=in caps=application/octet-stream "
+            "! tensor_converter input-dim=4 input-type=uint8 "
+            "! tensor_sink name=out")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        pipe.get("in").push_buffer(Buffer([np.zeros(4, np.uint8)]))  # no pts
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=10); pipe.stop()
+        assert got[0].pts is not None  # stamped by set-timestamp default
