@@ -88,11 +88,15 @@ class DataRepoSrc(SourceElement):
         "seed": Prop(0, int, "shuffle RNG seed (reproducibility)"),
         "use_native": Prop(True, prop_bool,
                            "prefetch samples with the C++ reader when built"),
+        "tensors_sequence": Prop(None, str,
+                                 "read only these tensor indices of each "
+                                 "sample, in order (reference prop)"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._info: Optional[TensorsInfo] = None
+        self._sequence: Optional[List[int]] = None
         self._data: Optional[np.memmap] = None
         self._order: List[int] = []
         self._pos = 0
@@ -107,6 +111,21 @@ class DataRepoSrc(SourceElement):
         caps = parse_caps_string(meta["gst_caps"])
         self._info = tensors_info_from_caps(caps)
         self._sample_size = self._info.nbytes
+        # reference tensors-sequence: read only the chosen tensors of each
+        # sample, in the given order; announced caps follow the selection
+        seq = self.props["tensors_sequence"]
+        self._sequence = None
+        if seq:
+            picks = [int(p) for p in str(seq).split(",") if p.strip()]
+            n = len(self._info.specs)
+            bad = [p for p in picks if not 0 <= p < n]
+            if bad:
+                raise ElementError(
+                    f"{self.describe()}: tensors-sequence {bad} out of "
+                    f"range for a {n}-tensor sample")
+            self._sequence = picks
+            caps = caps_from_tensors_info(
+                TensorsInfo.of(*(self._info.specs[p] for p in picks)))
         total = meta["total_samples"]
         start = self.props["start_sample_index"]
         stop = self.props["stop_sample_index"]
@@ -222,6 +241,8 @@ class DataRepoSrc(SourceElement):
             chunk = raw[off:off + spec.nbytes]
             tensors.append(chunk.view(spec.dtype.np_dtype).reshape(spec.shape).copy())
             off += spec.nbytes
+        if self._sequence is not None:
+            tensors = [tensors[p] for p in self._sequence]
         return Buffer(tensors, offset=idx)
 
     def stop(self) -> None:

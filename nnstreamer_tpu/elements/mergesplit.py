@@ -26,6 +26,7 @@ from ..core.tensors import TensorSpec
 from ..registry.elements import register_element
 from ..runtime.element import Element, ElementError, Prop
 from ..runtime.pad import Pad, PadDirection, PadPresence, PadTemplate
+from .muxdemux import collect_sync
 
 
 @register_element
@@ -74,8 +75,6 @@ class TensorMerge(Element):
         )
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
-        from .muxdemux import collect_sync
-
         with self._merge_lock:
             parts = collect_sync(self, pad, buf)
             if parts is None:

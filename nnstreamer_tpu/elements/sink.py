@@ -72,7 +72,7 @@ class TensorSink(SinkElement):
         if emit and rate > 0:
             # reference gst_tensor_sink_render: emit when at least 1/rate
             # of stream time passed since the last signalled buffer
-            now = buf.pts if buf.pts is not None else None
+            now = buf.pts
             last = getattr(self, "_last_signal_pts", None)
             if now is not None and last is not None and (now - last) < 1.0 / rate:
                 emit = False

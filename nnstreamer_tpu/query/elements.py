@@ -33,6 +33,26 @@ from .server import (
 _TENSOR_CAPS = Caps.new("other/tensors")
 
 
+def _connect_type(v) -> str:
+    """reference connect-type values TCP|HYBRID|AITT; only TCP exists here
+    (HYBRID/AITT are broker transports covered by the mqtt/edge elements).
+    Validated at property-set so a launch-line typo fails immediately."""
+    s = str(v).upper()
+    if s != "TCP":
+        raise ValueError(
+            f"connect-type {v!r} not supported: only TCP (use the mqtt/edge "
+            "elements for broker transports)")
+    return s
+
+_CONNECT_TYPE_PROP = Prop(
+    "TCP", _connect_type,
+    "transport (reference connect-type); only TCP is implemented — "
+    "HYBRID/AITT are edge-broker transports this framework covers via "
+    "its own MQTT/edge elements")
+
+
+
+
 @register_element
 class TensorQueryClient(Element):
     """Offload frames to a remote server pipeline; 1 sink (requests) + 1 src
@@ -43,6 +63,7 @@ class TensorQueryClient(Element):
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
     PROPERTIES = {
+        "connect_type": _CONNECT_TYPE_PROP,
         "host": Prop("127.0.0.1", str, "server host (reference dest-host)"),
         "port": Prop(0, int, "server port (reference dest-port)"),
         "timeout": Prop(10.0, float,
@@ -197,6 +218,7 @@ class TensorQueryServerSrc(SourceElement):
     ELEMENT_NAME = "tensor_query_serversrc"
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
     PROPERTIES = {
+        "connect_type": _CONNECT_TYPE_PROP,
         "host": Prop("127.0.0.1", str),
         "port": Prop(0, int, "listen port (0 = ephemeral; see bound_port)"),
         "id": Prop(0, int, "shared server id (pairs src and sink)"),
@@ -249,7 +271,10 @@ class TensorQueryServerSrc(SourceElement):
 class TensorQueryServerSink(SinkElement):
     ELEMENT_NAME = "tensor_query_serversink"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
-    PROPERTIES = {"id": Prop(0, int, "shared server id (pairs src and sink)")}
+    PROPERTIES = {
+        "id": Prop(0, int, "shared server id (pairs src and sink)"),
+        "connect_type": _CONNECT_TYPE_PROP,
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -292,6 +317,7 @@ class EdgeSink(SinkElement):
     ELEMENT_NAME = "edgesink"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
     PROPERTIES = {
+        "connect_type": _CONNECT_TYPE_PROP,
         "host": Prop("127.0.0.1", str),
         "port": Prop(0, int, "broker listen port (0 = ephemeral)"),
         "topic": Prop("", str),
@@ -331,6 +357,7 @@ class EdgeSrc(SourceElement):
         "dest_port": Prop(0, int),
         "topic": Prop("", str),
         "timeout": Prop(10.0, float),
+        "connect_type": _CONNECT_TYPE_PROP,
     }
 
     def __init__(self, name=None, **props):

@@ -725,3 +725,22 @@ class TestReferencePropParity:
         pipe.get("in").end_of_stream()
         pipe.wait(timeout=10); pipe.stop()
         assert got[0].pts is not None  # stamped by set-timestamp default
+
+    def test_datarepo_tensors_sequence(self, tmp_path):
+        # write a 2-tensor-per-sample repo, read back only tensor 1 then 0
+        write = parse_launch(
+            "tensor_src num-buffers=3 dimensions=2.3 types=float32 pattern=counter "
+            f"! datareposink location={tmp_path}/d.raw json={tmp_path}/d.json")
+        write.run(timeout=15)
+        got = run_collect(
+            f"datareposrc location={tmp_path}/d.raw json={tmp_path}/d.json "
+            "use-native=false tensors-sequence=1,0 ! tensor_sink name=out")
+        assert len(got) == 3
+        assert np.asarray(got[0].tensors[0]).shape == (3,)  # tensor 1 first
+        assert np.asarray(got[0].tensors[1]).shape == (2,)
+
+    def test_query_connect_type_validated(self):
+        with pytest.raises(Exception, match="connect-type"):
+            parse_launch(
+                "appsrc name=in caps=other/tensors,format=static,dimensions=2,types=float32 "
+                "! tensor_query_client connect-type=AITT ! tensor_sink name=out")
