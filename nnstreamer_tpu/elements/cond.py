@@ -61,10 +61,15 @@ class TensorIf(TransformElement):
                                       "a-value: 'tensorIdx:flatIdx'; total/average: tensor idx; custom: registered name"),
         "operator": Prop("gt", str, "|".join(_OPERATORS)),
         "supplied_value": Prop("0", str, "comparison value(s), ':'-separated for ranges"),
-        "then": Prop("passthrough", str, "passthrough | skip | fill-zero | fill-values | tensorpick"),
-        "then_option": Prop(None, str, "fill value / tensor indices"),
+        "then": Prop("passthrough", str,
+                     "passthrough | skip | fill-zero | fill-values | "
+                     "tensorpick | fill-with-file | fill-with-file-rpt | "
+                     "repeat-previous"),
+        "then_option": Prop(None, str,
+                            "fill value / tensor indices / raw tensor file "
+                            "path (fill-with-file*)"),
         "else": Prop("skip", str, "same choices as then"),
-        "else_option": Prop(None, str, ""),
+        "else_option": Prop(None, str, "same roles as then-option"),
     }
 
     # -- negotiation --------------------------------------------------------
@@ -80,7 +85,9 @@ class TensorIf(TransformElement):
         selections = []
         for action_key, option_key in (("then", "then_option"), ("else", "else_option")):
             action = self.props[action_key]
-            if action == "skip":
+            if action in ("skip", "repeat-previous"):
+                # no selection of their own: skip emits nothing and
+                # repeat-previous re-emits whatever the other branch shaped
                 continue
             selections.append(
                 [int(p) for p in str(self.props[option_key] or "0").split(",")]
@@ -147,9 +154,56 @@ class TensorIf(TransformElement):
         if action == "tensorpick":
             idx = [int(p) for p in str(option or "0").split(",")]
             return buf.with_tensors([buf.tensors[i] for i in idx]).copy_metadata_from(buf)
+        if action in ("fill-with-file", "fill-with-file-rpt"):
+            # declared-but-unimplemented in the reference (gsttensor_if.h:84-87
+            # enum with no .c handler); implemented here per its header docs:
+            # output tensors filled from the file's raw bytes — short files
+            # zero-fill the rest (plain) or repeat cyclically (rpt)
+            data = self._fill_file_bytes(str(option or ""))
+            out, off = [], 0
+            for t in buf.tensors:
+                a = np.asarray(t)
+                n = a.nbytes
+                if action == "fill-with-file-rpt" and len(data):
+                    start = off % len(data)
+                    tiled = np.tile(data, n // len(data) + 2)
+                    chunk = tiled[start:start + n]
+                else:
+                    avail = data[off:off + n]
+                    chunk = np.zeros(n, np.uint8)
+                    chunk[:len(avail)] = avail
+                off += n
+                out.append(chunk.view(a.dtype).reshape(a.shape))
+            return buf.with_tensors(out).copy_metadata_from(buf)
+        if action == "repeat-previous":
+            # reference TIFB_REPEAT_PREVIOUS_FRAME: re-emit the last frame
+            # this element produced; nothing cached yet -> skip
+            prev = getattr(self, "_prev_out", None)
+            if prev is None:
+                return None
+            return prev.with_tensors(list(prev.tensors)).copy_metadata_from(buf)
         raise ElementError(f"{self.describe()}: unknown action '{action}'")
+
+    def _fill_file_bytes(self, path: str) -> np.ndarray:
+        if not path:
+            raise ElementError(
+                f"{self.describe()}: fill-with-file needs the branch option "
+                "to name the raw tensor file")
+        cached = getattr(self, "_fill_cache", None)
+        if cached is None or cached[0] != path:
+            with open(path, "rb") as fh:
+                self._fill_cache = (path, np.frombuffer(fh.read(), np.uint8))
+        return self._fill_cache[1]
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._prev_out = None
 
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         if self._evaluate(buf):
-            return self._apply(self.props["then"], self.props["then_option"], buf)
-        return self._apply(self.props["else"], self.props["else_option"], buf)
+            out = self._apply(self.props["then"], self.props["then_option"], buf)
+        else:
+            out = self._apply(self.props["else"], self.props["else_option"], buf)
+        if out is not None:
+            self._prev_out = out
+        return out

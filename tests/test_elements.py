@@ -744,3 +744,46 @@ class TestReferencePropParity:
             parse_launch(
                 "appsrc name=in caps=other/tensors,format=static,dimensions=2,types=float32 "
                 "! tensor_query_client connect-type=AITT ! tensor_sink name=out")
+
+    def test_if_fill_with_file_and_rpt(self, tmp_path):
+        raw = np.arange(4, dtype=np.float32)
+        p = tmp_path / "fill.raw"
+        p.write_bytes(raw.tobytes()[:8])  # file holds only 2 floats
+        for action, want in (("fill-with-file", [0, 1, 0, 0]),
+                             ("fill-with-file-rpt", [0, 1, 0, 1])):
+            got = run_collect(
+                "tensor_src num-buffers=1 dimensions=4 types=float32 pattern=ones "
+                f"! tensor_if compared-value=a-value compared-value-option=0:0 "
+                f"operator=ge supplied-value=100 then=passthrough "
+                f"else={action} else-option={p} ! tensor_sink name=out")
+            np.testing.assert_allclose(
+                np.asarray(got[0].tensors[0]), want, err_msg=action)
+
+    def test_if_repeat_previous(self):
+        # frames 0..3: 0,1 pass (<=1); 2,3 fail and re-emit the cached 1
+        got = run_collect(
+            "tensor_src num-buffers=4 dimensions=1 types=float32 pattern=counter "
+            "! tensor_if compared-value=a-value compared-value-option=0:0 "
+            "operator=le supplied-value=1 then=passthrough "
+            "else=repeat-previous ! tensor_sink name=out")
+        vals = [float(np.asarray(b.tensors[0])[0]) for b in got]
+        assert vals == [0, 1, 1, 1]
+
+    def test_if_repeat_previous_nothing_cached_skips(self):
+        got = run_collect(
+            "tensor_src num-buffers=2 dimensions=1 types=float32 pattern=counter "
+            "! tensor_if compared-value=a-value compared-value-option=0:0 "
+            "operator=ge supplied-value=100 then=passthrough "
+            "else=repeat-previous ! tensor_sink name=out")
+        assert got == []  # every frame fails, cache never fills
+
+    def test_if_repeat_previous_pairs_with_tensorpick(self):
+        # repeat-previous has no tensor selection of its own: pairing it
+        # with a picking branch must negotiate (re-emits picked frames)
+        got = run_collect(
+            "tensor_src num-buffers=4 dimensions=1.2 types=float32 pattern=counter "
+            "! tensor_if compared-value=a-value compared-value-option=0:0 "
+            "operator=le supplied-value=1 then=tensorpick then-option=0 "
+            "else=repeat-previous ! tensor_sink name=out")
+        assert len(got) == 4
+        assert all(b.num_tensors == 1 for b in got)
