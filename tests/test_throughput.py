@@ -1,0 +1,93 @@
+"""Host-runtime throughput proof (VERDICT r02 weak #1 / next #2).
+
+The BASELINE target is >=2000 fps on TPU. The device does the FLOPs, but
+the HOST runtime must batch, queue, dispatch, and sink frames at that rate
+or it becomes the ceiling no matter how fast the chip is. This suite runs
+the EXACT bench topology (bench.py: tensor_src -> tensor_aggregator ->
+queue -> tensor_filter -> queue -> tensor_sink) with an instant identity
+backend, so every measured microsecond is framework overhead — a
+device-excluded proof that the plumbing sustains the target rate.
+
+Reference analog: the reference's hot loop is
+gst/nnstreamer/tensor_filter/tensor_filter.c:643 (gst_tensor_filter_transform)
+riding GStreamer's queue machinery; its CI never asserts a rate because its
+CI owns real hardware. Ours must, because the device is usually absent.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.custom_easy import (register_custom_easy,
+                                                 unregister_custom_easy)
+from nnstreamer_tpu.core import MessageType
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+TARGET_FPS = 2000.0
+BATCH = 256
+FRAME_SHAPE = (224, 224, 3)  # the bench's MobileNet input, raw uint8
+WARMUP_BATCHES = 3
+MEASURE_BATCHES = 24
+
+
+@pytest.fixture()
+def identity_backend():
+    register_custom_easy("tp_identity", lambda tensors: tensors)
+    yield "tp_identity"
+    unregister_custom_easy("tp_identity")
+
+
+def _run_bench_topology(identity_backend, batch, n_batches, frame_shape):
+    total = batch * n_batches
+    dims = ":".join(str(d) for d in reversed(frame_shape)) + ":1"
+    pipe = parse_launch(
+        f"tensor_src num-buffers={total} dimensions={dims} types=uint8 "
+        "pattern=zeros "
+        f"! tensor_aggregator frames-out={batch} frames-dim=0 concat=true "
+        "! queue max-size-buffers=4 "
+        f"! tensor_filter framework=custom-easy model={identity_backend} name=f "
+        "! queue max-size-buffers=4 "
+        "! tensor_sink name=out max-stored=1"
+    )
+    times = []
+    pipe.get("out").connect(lambda b: times.append(time.monotonic()))
+    pipe.play()
+    deadline = time.monotonic() + 120.0
+    while len(times) < n_batches and time.monotonic() < deadline:
+        msg = pipe.bus.pop(timeout=0.05)
+        if msg is not None and msg.type is MessageType.ERROR:
+            pipe.stop()
+            raise RuntimeError(f"pipeline ERROR: {msg.data.get('error')}")
+        if msg is not None and msg.type is MessageType.EOS:
+            break  # shortfall (if any) is reported by the caller's assert
+    pipe.stop()
+    return times
+
+
+class TestHostRuntimeThroughput:
+    def test_bench_topology_sustains_target_rate_device_excluded(
+            self, identity_backend):
+        """src->aggregator->queue->filter->queue->sink at batch 256 with an
+        instant backend must sustain >= 2000 fps-equivalent: if this fails,
+        no device can rescue the bench."""
+        n = WARMUP_BATCHES + MEASURE_BATCHES
+        times = _run_bench_topology(identity_backend, BATCH, n, FRAME_SHAPE)
+        assert len(times) == n, f"only {len(times)}/{n} batches arrived"
+        span = times[-1] - times[WARMUP_BATCHES - 1]
+        fps = (len(times) - WARMUP_BATCHES) * BATCH / span
+        print(f"\nhost-runtime throughput: {fps:.0f} fps-equivalent "
+              f"(batch={BATCH}, {MEASURE_BATCHES} batches, frame {FRAME_SHAPE})")
+        assert fps >= TARGET_FPS, (
+            f"host runtime sustained only {fps:.0f} fps-equivalent "
+            f"(target {TARGET_FPS:.0f}) — pipeline plumbing is the bottleneck")
+
+    def test_small_frame_rate_headroom(self, identity_backend):
+        """Same topology with tiny frames isolates per-buffer dispatch cost
+        from memcpy bandwidth: headroom here should be >> target."""
+        n = WARMUP_BATCHES + MEASURE_BATCHES
+        times = _run_bench_topology(identity_backend, BATCH, n, (16, 16, 3))
+        assert len(times) == n
+        span = times[-1] - times[WARMUP_BATCHES - 1]
+        fps = (len(times) - WARMUP_BATCHES) * BATCH / span
+        print(f"\nsmall-frame throughput: {fps:.0f} fps-equivalent")
+        assert fps >= 2 * TARGET_FPS

@@ -1,0 +1,129 @@
+"""Round-long TPU tunnel watcher: probe cheaply and repeatedly, and turn
+the FIRST minute of tunnel life into a real bench number.
+
+Rationale (VERDICT r02 "next round" #1): the axon tunnel on this rig dies
+for whole rounds at a time, and a single 450 s probe at bench time both
+eats the measurement budget and misses any window where the tunnel briefly
+lives. This watcher inverts the shape: many cheap probes (default 120 s
+timeout, every ~10 min) across the whole round, each logged to
+``PROBE_LOG_r03.jsonl``; the moment a probe reports a non-CPU platform it
+immediately launches ``bench.py`` (batch sweep armed) and then
+``tools/bench_suite.py``, saving results to ``BENCH_TPU_r03.json`` /
+``BENCH_SUITE_TPU_r03.json``. Either way the round ends with evidence:
+a TPU number, or a log of many spread-out attempts.
+
+Reference analog: the reference has no such machinery because its CI owns
+real hardware; this is rig-specific harnessing, not a framework component.
+
+Run:  python tools/tpu_probe_loop.py            # loops until killed
+      PROBE_INTERVAL=600 PROBE_TIMEOUT=120 ...  # knobs
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from nnstreamer_tpu.utils.hw_accel import default_platform  # noqa: E402
+
+PROBE_TIMEOUT = float(os.environ.get("PROBE_TIMEOUT", "120"))
+PROBE_INTERVAL = float(os.environ.get("PROBE_INTERVAL", "600"))
+LOG_PATH = os.environ.get("PROBE_LOG", os.path.join(ROOT, "PROBE_LOG_r03.jsonl"))
+BENCH_OUT = os.environ.get("PROBE_BENCH_OUT", os.path.join(ROOT, "BENCH_TPU_r03.json"))
+SUITE_OUT = os.environ.get("PROBE_SUITE_OUT", os.path.join(ROOT, "BENCH_SUITE_TPU_r03.json"))
+
+
+def _log_line(entry: dict) -> None:
+    entry["iso"] = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    with open(LOG_PATH, "a") as fh:
+        fh.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def _run_and_capture(cmd, out_path: str, timeout_s: float, env: dict) -> bool:
+    """Run `cmd`; save the LAST stdout JSON line to out_path. True on a
+    parseable result."""
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout_s,
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    except subprocess.TimeoutExpired:
+        _log_line({"event": "bench_timeout", "cmd": cmd[-1], "timeout_s": timeout_s})
+        return False
+    lines = [ln for ln in proc.stdout.decode(errors="replace").splitlines()
+             if ln.strip().startswith("{")]
+    tail = proc.stderr.decode(errors="replace")[-2000:]
+    if not lines:
+        _log_line({"event": "bench_no_output", "cmd": cmd[-1],
+                   "rc": proc.returncode, "stderr_tail": tail})
+        return False
+    results = []
+    for ln in lines:
+        try:
+            results.append(json.loads(ln))
+        except ValueError:
+            pass
+    if not results:
+        _log_line({"event": "bench_unparseable_output", "cmd": cmd[-1],
+                   "rc": proc.returncode, "lines": lines[-3:],
+                   "stderr_tail": tail})
+        return False
+    with open(out_path, "w") as fh:
+        json.dump(results[-1] if len(results) == 1 else results, fh, indent=1)
+    _log_line({"event": "bench_saved", "path": out_path, "result": results[-1]})
+    return True
+
+
+def probe_once() -> str | None:
+    t0 = time.monotonic()
+    plat = default_platform(timeout_s=PROBE_TIMEOUT, cache_path=None)
+    _log_line({"event": "probe", "platform": plat,
+               "elapsed_s": round(time.monotonic() - t0, 1),
+               "timeout_s": PROBE_TIMEOUT})
+    return plat
+
+
+def bench_on_device(platform: str) -> bool:
+    """Tunnel is alive right now — spend it. Seed the probe cache with the
+    platform the probe just saw so bench.py/bench_suite skip their own
+    probe and go straight to init (the live window is the scarce thing)."""
+    cache = "/tmp/nns_tpu_probe_cache.json"
+    try:
+        tmp = f"{cache}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"platform": platform, "ts": time.time()}, fh)
+        os.replace(tmp, cache)
+    except OSError as e:
+        _log_line({"event": "cache_seed_failed", "error": str(e)})
+    env = dict(os.environ, NNS_TPU_PROBE_CACHE=cache,
+               BENCH_INIT_TIMEOUT="120")
+    ok = _run_and_capture([sys.executable, os.path.join(ROOT, "bench.py")],
+                          BENCH_OUT, timeout_s=1500, env=env)
+    if ok:
+        _run_and_capture([sys.executable,
+                          os.path.join(ROOT, "tools", "bench_suite.py")],
+                         SUITE_OUT, timeout_s=2400, env=env)
+    return ok
+
+
+def main() -> None:
+    _log_line({"event": "watcher_start", "interval_s": PROBE_INTERVAL,
+               "probe_timeout_s": PROBE_TIMEOUT})
+    got_number = os.path.exists(BENCH_OUT)
+    while True:
+        plat = probe_once()
+        if plat and plat != "cpu" and not got_number:
+            got_number = bench_on_device(plat)
+        # after a success keep probing (cheap) so the log shows tunnel
+        # uptime, but don't re-burn bench time
+        time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    main()
