@@ -1,0 +1,169 @@
+"""Sparse tensors as a first-class wire format (VERDICT r02 missing #2).
+
+Reference analog: sparse layout is part of the serialized per-memory header
+(gst/nnstreamer/elements/gsttensor_sparseutil.c:116,
+include/tensor_typedef.h:280 ``GstTensorMetaInfo.sparse_info``) so a sparse
+stream survives query/edge transport. These tests pin the same guarantee
+for wire v2: sparse_enc -> serialize -> any transport -> deserialize ->
+sparse_dec reproduces the dense stream byte-exactly, and non-serializable
+meta raises instead of silently dropping (r02: a dropped ``sparse_specs``
+decoded into garbage with no error).
+"""
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer
+from nnstreamer_tpu.core.serialize import pack_tensors, unpack_tensors
+from nnstreamer_tpu.elements.sparse import TensorSparseDec, TensorSparseEnc
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+def _sparse_roundtrip(dense: Buffer) -> Buffer:
+    enc = TensorSparseEnc()
+    dec = TensorSparseDec()
+    sparse = enc.transform(dense)
+    wire = pack_tensors(sparse)
+    back = unpack_tensors(bytes(wire))
+    return dec.transform(back)
+
+
+def _rand_sparse(shape, dtype, density=0.1, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.random(shape).astype(dtype)
+    a[rng.random(shape) > density] = 0
+    return a
+
+
+class TestSparseWire:
+    def test_serialize_roundtrip_byte_exact(self):
+        dense = [_rand_sparse((8, 16), np.float32),
+                 (_rand_sparse((32,), np.float32, 0.2, 1) * 100).astype(np.int16)]
+        out = _sparse_roundtrip(Buffer(dense, pts=0.25))
+        assert len(out.tensors) == 2
+        for got, want in zip(out.tensors, dense):
+            assert np.asarray(got).dtype == want.dtype
+            assert np.asarray(got).tobytes() == want.tobytes()
+
+    def test_all_zero_tensor_roundtrips(self):
+        out = _sparse_roundtrip(Buffer([np.zeros((4, 4), np.float32)]))
+        assert np.asarray(out.tensors[0]).tobytes() == bytes(4 * 4 * 4)
+
+    def test_sparse_meta_and_pts_survive(self):
+        enc = TensorSparseEnc()
+        sparse = enc.transform(Buffer([_rand_sparse((8,), np.float32)], pts=1.5))
+        sparse.meta["client_id"] = 7
+        back = unpack_tensors(bytes(pack_tensors(sparse)))
+        assert back.pts == 1.5
+        assert back.meta["client_id"] == 7
+        specs = back.meta["sparse_specs"]
+        assert [tuple(s.shape) for s in specs] == [(8,)]
+
+    def test_wire_is_compact(self):
+        """The point of sparse-over-the-wire: bytes scale with nnz, not
+        with the dense size."""
+        dense = _rand_sparse((256, 256), np.float32, density=0.01)
+        sparse = TensorSparseEnc().transform(Buffer([dense]))
+        assert len(bytes(pack_tensors(sparse))) < dense.nbytes / 10
+
+    def test_non_serializable_meta_raises_naming_key(self):
+        b = Buffer([np.zeros(4, np.float32)])
+        b.meta["handle"] = object()
+        with pytest.raises(TypeError, match="handle"):
+            pack_tensors(b)
+
+    def test_numpy_meta_values_coerced(self):
+        b = Buffer([np.zeros(4, np.float32)])
+        b.meta["score"] = np.float32(0.5)
+        b.meta["box"] = np.arange(4, dtype=np.int64)
+        out = unpack_tensors(bytes(pack_tensors(b)))
+        assert out.meta["score"] == 0.5
+        assert out.meta["box"] == [0, 1, 2, 3]
+
+    def test_v1_dense_frame_still_reads(self):
+        """Wire v1 (no per-tensor flags byte) must keep deserializing —
+        old peers exist."""
+        payload = np.arange(6, dtype=np.float32)
+        blob = (b"NNST" + struct.pack("<HIdI", 1, 1, 0.5, 2) + b"{}"
+                + struct.pack("<B", 7) + b"float32" + struct.pack("<B", 2)
+                + struct.pack("<2Q", 2, 3) + struct.pack("<Q", payload.nbytes)
+                + payload.tobytes())
+        out = unpack_tensors(blob)
+        assert out.pts == 0.5
+        assert np.asarray(out.tensors[0]).shape == (2, 3)
+        np.testing.assert_array_equal(np.asarray(out.tensors[0]),
+                                      payload.reshape(2, 3))
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert cond()
+
+
+class TestSparseAcrossTransports:
+    SPARSE_CAPS = "other/tensors,format=sparse"
+
+    def test_sparse_survives_tensor_query(self):
+        """enc -> query client -> server echo -> dec == dense (the r02
+        failure mode: specs dropped at the boundary, garbage out)."""
+        server = parse_launch(
+            f"tensor_query_serversrc name=ssrc id=40 port=0 caps={self.SPARSE_CAPS} "
+            "! tensor_query_serversink id=40")
+        server.play()
+        _wait(lambda: server.get("ssrc").bound_port != 0)
+        port = server.get("ssrc").bound_port
+        try:
+            client = parse_launch(
+                "appsrc name=in caps=other/tensors,format=static,dimensions=4:8,types=float32 "
+                "! tensor_sparse_enc "
+                f"! tensor_query_client host=127.0.0.1 port={port} "
+                "! tensor_sparse_dec ! tensor_sink name=out max-stored=8")
+            out = []
+            client.get("out").connect(out.append)
+            client.play()
+            frames = [_rand_sparse((8, 4), np.float32, 0.2, seed=s)
+                      for s in range(3)]
+            src = client.get("in")
+            for f in frames:
+                src.push_buffer(f)
+            src.end_of_stream()
+            _wait(lambda: len(out) >= 3)
+            client.stop()
+            for got, want in zip(out, frames):
+                assert np.asarray(got.tensors[0]).tobytes() == want.tobytes()
+        finally:
+            server.stop()
+
+    def test_sparse_survives_grpc(self):
+        pytest.importorskip("grpc")
+        recv = parse_launch(
+            f"tensor_src_grpc name=g server=true port=0 caps={self.SPARSE_CAPS} "
+            "! tensor_sparse_dec ! tensor_sink name=out max-stored=8")
+        out = []
+        recv.get("out").connect(out.append)
+        recv.play()
+        _wait(lambda: recv.get("g").bound_port != 0)
+        port = recv.get("g").bound_port
+        try:
+            send = parse_launch(
+                "appsrc name=in caps=other/tensors,format=static,dimensions=4:8,types=float32 "
+                "! tensor_sparse_enc "
+                f"! tensor_sink_grpc server=false port={port}")
+            send.play()
+            frames = [_rand_sparse((8, 4), np.float32, 0.2, seed=10 + s)
+                      for s in range(3)]
+            src = send.get("in")
+            for f in frames:
+                src.push_buffer(f)
+            src.end_of_stream()
+            send.wait(timeout=10)
+            _wait(lambda: len(out) >= 3)
+            send.stop()
+            for got, want in zip(out, frames):
+                assert np.asarray(got.tensors[0]).tobytes() == want.tobytes()
+        finally:
+            recv.stop()
