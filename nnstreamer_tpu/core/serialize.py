@@ -44,10 +44,20 @@ _FLAG_SPARSE = 0x01
 SPARSE_META_KEY = "sparse_specs"
 
 
+# ndarrays in meta coerce to JSON lists only up to this many elements;
+# anything larger (e.g. a full segmentation class_map) would inflate every
+# frame with megabytes of JSON text — ship it as a tensor instead
+_META_ARRAY_MAX = 256
+
+
 def _meta_default(o):
     if isinstance(o, np.generic):
         return o.item()
     if isinstance(o, np.ndarray):
+        if o.size > _META_ARRAY_MAX:
+            raise TypeError(
+                f"ndarray of {o.size} elements in meta (>{_META_ARRAY_MAX}); "
+                "send large arrays as tensors, not meta")
         return o.tolist()
     if isinstance(o, (set, frozenset)):
         return sorted(o)
@@ -125,6 +135,10 @@ def pack_tensors(buf: Buffer, extra_meta: Optional[dict] = None) -> memoryview:
                 raise ValueError(
                     f"sparse tensor {i}: values dtype {vals.dtype} != "
                     f"dense spec dtype {dtype.value}")
+            if idx.size != vals.size:
+                raise ValueError(
+                    f"sparse tensor {i}: {idx.size} indices but "
+                    f"{vals.size} values")
             shape = tuple(int(d) for d in spec.shape)
             nbytes = 4 + idx.nbytes + vals.nbytes
             dt = dtype.value.encode()

@@ -12,21 +12,59 @@ payload, gst/mqtt/mqttcommon.h:49-61). Own design:
     shape/pts/meta ride in the frame, no fixed-size header;
   * negotiation: caps string published RETAINED on ``<topic>/caps`` —
     late subscribers still negotiate (the reference re-sends caps in every
-    message header instead).
+    message header instead);
+  * clock sync: with ``ntp-sync=true`` both ends correct their wall clock
+    via SNTP (utils/ntp.py, reference ntputil.c + ``ntp-sync``/``ntp-srvs``
+    props); the publisher stamps every frame with ``base_time_epoch_us`` /
+    ``sent_time_epoch_us`` (mqttcommon.h:49-61) and the subscriber
+    re-anchors pts into its own running time exactly like the reference's
+    ``_put_timestamp_on_gst_buf`` (mqttsrc.c:1380-1404): frames sent
+    before the subscriber started lose their timestamp, negative results
+    are dropped to None.
 """
 from __future__ import annotations
 
 import queue as _queue
+import time
 from typing import Optional
 
 from ..core import Buffer, Caps, parse_caps_string
 from ..core.serialize import pack_tensors, unpack_tensors
 from ..registry.elements import register_element
-from ..runtime.element import ElementError, Prop, SinkElement, SourceElement
+from ..runtime.element import (ElementError, Prop, SinkElement,
+                               SourceElement, prop_bool)
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 from ..utils.log import logger
+from ..utils.ntp import DEFAULT_SERVERS, EpochClock
 
 _TENSOR_CAPS = Caps.new("other/tensors")
+
+# wire meta keys for cross-host timestamp alignment (the reference's
+# GstMQTTMessageHdr base_time_epoch / sent_time_epoch, in µs)
+BASE_EPOCH_KEY = "mqtt_base_time_epoch_us"
+SENT_EPOCH_KEY = "mqtt_sent_time_epoch_us"
+
+
+def _epoch_clock(element) -> EpochClock:
+    """Build the element's epoch clock; ntp-sync failures post a warning
+    and fall back to the raw wall clock (the reference logs and keeps
+    g_get_real_time)."""
+    clock = EpochClock(element.props["ntp_srvs"]
+                       if element.props["ntp_sync"] else "")
+    if element.props["ntp_sync"] and not clock.sync():
+        logger.warning("%s: ntp-sync requested but no NTP server answered "
+                       "(%s); using the raw wall clock",
+                       element.name, element.props["ntp_srvs"])
+    return clock
+
+
+def _base_epoch_us(element, clock: EpochClock) -> int:
+    """Epoch µs at the pipeline's running-time zero (reference: epoch(now)
+    − (clock_time − base_time), mqttsrc.c:470-476)."""
+    pipe = element.pipeline
+    t0 = pipe.play_t0_mono if pipe is not None else None
+    elapsed_us = 0 if t0 is None else int((time.monotonic() - t0) * 1e6)
+    return clock.epoch_us() - elapsed_us
 
 
 @register_element
@@ -39,12 +77,18 @@ class MqttSink(SinkElement):
         "pub_topic": Prop("", str, "publish topic (reference pub-topic)"),
         "broker": Prop("external", str, "external | embedded (in-process)"),
         "client_id": Prop("", str),
+        "ntp_sync": Prop(False, prop_bool,
+                         "correct the wall clock via SNTP (reference ntp-sync)"),
+        "ntp_srvs": Prop(DEFAULT_SERVERS, str,
+                         "HOST:PORT,... NTP servers (reference ntp-srvs)"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._client = None
         self._broker = None
+        self._clock: Optional[EpochClock] = None
+        self._base_epoch_us = 0
 
     @property
     def bound_port(self) -> int:
@@ -62,13 +106,18 @@ class MqttSink(SinkElement):
             host, port = self._broker.host, self._broker.port
         self._client = mqtt.MqttClient(host, port,
                                        client_id=self.props["client_id"])
+        self._clock = _epoch_clock(self)
+        self._base_epoch_us = _base_epoch_us(self, self._clock)
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
         self._client.publish(f"{self.props['pub_topic']}/caps",
                              str(caps).encode(), retain=True)
 
     def render(self, buf: Buffer) -> None:
-        self._client.publish(self.props["pub_topic"], pack_tensors(buf))
+        hdr = {BASE_EPOCH_KEY: self._base_epoch_us,
+               SENT_EPOCH_KEY: self._clock.epoch_us()}
+        self._client.publish(self.props["pub_topic"],
+                             pack_tensors(buf, extra_meta=hdr))
 
     def stop(self) -> None:
         from ..query import mqtt
@@ -92,6 +141,10 @@ class MqttSrc(SourceElement):
         "timeout": Prop(10.0, float, "caps-wait / connect timeout seconds"),
         "client_id": Prop("", str),
         "num_buffers": Prop(-1, int, "stop after N frames (-1 = endless)"),
+        "ntp_sync": Prop(False, prop_bool,
+                         "correct the wall clock via SNTP (reference ntp-sync)"),
+        "ntp_srvs": Prop(DEFAULT_SERVERS, str,
+                         "HOST:PORT,... NTP servers (reference ntp-srvs)"),
     }
 
     def __init__(self, name=None, **props):
@@ -100,6 +153,8 @@ class MqttSrc(SourceElement):
         self._q: _queue.Queue = _queue.Queue()
         self._caps_q: _queue.Queue = _queue.Queue()
         self._count = 0
+        self._clock: Optional[EpochClock] = None
+        self._base_epoch_us = 0
 
     def get_src_caps(self) -> Caps:
         from ..query import mqtt
@@ -133,6 +188,30 @@ class MqttSrc(SourceElement):
                 f"within {self.props['timeout']}s — is the publisher up?")
         return parse_caps_string(caps_str)
 
+    def start(self) -> None:
+        # fresh sync every (re)start, like the sink — a cached offset
+        # would accumulate host clock drift across stop/play cycles
+        self._clock = _epoch_clock(self)
+        self._base_epoch_us = _base_epoch_us(self, self._clock)
+        super().start()
+
+    def _align_timestamp(self, buf: Buffer) -> Buffer:
+        """Re-anchor the publisher's pts into THIS pipeline's running time
+        (reference mqttsrc.c:1380-1404 _put_timestamp_on_gst_buf)."""
+        base = buf.meta.pop(BASE_EPOCH_KEY, None)
+        sent = buf.meta.pop(SENT_EPOCH_KEY, None)
+        if base is None:
+            return buf  # pre-clock-sync peer: leave pts as it arrived
+        if sent is not None:
+            buf.meta["mqtt_latency_us"] = self._clock.epoch_us() - sent
+        if sent is not None and sent < self._base_epoch_us:
+            buf.pts = None  # published before we started: not in our timeline
+            return buf
+        if buf.pts is not None:
+            pts = buf.pts + (base - self._base_epoch_us) / 1e6
+            buf.pts = pts if pts >= 0 else None
+        return buf
+
     def create(self) -> Optional[Buffer]:
         limit = self.props["num_buffers"]
         if 0 <= limit <= self._count:
@@ -143,7 +222,7 @@ class MqttSrc(SourceElement):
             except _queue.Empty:
                 continue
             self._count += 1
-            return buf
+            return self._align_timestamp(buf)
         return None
 
     def reset_flow(self) -> None:

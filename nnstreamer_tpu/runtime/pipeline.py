@@ -51,6 +51,8 @@ class Pipeline:
         self.name = name
         self.elements: Dict[str, Element] = {}
         self.bus = Bus()
+        # running-time anchor, set at each play() (GStreamer base_time analog)
+        self.play_t0_mono: Optional[float] = None
         self._playing = False
         self._eos_sinks: Set[str] = set()
         self._lock = threading.Lock()
@@ -89,6 +91,7 @@ class Pipeline:
         trace.dump_dot(self)       # NNS_DOT_DIR (GST_DEBUG_DUMP_DOT_DIR)
         self._validate_links()
         self._playing = True
+        self.play_t0_mono = time.monotonic()
         self._eos_sinks.clear()
         for el in self.elements.values():
             el.reset_flow()

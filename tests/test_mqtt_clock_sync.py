@@ -1,0 +1,167 @@
+"""MQTT cross-host clock alignment (VERDICT r02 missing #3).
+
+Reference analog: gst/mqtt/ntputil.c (SNTP query → epoch µs) +
+mqttcommon.h:49-61 (base_time_epoch/sent_time_epoch in the message header)
++ mqttsrc.c:1380-1404 (_put_timestamp_on_gst_buf re-anchors pts). The
+reference tests this with a gmock NTP mock (tests/unittest_ntp_util_mock.cc);
+we run a real fake UDP NTP responder and skew each element's wall clock to
+prove the subscriber reconstructs pts in ITS OWN timeline regardless of
+host clock error.
+"""
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements import mqtt as mqtt_el
+from nnstreamer_tpu.runtime.parse import parse_launch
+from nnstreamer_tpu.utils.ntp import (NTP_DELTA, EpochClock, parse_servers,
+                                      sntp_epoch_us)
+
+
+class FakeNtpServer:
+    """UDP responder speaking just enough RFC 5905: mode-4 reply whose
+    transmit timestamp is ``clock()`` (true time by default)."""
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._running = True
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while self._running:
+            try:
+                _, addr = self._sock.recvfrom(256)
+            except OSError:
+                return
+            t = self._clock()
+            reply = bytearray(48)
+            reply[0] = 0x1C  # li=0, vn=3, mode=4 (server)
+            struct.pack_into("!II", reply, 40,
+                             int(t) + NTP_DELTA, int((t % 1.0) * (1 << 32)))
+            try:
+                self._sock.sendto(bytes(reply), addr)
+            except OSError:
+                return
+
+    def stop(self):
+        self._running = False
+        self._sock.close()
+
+
+@pytest.fixture()
+def ntp_server():
+    s = FakeNtpServer()
+    yield s
+    s.stop()
+
+
+class TestSntp:
+    def test_query_returns_epoch(self, ntp_server):
+        got = sntp_epoch_us("127.0.0.1", ntp_server.port)
+        assert abs(got - time.time() * 1e6) < 200_000  # 200 ms
+
+    def test_bogus_reply_rejected(self):
+        srv = FakeNtpServer(clock=lambda: -1e9)  # pre-1970 transmit ts
+        try:
+            with pytest.raises(ValueError):
+                sntp_epoch_us("127.0.0.1", srv.port)
+        finally:
+            srv.stop()
+
+    def test_parse_servers(self):
+        assert parse_servers("a:123, b ,c:999") == [
+            ("a", 123), ("b", 123), ("c", 999)]
+        assert parse_servers("") == []
+
+
+class TestEpochClock:
+    def test_corrects_skewed_wall(self, ntp_server):
+        skewed = lambda: time.time() - 7.5  # noqa: E731 - host 7.5 s behind
+        clock = EpochClock(f"127.0.0.1:{ntp_server.port}", wall=skewed)
+        assert clock.sync()
+        assert abs(clock.epoch_us() - time.time() * 1e6) < 300_000
+
+    def test_no_server_falls_back_to_wall(self):
+        # closed port: sync fails, epoch_us == raw (uncorrected) wall
+        clock = EpochClock("127.0.0.1:1", timeout=0.2)
+        assert not clock.sync()
+        assert abs(clock.epoch_us() - time.time() * 1e6) < 200_000
+
+
+def _skewed_clock_factory(ntp_port, skews):
+    """Replacement for elements.mqtt._epoch_clock giving each element a
+    deliberately wrong wall clock (per element name) — the two-skewed-hosts
+    scenario in one process."""
+
+    def make(element):
+        skew = skews.get(element.name, 0.0)
+        wall = lambda: time.time() + skew  # noqa: E731
+        clock = EpochClock(
+            f"127.0.0.1:{ntp_port}" if element.props["ntp_sync"] else "",
+            wall=wall)
+        if element.props["ntp_sync"]:
+            assert clock.sync(), "fake NTP server did not answer"
+        return clock
+
+    return make
+
+
+def _run_pub_sub(monkeypatch, ntp_port, skews, ntp_sync):
+    monkeypatch.setattr(mqtt_el, "_epoch_clock",
+                        _skewed_clock_factory(ntp_port, skews))
+    sync = "true" if ntp_sync else "false"
+    pub = parse_launch(
+        "tensor_src num-buffers=40 framerate=20/1 dimensions=4 types=float32 "
+        "pattern=counter "
+        "! mqttsink name=pub pub-topic=clocksync broker=embedded port=0 "
+        f"ntp-sync={sync}")
+    pub.play()
+    port = pub.get("pub").bound_port
+    time.sleep(0.5)  # publisher runs ~10 frames before the subscriber exists
+    sub = parse_launch(
+        f"mqttsrc name=sub port={port} sub-topic=clocksync ntp-sync={sync} "
+        "! tensor_sink name=out max-stored=0")
+    got = []
+    sub.get("out").connect(got.append)
+    sub.play()
+    deadline = time.monotonic() + 10
+    while len(got) < 10 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    pub.stop()
+    sub.stop()
+    assert len(got) >= 10, f"only {len(got)} frames crossed the broker"
+    return got
+
+
+class TestCrossHostAlignment:
+    def test_skewed_hosts_reconstruct_pts_with_ntp(self, monkeypatch, ntp_server):
+        """Publisher host 4 s slow, subscriber host 3 s fast; with ntp-sync
+        both correct to true time and the subscriber's pts land in its own
+        running time (small positive values), not ±7 s off."""
+        got = _run_pub_sub(monkeypatch, ntp_server.port,
+                           {"pub": -4.0, "sub": +3.0}, ntp_sync=True)
+        pts = [b.pts for b in got if b.pts is not None]
+        assert len(pts) >= 5, "aligned frames should carry timestamps"
+        assert all(-0.1 <= p <= 5.0 for p in pts), f"pts out of range: {pts[:5]}"
+        assert pts == sorted(pts), "reconstructed pts must stay monotonic"
+        # latency meta is computable once both clocks agree
+        lats = [b.meta.get("mqtt_latency_us") for b in got]
+        assert any(l is not None and -100_000 < l < 2_000_000 for l in lats)
+
+    def test_skewed_hosts_without_ntp_lose_timestamps(self, monkeypatch,
+                                                      ntp_server):
+        """Negative control: same skews, no ntp-sync — the publisher's
+        frames appear sent 'before' the subscriber started (7 s clock gap),
+        so per reference semantics their pts are dropped to None rather
+        than silently wrong."""
+        got = _run_pub_sub(monkeypatch, ntp_server.port,
+                           {"pub": -4.0, "sub": +3.0}, ntp_sync=False)
+        assert all(b.pts is None for b in got)
