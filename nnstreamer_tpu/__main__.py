@@ -35,6 +35,8 @@ def _cmd_launch(args) -> int:
     timeout = args.timeout if args.timeout is not None else 86400.0
     msg = pipe.bus.wait_for((MessageType.EOS, MessageType.ERROR),
                             timeout=timeout)
+    if args.latency:
+        print(json.dumps(pipe.query_latency()))
     pipe.stop()
     if msg is None:
         print("timeout waiting for EOS", file=sys.stderr)
@@ -171,6 +173,8 @@ def main(argv=None) -> int:
     p = sub.add_parser("launch", help="run a pipeline (gst-launch analog)")
     p.add_argument("pipeline", help="launch text, .json, or .launch file")
     p.add_argument("--timeout", type=float, default=None)
+    p.add_argument("--latency", action="store_true",
+                   help="print the pipeline LATENCY query (JSON) at EOS")
     p.set_defaults(fn=_cmd_launch)
 
     p = sub.add_parser("inspect", help="list elements / show one (gst-inspect)")

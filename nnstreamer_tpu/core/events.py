@@ -49,6 +49,8 @@ class MessageType(enum.Enum):
     WARNING = "warning"
     EOS = "eos"
     ELEMENT = "element"          # element-specific payload (trainer progress...)
+    LATENCY = "latency"          # an element's latency estimate changed:
+    # re-run Pipeline.query_latency() (reference gst_message_new_latency)
     STATE_CHANGED = "state-changed"
 
 

@@ -45,19 +45,18 @@ SPARSE_META_KEY = "sparse_specs"
 
 
 # ndarrays in meta coerce to JSON lists only up to this many elements;
-# anything larger (e.g. a full segmentation class_map) would inflate every
-# frame with megabytes of JSON text — ship it as a tensor instead
+# anything larger (e.g. the image-segment decoder's full H×W class_map,
+# an in-process convenience) would inflate every frame with megabytes of
+# JSON text — such keys are dropped from the wire with a warning (ship
+# large arrays as tensors); all OTHER non-serializable meta raises
 _META_ARRAY_MAX = 256
+_warned_meta_keys = set()
 
 
 def _meta_default(o):
     if isinstance(o, np.generic):
         return o.item()
     if isinstance(o, np.ndarray):
-        if o.size > _META_ARRAY_MAX:
-            raise TypeError(
-                f"ndarray of {o.size} elements in meta (>{_META_ARRAY_MAX}); "
-                "send large arrays as tensors, not meta")
         return o.tolist()
     if isinstance(o, (set, frozenset)):
         return sorted(o)
@@ -66,8 +65,23 @@ def _meta_default(o):
 
 def _encode_meta(meta: dict) -> bytes:
     """JSON-encode buffer meta, coercing numpy values; raise naming the
-    offending keys instead of silently dropping them."""
-    items = {str(k): v for k, v in meta.items() if k != SPARSE_META_KEY}
+    offending keys instead of silently dropping them. Oversized ndarray
+    values are dropped loudly (warning, once per key)."""
+    from ..utils.log import logger
+
+    items = {}
+    for k, v in meta.items():
+        if k == SPARSE_META_KEY:
+            continue  # carried in the per-tensor headers
+        if isinstance(v, np.ndarray) and v.size > _META_ARRAY_MAX:
+            if k not in _warned_meta_keys:
+                _warned_meta_keys.add(k)
+                logger.warning(
+                    "meta['%s'] (%d-element ndarray) dropped from the wire: "
+                    "arrays >%d elements must travel as tensors, not meta",
+                    k, v.size, _META_ARRAY_MAX)
+            continue
+        items[str(k)] = v
     try:
         return json.dumps(items, default=_meta_default).encode()
     except (TypeError, ValueError):

@@ -120,10 +120,18 @@ class TensorFilter(TransformElement):
     # (reference gst_tensor_parse_config_file); _apply_config_file below
     # additionally routes non-property lines into custom options.
 
+    # LATENCY-query tuning (reference tensor_filter.c:110-120): headroom
+    # padded onto the reported estimate to limit re-report churn while
+    # tracking a maximum; threshold of downward deviation that still
+    # forces a re-report
+    LATENCY_REPORT_HEADROOM = 0.05
+    LATENCY_REPORT_THRESHOLD = 0.25
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.backend: Optional[FilterBackend] = None
         self.stats = InvokeStats()
+        self._latency_reported = 0.0  # last value handed to a LATENCY query
         self._in_info: Optional[TensorsInfo] = None
         self._out_info: Optional[TensorsInfo] = None
         self._throttle_delay_s = 0.0
@@ -384,7 +392,38 @@ class TensorFilter(TransformElement):
         out = Buffer(list(outputs)).copy_metadata_from(buf)
         if self.props["latency_report"]:
             self.post_message(MessageType.ELEMENT, **self.stats.snapshot())
+            self._track_latency()
         return out
+
+    # -- pipeline LATENCY query (reference tensor_filter.c:366-510,1386) ----
+    def _estimated_latency_s(self) -> float:
+        """Current invoke latency estimate: sampled device-complete time
+        when available, host dispatch time otherwise."""
+        est = self.stats.recent_device_latency_s
+        return est if est > 0 else self.stats.recent_latency_s
+
+    def _track_latency(self) -> None:
+        """Post a LATENCY bus message when the estimate outgrows the last
+        reported value or sinks >25% below it, prompting the app to re-run
+        Pipeline.query_latency() (reference track_latency)."""
+        estimated = self._estimated_latency_s()
+        if estimated <= 0:
+            return
+        reported = self._latency_reported
+        deviation = abs(estimated - reported) / reported if reported > 0 else 0.0
+        if estimated > reported or deviation > self.LATENCY_REPORT_THRESHOLD:
+            self.post_message(MessageType.LATENCY,
+                              estimated_s=estimated, reported_s=reported)
+
+    def report_latency(self):
+        if not self.props["latency_report"]:
+            return None
+        estimated = self._estimated_latency_s()
+        if estimated <= 0:
+            return None
+        latency = estimated * (1 + self.LATENCY_REPORT_HEADROOM)
+        self._latency_reported = latency
+        return latency
 
     # -- runtime model control ----------------------------------------------
     @property

@@ -20,7 +20,11 @@ payload, gst/mqtt/mqttcommon.h:49-61). Own design:
     re-anchors pts into its own running time exactly like the reference's
     ``_put_timestamp_on_gst_buf`` (mqttsrc.c:1380-1404): frames sent
     before the subscriber started lose their timestamp, negative results
-    are dropped to None.
+    are dropped to None. Stamping/re-anchoring happens whether or not
+    ntp-sync is on (reference parity: the non-NTP default stamps with the
+    raw wall clock via g_get_real_time), so across hosts with unsynced
+    clocks the pts error equals the clock skew — enable ntp-sync to
+    bound it.
 """
 from __future__ import annotations
 

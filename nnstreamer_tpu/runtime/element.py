@@ -222,6 +222,14 @@ class Element:
             pad.got_eos = False
             pad.caps = None
 
+    # -- latency ------------------------------------------------------------
+    def report_latency(self):
+        """This element's contribution (seconds) to the pipeline LATENCY
+        query, or None if it adds none / doesn't report (reference:
+        GST_QUERY_LATENCY handling — elements add their processing latency
+        as the query travels upstream, tensor_filter.c:1386-1418)."""
+        return None
+
     # -- messages -----------------------------------------------------------
     def post_message(self, msg_type: MessageType, **data) -> None:
         if self.pipeline is not None:

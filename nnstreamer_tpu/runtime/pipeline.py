@@ -120,6 +120,47 @@ class Pipeline:
     def playing(self) -> bool:
         return self._playing
 
+    # -- LATENCY query -------------------------------------------------------
+    def query_latency(self) -> dict:
+        """Pipeline-wide latency answer (reference GST_QUERY_LATENCY as
+        driven by tensor_filter's latency-report,
+        tensor_filter.c:1386-1418): the query conceptually travels from
+        each sink upstream, every element adding its ``report_latency()``
+        contribution (tensor_filter pads its estimate with 5% headroom and
+        remembers what it reported, so LATENCY bus messages only fire when
+        the estimate escapes that headroom). Returns::
+
+            {"latency_s": worst sink-to-source path total,
+             "per_element": {name: contribution_s},   # reporting elements
+             "per_sink": {sink_name: path_total_s}}
+        """
+        per_element: Dict[str, float] = {}
+        memo: Dict[str, float] = {}
+
+        def upstream(el: Element, on_path: frozenset) -> float:
+            if el.name in memo:
+                return memo[el.name]
+            if el.name in on_path:
+                return 0.0  # feedback loop (tensor_repo): cut the cycle
+            own = el.report_latency()
+            if own is not None:
+                per_element[el.name] = own
+            branches = [
+                upstream(pad.peer.element, on_path | {el.name})
+                for pad in el.sink_pads
+                if pad.peer is not None and pad.peer.element is not None
+            ]
+            total = (own or 0.0) + (max(branches) if branches else 0.0)
+            memo[el.name] = total
+            return total
+
+        per_sink = {s.name: upstream(s, frozenset()) for s in self.sinks}
+        return {
+            "latency_s": max(per_sink.values()) if per_sink else 0.0,
+            "per_element": per_element,
+            "per_sink": per_sink,
+        }
+
     def _validate_links(self) -> None:
         for el in self.elements.values():
             for pad in el.sink_pads:
