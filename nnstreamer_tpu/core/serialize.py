@@ -57,6 +57,12 @@ def _meta_default(o):
     if isinstance(o, np.generic):
         return o.item()
     if isinstance(o, np.ndarray):
+        if o.size > _META_ARRAY_MAX:
+            # nested inside a list/dict value the top-level drop can't see:
+            # refuse loudly rather than inflate the frame
+            raise TypeError(
+                f"ndarray of {o.size} elements nested in meta "
+                f"(>{_META_ARRAY_MAX}); ship large arrays as tensors")
         return o.tolist()
     if isinstance(o, (set, frozenset)):
         return sorted(o)

@@ -132,6 +132,7 @@ class TensorFilter(TransformElement):
         self.backend: Optional[FilterBackend] = None
         self.stats = InvokeStats()
         self._latency_reported = 0.0  # last value handed to a LATENCY query
+        self._latency_posted = 0.0    # estimate last announced on the bus
         self._in_info: Optional[TensorsInfo] = None
         self._out_info: Optional[TensorsInfo] = None
         self._throttle_delay_s = 0.0
@@ -405,15 +406,24 @@ class TensorFilter(TransformElement):
     def _track_latency(self) -> None:
         """Post a LATENCY bus message when the estimate outgrows the last
         reported value or sinks >25% below it, prompting the app to re-run
-        Pipeline.query_latency() (reference track_latency)."""
+        Pipeline.query_latency() (reference track_latency). One message per
+        announcement: re-posts only once the estimate escapes what was
+        already announced, so an app that never queries isn't flooded."""
         estimated = self._estimated_latency_s()
         if estimated <= 0:
             return
         reported = self._latency_reported
         deviation = abs(estimated - reported) / reported if reported > 0 else 0.0
-        if estimated > reported or deviation > self.LATENCY_REPORT_THRESHOLD:
-            self.post_message(MessageType.LATENCY,
-                              estimated_s=estimated, reported_s=reported)
+        if not (estimated > reported or deviation > self.LATENCY_REPORT_THRESHOLD):
+            return
+        posted = self._latency_posted
+        if posted > 0 and (
+                abs(estimated - posted) / posted <= self.LATENCY_REPORT_THRESHOLD
+                and estimated <= posted * (1 + self.LATENCY_REPORT_HEADROOM)):
+            return  # this estimate was already announced; await the query
+        self._latency_posted = estimated
+        self.post_message(MessageType.LATENCY,
+                          estimated_s=estimated, reported_s=reported)
 
     def report_latency(self):
         if not self.props["latency_report"]:
@@ -423,6 +433,7 @@ class TensorFilter(TransformElement):
             return None
         latency = estimated * (1 + self.LATENCY_REPORT_HEADROOM)
         self._latency_reported = latency
+        self._latency_posted = 0.0  # the app reacted; re-arm announcements
         return latency
 
     # -- runtime model control ----------------------------------------------

@@ -3,18 +3,27 @@ mesh axis (EP).
 
 The reference has no expert parallelism (SURVEY.md §2.9: its nearest
 analog is per-frame conditional routing via tensor_if/demux); this is the
-TPU-native treatment: switch (top-1) routing expressed as DENSE one-hot
-dispatch/combine einsums — static shapes, no data-dependent gathers, so
-XLA tiles everything onto the MXU — with the expert dimension sharded
-over a mesh axis via sharding constraints, letting GSPMD insert the
-all_to_all family of collectives over ICI (the GShard/Switch formulation
-re-derived for this runtime).
+TPU-native treatment: switch (top-1) routing with the expert dimension
+sharded over a mesh axis via sharding constraints, letting GSPMD insert
+the all_to_all family of collectives over ICI (the GShard/Switch
+formulation re-derived for this runtime).
 
-Capacity semantics: each expert processes at most
-``ceil(tokens/experts * capacity_factor)`` tokens; overflow tokens fall
-through the residual connection (contribute zero from the MoE branch) —
-the standard load-shedding stance, matching the framework's QoS
-philosophy.
+Two dispatch forms, identical token→slot assignment:
+
+* ``dispatch="scatter"`` (default) — capacity-based scatter/gather:
+  tokens scatter-add into a flat (E·C, D) slot buffer (overflow indices
+  drop via out-of-bounds ``mode="drop"``) and gather back after expert
+  compute. O(T·D) dispatch work — the scalable form at large E.
+* ``dispatch="dense"`` — one-hot (T, E, C) dispatch/combine einsums.
+  O(T·E·C) but all-matmul; can win at tiny E where the MXU eats the
+  einsum for free. Kept as the equivalence oracle.
+
+Both are static-shape and jit-safe. Capacity semantics: each expert
+processes at most ``ceil(tokens/experts * capacity_factor)`` tokens;
+overflow tokens fall through the residual connection (contribute zero
+from the MoE branch) — the standard load-shedding stance, matching the
+framework's QoS philosophy. Priority is token order (first-come), so the
+two forms drop the SAME tokens.
 """
 from __future__ import annotations
 
@@ -48,22 +57,59 @@ def moe_pspecs(ep_axis: str = "ep"):
     }
 
 
+def _route(params, xt, C: int):
+    """Shared switch routing: per-token expert choice, gate, capacity slot,
+    and keep mask. Token order is the drop priority, so every dispatch
+    form built on this assigns identical slots."""
+    import jax
+    import jax.numpy as jnp
+
+    E = params["wr"].shape[1]
+    # routing bookkeeping stays float32 regardless of activation dtype:
+    # bf16 cumsum counters round above 256 and would collide capacity slots
+    logits = (xt.astype(jnp.float32) @ params["wr"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = probs.max(axis=-1)                  # (T,)
+    expert = probs.argmax(axis=-1)             # (T,)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)       # (T, E)
+    # position of each token within its expert's capacity buffer
+    pos_e = (jnp.cumsum(onehot, axis=0) - onehot) * onehot      # (T, E)
+    pos = pos_e.sum(-1).astype(jnp.int32)                       # (T,)
+    keep = pos < C                                              # (T,) bool
+    return logits, gate, expert, onehot, pos, keep
+
+
+def _expert_compute(params, expert_in, constrain, ep_axis):
+    """Batched per-expert FFN over (E, C, D), experts sharded on ep."""
+    import jax
+    import jax.numpy as jnp
+
+    expert_in = constrain(expert_in, ep_axis, None, None)
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))
+    h = constrain(h, ep_axis, None, None)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])    # (E, C, D)
+    return constrain(expert_out, ep_axis, None, None)
+
+
 def moe_ffn(params: Dict[str, Any], x, mesh=None, ep_axis: str = "ep",
-            capacity_factor: float = 1.25, return_aux: bool = False):
+            capacity_factor: float = 1.25, return_aux: bool = False,
+            dispatch: str = "scatter"):
     """Switch-routed expert FFN. ``x`` (..., D) → (..., D), or
     ``(y, aux_loss)`` with ``return_aux`` (wire the load-balance loss into
     training or the router can collapse onto one expert).
 
-    Dense dispatch: a (T, E, C) one-hot tensor carries each token to its
-    expert slot; expert compute is one batched einsum over (E, C, D); the
-    combine einsum weights results by the router gate. With ``mesh``, the
-    (E, ...) tensors are constrained to ``ep_axis`` so expert compute and
-    weights live together per chip and GSPMD moves tokens, not experts.
+    ``dispatch="scatter"`` routes tokens through a flat (E·C, D) slot
+    buffer with scatter-add/gather (O(T·D)); ``"dense"`` uses the one-hot
+    (T, E, C) einsum form (O(T·E·C)). With ``mesh``, the (E, ...) tensors
+    are constrained to ``ep_axis`` so expert compute and weights live
+    together per chip and GSPMD moves tokens, not experts.
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if dispatch not in ("scatter", "dense"):
+        raise ValueError(f"dispatch must be 'scatter' or 'dense', got {dispatch!r}")
     orig_shape = x.shape
     D = orig_shape[-1]
     xt = x.reshape(-1, D)                      # (T, D)
@@ -76,29 +122,29 @@ def moe_ffn(params: Dict[str, Any], x, mesh=None, ep_axis: str = "ep",
             return t
         return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, P(*spec)))
 
-    # routing bookkeeping stays float32 regardless of activation dtype:
-    # bf16 cumsum counters round above 256 and would collide capacity slots
-    logits = (xt.astype(jnp.float32) @ params["wr"].astype(jnp.float32))
-    probs = jax.nn.softmax(logits, axis=-1)
-    gate = probs.max(axis=-1)                  # (T,)
-    expert = probs.argmax(axis=-1)             # (T,)
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)       # (T, E)
-    # position of each token within its expert's capacity buffer
-    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot        # (T, E)
-    keep = (pos < C) * onehot                                   # drop overflow
-    pos_oh = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
-                            dtype=jnp.float32)                  # (T, C)
-    dispatch = (keep[:, :, None] * pos_oh[:, None, :]).astype(xt.dtype)
+    logits, gate, expert, onehot, pos, keep = _route(params, xt, C)
 
-    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)         # (E, C, D)
-    expert_in = constrain(expert_in, ep_axis, None, None)
-    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))
-    h = constrain(h, ep_axis, None, None)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])    # (E, C, D)
-    expert_out = constrain(expert_out, ep_axis, None, None)
-
-    combine = dispatch * gate.astype(xt.dtype)[:, None, None]   # (T, E, C)
-    y = jnp.einsum("tec,ecd->td", combine, expert_out).reshape(orig_shape)
+    if dispatch == "dense":
+        keep_e = keep[:, None] * onehot                             # (T, E)
+        pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)          # (T, C)
+        disp = (keep_e[:, :, None] * pos_oh[:, None, :]).astype(xt.dtype)
+        expert_in = jnp.einsum("tec,td->ecd", disp, xt)             # (E, C, D)
+        expert_out = _expert_compute(params, expert_in, constrain, ep_axis)
+        combine = disp * gate.astype(xt.dtype)[:, None, None]       # (T, E, C)
+        y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    else:
+        # flat slot id; overflow tokens get an out-of-range index that the
+        # scatter drops and the gather masks
+        slot = jnp.where(keep, expert * C + pos, E * C)             # (T,)
+        expert_in = (
+            jnp.zeros((E * C, D), xt.dtype)
+            .at[slot].add(xt, mode="drop")
+            .reshape(E, C, D))
+        expert_out = _expert_compute(params, expert_in, constrain, ep_axis)
+        flat_out = expert_out.reshape(E * C, D)
+        gathered = jnp.take(flat_out, jnp.minimum(slot, E * C - 1), axis=0)
+        y = gathered * (gate * keep).astype(xt.dtype)[:, None]
+    y = y.reshape(orig_shape)
     if return_aux:
         return y, load_balance_loss(logits, expert)
     return y

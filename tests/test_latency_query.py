@@ -155,8 +155,22 @@ class TestLatencyQuery:
         assert q["per_sink"]["out"] > q["per_sink"]["out2"] > 0
         assert q["latency_s"] == pytest.approx(q["per_sink"]["out"])
 
-    def test_repo_feedback_loop_terminates(self):
-        """A tensor_repo feedback cycle must not hang the query walk."""
+    def test_pad_cycle_terminates(self):
+        """A genuine pad-graph cycle (mux ← tee feedback, the launch-string
+        analog of a tensor_repo loop wired through pads) must not recurse
+        the query walk forever."""
+        pipe = parse_launch(
+            "tensor_mux name=m ! tee name=t "
+            "t. ! tensor_sink name=out max-stored=1 "
+            "t. ! queue ! m.sink_1 "
+            "tensor_src num-buffers=2 dimensions=4 types=float32 ! m.sink_0")
+        q = pipe.query_latency()  # must return, not recurse forever
+        assert "latency_s" in q and "out" in q["per_sink"]
+
+    def test_repo_feedback_pipeline_queries_cleanly(self):
+        """tensor_repo feedback travels through the slot table (not pads),
+        so its pipeline is a straight chain to the walk — still worth
+        pinning that the query answers on it."""
         register_custom_easy("lat_id", lambda t: t)
         try:
             pipe = parse_launch(
@@ -164,7 +178,7 @@ class TestLatencyQuery:
                 "caps=other/tensors,format=static,dimensions=4,types=float32 "
                 "! tensor_filter framework=custom-easy model=lat_id name=f "
                 "! tensor_repo_sink slot-index=9")
-            q = pipe.query_latency()  # must return, not recurse forever
+            q = pipe.query_latency()
             assert "latency_s" in q
         finally:
             unregister_custom_easy("lat_id")

@@ -41,34 +41,64 @@ def _reference_moe(params, x, capacity):
 
 
 class TestMoeFfn:
-    def test_matches_per_token_oracle(self):
+    @pytest.mark.parametrize("dispatch", ["scatter", "dense"])
+    def test_matches_per_token_oracle(self, dispatch):
         import math
 
         params = _params()
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 8), jnp.float32)
-        y = moe_ffn(params, x, capacity_factor=1.25)
+        y = moe_ffn(params, x, capacity_factor=1.25, dispatch=dispatch)
         capacity = max(1, math.ceil(12 / 4 * 1.25))
         ref = _reference_moe(params, x, capacity)
         assert np.allclose(np.asarray(y), ref, atol=1e-5)
 
-    def test_capacity_overflow_drops_tokens(self):
+    @pytest.mark.parametrize("dispatch", ["scatter", "dense"])
+    def test_capacity_overflow_drops_tokens(self, dispatch):
         params = _params(experts=2)
         # force all tokens to expert 0 by biasing the router
         params = dict(params)
         params["wr"] = jnp.zeros_like(params["wr"]).at[:, 0].set(10.0)
         x = jnp.ones((1, 8, 8), jnp.float32)
-        y = moe_ffn(params, x, capacity_factor=0.25)  # capacity = 1
+        y = moe_ffn(params, x, capacity_factor=0.25,  # capacity = 1
+                    dispatch=dispatch)
         contributions = np.abs(np.asarray(y)).sum(-1).reshape(-1)
         assert (contributions > 1e-9).sum() == 1  # only 1 token fits
 
-    def test_sharded_matches_unsharded(self):
+    @pytest.mark.parametrize("capacity_factor", [0.25, 0.75, 1.0, 2.0])
+    def test_scatter_equals_dense_including_drops(self, capacity_factor):
+        """The scalable scatter form and the one-hot einsum oracle must
+        assign (and drop) exactly the same tokens at every capacity."""
+        params = _params(experts=4, seed=7)
+        x = jax.random.normal(jax.random.PRNGKey(11), (3, 10, 8), jnp.float32)
+        ys = moe_ffn(params, x, capacity_factor=capacity_factor,
+                     dispatch="scatter")
+        yd = moe_ffn(params, x, capacity_factor=capacity_factor,
+                     dispatch="dense")
+        assert np.allclose(np.asarray(ys), np.asarray(yd), atol=1e-5)
+
+    def test_scatter_equals_dense_under_jit_bf16(self):
+        params = _params(experts=4, seed=3)
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 16, 8), jnp.bfloat16)
+        ys = jax.jit(lambda p, a: moe_ffn(p, a, dispatch="scatter"))(params, x)
+        yd = jax.jit(lambda p, a: moe_ffn(p, a, dispatch="dense"))(params, x)
+        assert ys.dtype == yd.dtype  # both promote through the f32 experts
+        assert np.allclose(np.asarray(ys, np.float32),
+                           np.asarray(yd, np.float32), atol=2e-2)
+
+    def test_bad_dispatch_rejected(self):
+        with pytest.raises(ValueError, match="dispatch"):
+            moe_ffn(_params(), jnp.ones((2, 8)), dispatch="magic")
+
+    @pytest.mark.parametrize("dispatch", ["scatter", "dense"])
+    def test_sharded_matches_unsharded(self, dispatch):
         mesh = make_mesh(jax.devices(), {"dp": 2, "ep": 4})
         params = _params(experts=4)
         x = jax.random.normal(jax.random.PRNGKey(2), (4, 8, 8), jnp.float32)
-        dense = np.asarray(moe_ffn(params, x))
+        unsharded = np.asarray(moe_ffn(params, x, dispatch=dispatch))
         sharded = jax.jit(
-            lambda p, a: moe_ffn(p, a, mesh=mesh, ep_axis="ep"))(params, x)
-        assert np.allclose(np.asarray(sharded), dense, atol=1e-5)
+            lambda p, a: moe_ffn(p, a, mesh=mesh, ep_axis="ep",
+                                 dispatch=dispatch))(params, x)
+        assert np.allclose(np.asarray(sharded), unsharded, atol=1e-5)
 
     def test_load_balance_loss_bounds(self):
         params = _params()
