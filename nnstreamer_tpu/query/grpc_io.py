@@ -8,12 +8,24 @@ flatbuf IDL). TPU redesign: grpcio with *generic* bytes methods — the IDL is
 our own ``core/serialize`` tensor frame (already the wire format of the
 query/edge/mqtt layers), so no codegen step and one serialization everywhere.
 
-Service surface (bytes in/out, identity serializers):
-  /nnstreamer.Tensor/Send   client-streaming — remote pushes frames to us
-  /nnstreamer.Tensor/Recv   server-streaming — remote pulls our frame stream
+Service surface (bytes in/out, identity serializers). TWO IDLs:
 
-Each stream message is 1 tag byte + payload:
-  ``C`` caps string (always first), ``D`` serialized tensor frame, ``E`` EOS.
+* own wire (default client idl):
+    /nnstreamer.Tensor/Send   client-streaming — remote pushes frames to us
+    /nnstreamer.Tensor/Recv   server-streaming — remote pulls our stream
+  Each stream message is 1 tag byte + payload: ``C`` caps string (always
+  first), ``D`` serialized tensor frame (core/serialize — pts/meta/sparse
+  ride along), ``E`` EOS.
+
+* reference protobuf IDL (``idl=protobuf`` on the client role; servers
+  speak BOTH at once, so a reference peer connects unmodified):
+    /nnstreamer.protobuf.TensorService/SendTensors  (stream Tensors → Empty)
+    /nnstreamer.protobuf.TensorService/RecvTensors  (Empty → stream Tensors)
+  Messages are the reference's ``Tensors`` proto
+  (ext/nnstreamer/include/nnstreamer.proto, byte-level codec in
+  core/wire_protobuf). That IDL carries no caps/pts/meta channel: caps
+  derive from each message's dimension/type fields and stream close is
+  the EOS, matching the reference's semantics.
 
 Like the reference, BOTH elements speak BOTH roles (``server=true/false``):
   sink(server=false) --Send-->  src(server=true)     (push topology)
@@ -24,10 +36,16 @@ from __future__ import annotations
 import queue as _queue
 import threading
 from concurrent import futures
-from typing import Optional
+from typing import Optional, Tuple
 
-from ..core import Buffer, Caps, parse_caps_string
+import numpy as np
+
+from ..core import (Buffer, Caps, TensorFormat, TensorsInfo,
+                    caps_from_tensors_info, parse_caps_string,
+                    tensors_info_from_caps)
 from ..core.serialize import pack_tensors, unpack_tensors
+from ..core.tensors import TensorSpec
+from ..core.wire_protobuf import decode_tensors, encode_tensors
 from ..registry.elements import register_element
 from ..runtime.element import ElementError, Prop, SinkElement, SourceElement, prop_bool
 from ..runtime.pad import PadDirection, PadTemplate
@@ -36,6 +54,9 @@ from ..utils.log import logger
 _TENSOR_CAPS = Caps.new("other/tensors")
 SEND_METHOD = "/nnstreamer.Tensor/Send"
 RECV_METHOD = "/nnstreamer.Tensor/Recv"
+PB_SEND_METHOD = "/nnstreamer.protobuf.TensorService/SendTensors"
+PB_RECV_METHOD = "/nnstreamer.protobuf.TensorService/RecvTensors"
+IDLS = ("own", "protobuf")
 _IDENT = lambda b: bytes(b)  # noqa: E731 — identity (de)serializer
 
 
@@ -43,6 +64,37 @@ def _tag(msg: bytes) -> tuple:
     if not msg:
         raise ValueError("empty grpc tensor message")
     return msg[:1], msg[1:]
+
+
+def _check_idl(idl: str) -> str:
+    if idl not in IDLS:
+        raise ElementError(f"idl must be one of {IDLS}, got {idl!r}")
+    return idl
+
+
+def _buffer_to_pb(buf: Buffer, info: Optional[TensorsInfo] = None) -> bytes:
+    """Buffer → reference ``Tensors`` bytes; tensor names and stream format
+    come from the negotiated ``info`` when available."""
+    arrays = [np.ascontiguousarray(np.asarray(t))
+              for t in buf.as_numpy().tensors]
+    names = None
+    fmt = TensorFormat.STATIC
+    if info is not None:
+        fmt = info.format
+        if any(s.name for s in info.specs):
+            names = [s.name for s in info.specs]
+    return encode_tensors(arrays, names=names, fmt=fmt)
+
+
+def _pb_to_buffer(msg: bytes) -> Tuple[Buffer, Caps]:
+    """Reference ``Tensors`` message → (Buffer, caps derived from the
+    per-message dimension/type fields — the protobuf IDL's only config
+    channel)."""
+    arrays, names, fmt, _rate = decode_tensors(bytes(msg))
+    info = TensorsInfo(
+        tuple(TensorSpec(a.shape, a.dtype, name) for a, name in
+              zip(arrays, names)), fmt)
+    return Buffer([a.copy() for a in arrays]), caps_from_tensors_info(info)
 
 
 class GrpcTensorService:
@@ -61,31 +113,34 @@ class GrpcTensorService:
         self._caps_seen = threading.Event()
         self._stopped = threading.Event()
         self._subs_lock = threading.Lock()
-        self._subs: list = []                     # per-subscriber queues
+        self._subs: list = []                     # (queue, idl) per subscriber
+        self._pb_encode_warned = False
         self._grpc = grpc
+
+        def accept_caps(caps: Caps, context) -> None:
+            """Shared Send-side caps gate (both IDLs): always validate
+            against the CONFIGURED caps, never against what a previous
+            client happened to declare; learn the first accepted caps."""
+            with self._caps_lock:
+                expected = self.expected_caps
+                if expected is not None and not expected.can_intersect(caps):
+                    reject = True
+                else:
+                    reject = False
+                    if self.caps is None:
+                        self.caps = caps
+            if reject:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"caps {caps} rejected (server expects {expected})")
+            self._caps_seen.set()
 
         def send_handler(request_iterator, context):
             got_caps = False
             for msg in request_iterator:
                 tag, payload = _tag(msg)
                 if tag == b"C":
-                    caps = parse_caps_string(payload.decode())
-                    with self._caps_lock:
-                        # always validate against the CONFIGURED caps, never
-                        # against what a previous client happened to declare
-                        expected = self.expected_caps
-                        if expected is not None and not expected.can_intersect(caps):
-                            reject = True
-                        else:
-                            reject = False
-                            if self.caps is None:
-                                self.caps = caps
-                    if reject:
-                        context.abort(
-                            grpc.StatusCode.INVALID_ARGUMENT,
-                            f"caps {caps} rejected (server expects {expected})",
-                        )
-                    self._caps_seen.set()
+                    accept_caps(parse_caps_string(payload.decode()), context)
                     got_caps = True
                 elif tag == b"D":
                     if not got_caps:
@@ -97,10 +152,38 @@ class GrpcTensorService:
                     self._inbox_put(None, context)
             return b"ok"
 
-        def recv_handler(request, context):
+        def _register_sub(idl: str) -> _queue.Queue:
+            """Register the subscriber queue AT HANDLER ENTRY — frames/EOS
+            published while the handler still waits for caps must queue,
+            not vanish."""
             q: _queue.Queue = _queue.Queue(max_queued)
             with self._subs_lock:
-                self._subs.append(q)
+                self._subs.append((q, idl))
+            return q
+
+        def _unregister_sub(q, idl: str) -> None:
+            with self._subs_lock:
+                if (q, idl) in self._subs:
+                    self._subs.remove((q, idl))
+
+        def _drain(q, context):
+            """Yield queued payloads until EOS/stop. None = EOS marker."""
+            while True:
+                # bounded wait: the handler must exit when the service
+                # stops or the client hangs up, else its executor thread
+                # blocks process exit (concurrent.futures joins at atexit)
+                try:
+                    item = q.get(timeout=0.5)
+                except _queue.Empty:
+                    if self._stopped.is_set() or not context.is_active():
+                        return
+                    continue
+                yield item  # None = EOS marker, else payload bytes
+                if item is None:
+                    return
+
+        def recv_handler(request, context):
+            q = _register_sub("own")
             try:
                 # a subscriber may connect before the pipeline negotiated;
                 # hold the caps message until set_caps ran
@@ -108,24 +191,40 @@ class GrpcTensorService:
                     context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                                   "server pipeline has no negotiated caps yet")
                 yield b"C" + str(self._out_caps).encode()
-                while True:
-                    # bounded wait: the handler must exit when the service
-                    # stops or the client hangs up, else its executor thread
-                    # blocks process exit (concurrent.futures joins at atexit)
-                    try:
-                        item = q.get(timeout=0.5)
-                    except _queue.Empty:
-                        if self._stopped.is_set() or not context.is_active():
-                            return
-                        continue
-                    if item is None:
-                        yield b"E"
-                        return
-                    yield b"D" + bytes(item)
+                for item in _drain(q, context):
+                    yield b"E" if item is None else b"D" + bytes(item)
             finally:
-                with self._subs_lock:
-                    if q in self._subs:
-                        self._subs.remove(q)
+                _unregister_sub(q, "own")
+
+        def pb_send_handler(request_iterator, context):
+            """Reference SendTensors: stream of Tensors messages; caps come
+            from each message's own config fields, stream close is EOS."""
+            for msg in request_iterator:
+                try:
+                    buf, caps = _pb_to_buffer(msg)
+                except (ValueError, IndexError, KeyError) as e:
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                  f"bad Tensors message: {e}")
+                accept_caps(caps, context)
+                if not self._inbox_put(buf, context):
+                    return b""
+            self._inbox_put(None, context)  # stream close = EOS
+            return b""  # google.protobuf.Empty
+
+        def pb_recv_handler(request, context):
+            q = _register_sub("protobuf")
+            try:
+                # no caps preamble in this IDL: config rides in every
+                # message, but frames only exist once the pipeline negotiated
+                if not self._out_caps_set.wait(timeout=10.0):
+                    context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                  "server pipeline has no negotiated caps yet")
+                for item in _drain(q, context):
+                    if item is None:
+                        return  # EOS = end of stream (reference semantics)
+                    yield bytes(item)
+            finally:
+                _unregister_sub(q, "protobuf")
 
         handler = grpc.method_handlers_generic_handler(
             "nnstreamer.Tensor",
@@ -138,9 +237,22 @@ class GrpcTensorService:
                     response_serializer=_IDENT),
             },
         )
+        # the reference's service, hosted SIMULTANEOUSLY: a peer built
+        # against ext/nnstreamer/include/nnstreamer.proto connects as-is
+        pb_handler = grpc.method_handlers_generic_handler(
+            "nnstreamer.protobuf.TensorService",
+            {
+                "SendTensors": grpc.stream_unary_rpc_method_handler(
+                    pb_send_handler, request_deserializer=_IDENT,
+                    response_serializer=_IDENT),
+                "RecvTensors": grpc.unary_stream_rpc_method_handler(
+                    pb_recv_handler, request_deserializer=_IDENT,
+                    response_serializer=_IDENT),
+            },
+        )
         self._executor = futures.ThreadPoolExecutor(max_workers=8)
         self._server = grpc.server(self._executor)
-        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_generic_rpc_handlers((handler, pb_handler))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         if self.port == 0:
             raise ElementError(f"grpc: cannot bind {host}:{port}")
@@ -172,18 +284,42 @@ class GrpcTensorService:
         return self.caps
 
     def publish(self, buf: Optional[Buffer]) -> None:
-        """Fan a frame (or None = EOS) out to every Recv subscriber.
+        """Fan a frame (or None = EOS) out to every Recv subscriber,
+        encoded per subscriber idl (lazily, once per idl in use).
 
         Live-stream semantics: a slow subscriber drops its oldest frame
         rather than backpressuring the pipeline's render thread (a blocking
         put here would also deadlock stop(), which publishes the EOS)."""
-        payload = None if buf is None else pack_tensors(buf)
         with self._subs_lock:
             subs = list(self._subs)
-        for q in subs:
+        _skip = object()  # frame unencodable for this idl: skip those subs
+        payloads: dict = {}
+        for q, idl in subs:
+            if idl not in payloads:
+                if buf is None:
+                    payloads[idl] = None
+                elif idl == "protobuf":
+                    try:
+                        info = (tensors_info_from_caps(self._out_caps)
+                                if self._out_caps is not None else None)
+                        payloads[idl] = _buffer_to_pb(buf, info)
+                    except ValueError as e:
+                        # e.g. bfloat16: not on the reference wire — a
+                        # connected pb peer must not kill the pipeline or
+                        # starve the own-wire subscribers
+                        if not self._pb_encode_warned:
+                            self._pb_encode_warned = True
+                            logger.warning(
+                                "grpc: frame not representable in the "
+                                "protobuf IDL, skipping pb subscribers: %s", e)
+                        payloads[idl] = _skip
+                else:
+                    payloads[idl] = pack_tensors(buf)
+            if payloads[idl] is _skip:
+                continue
             while True:
                 try:
-                    q.put_nowait(payload)
+                    q.put_nowait(payloads[idl])
                     break
                 except _queue.Full:
                     try:
@@ -199,24 +335,37 @@ class GrpcTensorService:
 
 
 class GrpcTensorClient:
-    """Client side of both methods."""
+    """Client side of both methods, in either IDL (``idl="protobuf"``
+    speaks the reference's TensorService, e.g. to a reference server)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 idl: str = "own"):
         import grpc
 
         self._grpc = grpc
+        self._idl = _check_idl(idl)
+        self._timeout = timeout
         self._channel = grpc.insecure_channel(f"{host}:{port}")
         grpc.channel_ready_future(self._channel).result(timeout=timeout)
         self._send_q: Optional[_queue.Queue] = None
+        self._send_info: Optional[TensorsInfo] = None
         self._send_future = None
         self._recv_call = None
 
     # -- push topology: we stream frames to a remote Send ------------------
     def start_send(self, caps: Caps) -> None:
         self._send_q = _queue.Queue(64)
-        self._send_q.put(b"C" + str(caps).encode())
+        if self._idl == "protobuf":
+            method = PB_SEND_METHOD  # no caps preamble in this IDL
+            try:  # names/format for the Tensors messages
+                self._send_info = tensors_info_from_caps(caps)
+            except (ValueError, KeyError):
+                self._send_info = None
+        else:
+            method = SEND_METHOD
+            self._send_q.put(b"C" + str(caps).encode())
         stub = self._channel.stream_unary(
-            SEND_METHOD, request_serializer=_IDENT, response_deserializer=_IDENT)
+            method, request_serializer=_IDENT, response_deserializer=_IDENT)
 
         def gen():
             while True:
@@ -228,17 +377,59 @@ class GrpcTensorClient:
         self._send_future = stub.future(gen())
 
     def send(self, buf: Buffer) -> None:
-        self._send_q.put(b"D" + bytes(pack_tensors(buf)))
+        if self._idl == "protobuf":
+            self._send_q.put(_buffer_to_pb(buf, self._send_info))
+        else:
+            self._send_q.put(b"D" + bytes(pack_tensors(buf)))
 
     def finish_send(self, timeout: float = 10.0) -> None:
-        self._send_q.put(b"E")
-        self._send_q.put(None)
+        if self._idl != "protobuf":
+            self._send_q.put(b"E")
+        self._send_q.put(None)  # close the request stream (pb: EOS itself)
         if self._send_future is not None:
             self._send_future.result(timeout=timeout)
 
     # -- pull topology: we consume a remote Recv stream --------------------
     def recv_stream(self):
         """Yields (caps, iterator-of-Buffer-or-None)."""
+        if self._idl == "protobuf":
+            stub = self._channel.unary_stream(
+                PB_RECV_METHOD, request_serializer=_IDENT,
+                response_deserializer=_IDENT)
+            stream = stub(b"")  # google.protobuf.Empty
+            self._recv_call = stream
+            # caps derive from the first Tensors message's config fields;
+            # bound the wait (gRPC streams have no timed next, and an RPC
+            # deadline would kill the whole long-lived stream)
+            box: _queue.Queue = _queue.Queue(1)
+
+            def _first():
+                try:
+                    box.put(("ok", next(stream)))
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    box.put(("err", e))
+
+            threading.Thread(target=_first, daemon=True).start()
+            try:
+                kind, val = box.get(timeout=self._timeout)
+            except _queue.Empty:
+                stream.cancel()
+                raise ConnectionError(
+                    f"grpc pb Recv: no frame within {self._timeout}s "
+                    "(remote negotiated but never published?)")
+            if kind == "err":
+                raise ConnectionError(
+                    f"grpc pb Recv stream ended before the first frame: {val}")
+            first_buf, caps = _pb_to_buffer(val)
+
+            def pb_frames():
+                yield first_buf
+                for msg in stream:
+                    buf, _caps = _pb_to_buffer(msg)
+                    yield buf
+                yield None  # stream close = EOS
+
+            return caps, pb_frames()
         stub = self._channel.unary_stream(
             RECV_METHOD, request_serializer=_IDENT, response_deserializer=_IDENT)
         stream = stub(b"")
@@ -285,6 +476,9 @@ class TensorSrcGrpc(SourceElement):
         "port": Prop(0, int, "listen/connect port (0 server = ephemeral)"),
         "caps": Prop(None, str, "expected caps (optional in server mode)"),
         "timeout": Prop(10.0, float, "caps handshake timeout"),
+        "idl": Prop("own", str,
+                    "client-role wire: own | protobuf (reference "
+                    "TensorService IDL); servers host both at once"),
     }
 
     def __init__(self, name=None, **props):
@@ -311,7 +505,8 @@ class TensorSrcGrpc(SourceElement):
                     "(set the caps property to negotiate before connect)")
             return got
         self._client = GrpcTensorClient(self.props["host"], self.props["port"],
-                                        self.props["timeout"])
+                                        self.props["timeout"],
+                                        idl=self.props["idl"])
         caps, self._frames = self._client.recv_stream()
         return caps
 
@@ -360,6 +555,9 @@ class TensorSinkGrpc(SinkElement):
         "host": Prop("127.0.0.1", str),
         "port": Prop(0, int, "connect/listen port (0 server = ephemeral)"),
         "timeout": Prop(10.0, float, "connect timeout"),
+        "idl": Prop("own", str,
+                    "client-role wire: own | protobuf (reference "
+                    "TensorService IDL); servers host both at once"),
     }
 
     def __init__(self, name=None, **props):
@@ -385,7 +583,8 @@ class TensorSinkGrpc(SinkElement):
                     pass
                 self._client.close()
             self._client = GrpcTensorClient(self.props["host"], self.props["port"],
-                                            self.props["timeout"])
+                                            self.props["timeout"],
+                                            idl=self.props["idl"])
             self._client.start_send(caps)
 
     def render(self, buf: Buffer) -> None:

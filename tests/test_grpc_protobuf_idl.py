@@ -1,0 +1,253 @@
+"""gRPC protobuf-IDL interop (VERDICT r02 missing #4).
+
+Reference analog: the reference's gRPC elements speak the protobuf IDL of
+``ext/nnstreamer/include/nnstreamer.proto`` (service TensorService:
+SendTensors / RecvTensors; ``ext/nnstreamer/extra/nnstreamer_grpc_common.h:32-83``).
+These tests prove a peer built from that .proto — real protoc-generated
+code + the real protobuf runtime, not our codec — can talk to our
+elements in both directions, and that our elements can run the protobuf
+IDL between themselves (``idl=protobuf``).
+"""
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("grpc")
+
+from nnstreamer_tpu.query.grpc_io import PB_RECV_METHOD, PB_SEND_METHOD
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+# the reference's message layout, expressed independently for interop tests
+# (same layout test_wire_formats.py uses against the codec)
+_PROTO_SRC = """
+syntax = "proto3";
+package nnstreamer.protobuf;
+message Tensor {
+  string name = 1;
+  enum Tensor_type {
+    NNS_INT32 = 0; NNS_UINT32 = 1; NNS_INT16 = 2; NNS_UINT16 = 3;
+    NNS_INT8 = 4; NNS_UINT8 = 5; NNS_FLOAT64 = 6; NNS_FLOAT32 = 7;
+    NNS_INT64 = 8; NNS_UINT64 = 9;
+  }
+  Tensor_type type = 2;
+  repeated uint32 dimension = 3;
+  bytes data = 4;
+}
+message Tensors {
+  uint32 num_tensor = 1;
+  message frame_rate { int32 rate_n = 1; int32 rate_d = 2; }
+  frame_rate fr = 2;
+  repeated Tensor tensor = 3;
+  enum Tensor_format { NNS_TENSOR_FORAMT_STATIC = 0;
+    NNS_TENSOR_FORMAT_FLEXIBLE = 1; NNS_TENSOR_FORMAT_SPARSE = 2; }
+  Tensor_format format = 4;
+}
+"""
+
+_IDENT = lambda b: bytes(b)  # noqa: E731
+
+
+@pytest.fixture(scope="module")
+def pb2(tmp_path_factory):
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not available")
+    d = tmp_path_factory.mktemp("proto_idl")
+    (d / "nns_idl.proto").write_text(_PROTO_SRC)
+    subprocess.run(
+        ["protoc", f"--python_out={d}", "-I", str(d), "nns_idl.proto"],
+        check=True)
+    sys.path.insert(0, str(d))
+    try:
+        import nns_idl_pb2
+
+        return nns_idl_pb2
+    finally:
+        sys.path.remove(str(d))
+
+
+def _pb_frame(pb2, arrays):
+    """Build a Tensors message the way the reference's encoder does
+    (16 innermost-first dimension slots, 0-padded)."""
+    msg = pb2.Tensors()
+    msg.num_tensor = len(arrays)
+    msg.fr.rate_n = 0
+    msg.fr.rate_d = 0
+    types = {np.dtype(np.float32): 7, np.dtype(np.uint8): 5,
+             np.dtype(np.int32): 0}
+    for a in arrays:
+        t = msg.tensor.add()
+        t.type = types[a.dtype]
+        t.dimension.extend(list(reversed(a.shape)) + [0] * (16 - a.ndim))
+        t.data = a.tobytes()
+    return msg
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert cond()
+
+
+class TestReferencePeer:
+    """A peer using protoc-generated reference messages over raw grpcio."""
+
+    def test_reference_peer_pushes_into_our_pipeline(self, pb2):
+        import grpc
+
+        recv = parse_launch(
+            "tensor_src_grpc name=g server=true port=0 "
+            "caps=other/tensors,format=static,dimensions=4:2,types=float32 "
+            "! tensor_sink name=out max-stored=8")
+        out = []
+        recv.get("out").connect(out.append)
+        recv.play()
+        _wait(lambda: recv.get("g").bound_port != 0)
+        port = recv.get("g").bound_port
+        try:
+            chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+            grpc.channel_ready_future(chan).result(timeout=5)
+            stub = chan.stream_unary(PB_SEND_METHOD, request_serializer=_IDENT,
+                                     response_deserializer=_IDENT)
+            frames = [np.full((2, 4), i, np.float32) for i in range(3)]
+            stub(iter([_pb_frame(pb2, [f]).SerializeToString()
+                       for f in frames]))
+            _wait(lambda: len(out) >= 3)
+            chan.close()
+            for got, want in zip(out, frames):
+                a = np.asarray(got.tensors[0])
+                assert a.dtype == np.float32
+                assert a.tobytes() == want.tobytes()
+        finally:
+            recv.stop()
+
+    def test_reference_peer_pulls_our_stream(self, pb2):
+        import grpc
+
+        send = parse_launch(
+            "appsrc name=in "
+            "caps=other/tensors,format=static,dimensions=4,types=float32 "
+            "! tensor_sink_grpc name=g server=true port=0")
+        send.play()
+        _wait(lambda: send.get("g").bound_port != 0)
+        port = send.get("g").bound_port
+        try:
+            chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+            grpc.channel_ready_future(chan).result(timeout=5)
+            stub = chan.unary_stream(PB_RECV_METHOD, request_serializer=_IDENT,
+                                     response_deserializer=_IDENT)
+            stream = stub(b"")
+            # subscribe first (live pub/sub), then publish
+            _wait(lambda: send.get("g").service is not None
+                  and len(send.get("g").service._subs) > 0)
+            src = send.get("in")
+            for i in range(3):
+                src.push_buffer(np.full(4, float(i), np.float32))
+            got = []
+            for raw in stream:
+                msg = pb2.Tensors.FromString(bytes(raw))
+                assert msg.num_tensor == 1
+                t = msg.tensor[0]
+                assert t.type == 7  # NNS_FLOAT32
+                assert list(t.dimension)[:1] == [4]
+                got.append(np.frombuffer(t.data, np.float32))
+                if len(got) >= 3:
+                    break
+            chan.close()
+            assert len(got) == 3
+            np.testing.assert_allclose(got[2], np.full(4, 2, np.float32))
+        finally:
+            send.stop()
+
+    def test_pb_caps_mismatch_rejected(self, pb2):
+        import grpc
+
+        recv = parse_launch(
+            "tensor_src_grpc name=g server=true port=0 "
+            "caps=other/tensors,format=static,dimensions=4,types=float32 "
+            "! tensor_sink name=out")
+        recv.play()
+        _wait(lambda: recv.get("g").bound_port != 0)
+        port = recv.get("g").bound_port
+        try:
+            chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+            grpc.channel_ready_future(chan).result(timeout=5)
+            stub = chan.stream_unary(PB_SEND_METHOD, request_serializer=_IDENT,
+                                     response_deserializer=_IDENT)
+            bad = _pb_frame(pb2, [np.zeros((8, 8), np.int32)])
+            with pytest.raises(grpc.RpcError):
+                stub(iter([bad.SerializeToString()]))
+            chan.close()
+        finally:
+            recv.stop()
+
+
+class TestOwnElementsProtobufIdl:
+    def test_push_loopback_idl_protobuf(self):
+        recv = parse_launch(
+            "tensor_src_grpc name=g server=true port=0 "
+            "caps=other/tensors,format=static,dimensions=4,types=float32 "
+            "! tensor_sink name=out max-stored=8")
+        out = []
+        recv.get("out").connect(out.append)
+        recv.play()
+        _wait(lambda: recv.get("g").bound_port != 0)
+        port = recv.get("g").bound_port
+        try:
+            send = parse_launch(
+                "tensor_src num-buffers=4 dimensions=4 types=float32 "
+                "pattern=counter "
+                f"! tensor_sink_grpc server=false port={port} idl=protobuf")
+            send.play()
+            send.wait(timeout=10)
+            _wait(lambda: len(out) >= 4)
+            send.stop()
+            np.testing.assert_allclose(np.asarray(out[2].tensors[0]),
+                                       np.full(4, 2, np.float32))
+        finally:
+            recv.stop()
+
+    def test_pull_loopback_idl_protobuf(self):
+        send = parse_launch(
+            "appsrc name=in "
+            "caps=other/tensors,format=static,dimensions=2:3,types=uint8 "
+            "! tensor_sink_grpc name=g server=true port=0")
+        send.play()
+        _wait(lambda: send.get("g").bound_port != 0)
+        port = send.get("g").bound_port
+        try:
+            recv = parse_launch(
+                f"tensor_src_grpc server=false port={port} idl=protobuf "
+                "! tensor_sink name=out max-stored=8")
+            out = []
+            recv.get("out").connect(out.append)
+            recv.play()
+            # pb recv derives caps from the FIRST message, so the
+            # subscriber blocks in negotiation until a frame is published;
+            # wait for its subscription then push
+            _wait(lambda: len(send.get("g").service._subs) > 0)
+            src = send.get("in")
+            for i in range(3):
+                src.push_buffer(np.full((3, 2), i, np.uint8))
+            _wait(lambda: len(out) >= 3)
+            a = np.asarray(out[1].tensors[0])
+            assert a.shape == (3, 2) and a.dtype == np.uint8
+            assert a[0, 0] == 1
+            recv.stop()
+        finally:
+            send.stop()
+
+    def test_bad_idl_rejected(self):
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            "tensor_src num-buffers=1 dimensions=4 types=float32 "
+            "! tensor_sink_grpc server=false port=1 idl=capnproto timeout=1")
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+        pipe.stop()
+        assert msg is not None
