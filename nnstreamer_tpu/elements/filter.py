@@ -161,14 +161,17 @@ class TensorFilter(TransformElement):
         return resolve(self.props["model"])
 
     def _detect_framework(self, model: str, hint: Optional[str]) -> str:
+        # aliases ([filter-aliases] in the ini, reference nnstreamer.ini.in)
+        # apply to explicit framework names AND to auto-detect candidates
         fw = self.props["framework"]
         if fw != "auto":
-            return fw
+            return get_config().filter_alias(fw)
         if hint:
-            return hint
+            return get_config().filter_alias(hint)
         if model.startswith("builtin://"):
             return "jax"
-        candidates = get_config().framework_priority(model)
+        candidates = [get_config().filter_alias(c)
+                      for c in get_config().framework_priority(model)]
         available = set(subplugin_names(SubpluginKind.FILTER))
         for c in candidates:
             if c in available:

@@ -109,6 +109,7 @@ class GrpcTensorService:
         self.caps: Optional[Caps] = None           # learned from Send streams
         self._caps_lock = threading.Lock()
         self._out_caps: Optional[Caps] = None      # declared for Recv streams
+        self._out_info: Optional[TensorsInfo] = None  # cached from out_caps
         self._out_caps_set = threading.Event()
         self._caps_seen = threading.Event()
         self._stopped = threading.Event()
@@ -277,6 +278,10 @@ class GrpcTensorService:
     @out_caps.setter
     def out_caps(self, caps: Caps) -> None:
         self._out_caps = caps
+        try:  # cached for pb encoding on the publish hot path
+            self._out_info = tensors_info_from_caps(caps)
+        except (ValueError, KeyError):
+            self._out_info = None
         self._out_caps_set.set()
 
     def wait_caps(self, timeout: float) -> Optional[Caps]:
@@ -300,9 +305,7 @@ class GrpcTensorService:
                     payloads[idl] = None
                 elif idl == "protobuf":
                     try:
-                        info = (tensors_info_from_caps(self._out_caps)
-                                if self._out_caps is not None else None)
-                        payloads[idl] = _buffer_to_pb(buf, info)
+                        payloads[idl] = _buffer_to_pb(buf, self._out_info)
                     except ValueError as e:
                         # e.g. bfloat16: not on the reference wire — a
                         # connected pb peer must not kill the pipeline or
@@ -483,6 +486,7 @@ class TensorSrcGrpc(SourceElement):
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
+        _check_idl(self.props["idl"])  # typos surface at construction
         self.service: Optional[GrpcTensorService] = None
         self._client: Optional[GrpcTensorClient] = None
         self._frames = None
@@ -562,6 +566,7 @@ class TensorSinkGrpc(SinkElement):
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
+        _check_idl(self.props["idl"])  # typos surface at construction
         self.service: Optional[GrpcTensorService] = None
         self._client: Optional[GrpcTensorClient] = None
 

@@ -87,6 +87,12 @@ class Config:
         v = self.get(section, key, "")
         return [p.strip() for p in v.split(",") if p.strip()]
 
+    def filter_alias(self, framework: str) -> str:
+        """Resolve a filter-framework alias (reference ``[filter-aliases]``
+        in nnstreamer.ini, e.g. ``trix-engine=<real subplugin>``); returns
+        the input unchanged when no alias is configured."""
+        return self.get("filter-aliases", framework) or framework
+
     def framework_priority(self, model_path: str) -> List[str]:
         """Backend candidates for a model file, by extension (reference
         ``gst_tensor_filter_detect_framework``, tensor_filter_common.c:1218)."""

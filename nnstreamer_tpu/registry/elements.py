@@ -74,14 +74,27 @@ def load_standard_elements() -> None:
 
 def _allowed(factory_name: str) -> bool:
     """Element restriction allowlist (reference: meson
-    ``enable-element-restriction`` + ``restricted-elements`` — products ship
-    pipelines limited to a vetted element set, nnstreamer_conf's
-    element-restriction check). Config key: ``[common] restricted_elements``
-    = comma-separated allowlist; empty/absent = everything allowed."""
+    ``enable-element-restriction`` writing ``[element-restriction]
+    enable_element_restriction=True / allowed_elements=...`` into
+    nnstreamer.ini — products ship pipelines limited to a vetted element
+    set). Two spellings accepted:
+
+    * the reference's ini section: ``[element-restriction]`` with
+      ``enable_element_restriction`` + ``allowed_elements``;
+    * the shorthand ``[common] restricted_elements`` (allowlist implied
+      enabled when non-empty).
+    """
     from .config import get_config
 
-    allow = get_config().get("common", "restricted_elements", "")
-    if not allow.strip():
+    cfg = get_config()
+    if cfg.get_bool("element-restriction", "enable_element_restriction", False):
+        # explicitly enabled: fail CLOSED — an empty/absent allowlist
+        # under an enabled lockdown denies everything, it does not
+        # silently disable the vetting
+        allow = cfg.get("element-restriction", "allowed_elements", "")
+        return factory_name in {e.strip() for e in allow.split(",") if e.strip()}
+    allow = cfg.get("common", "restricted_elements", "")
+    if not allow.strip():  # shorthand key: empty means no restriction
         return True
     return factory_name in {e.strip() for e in allow.split(",") if e.strip()}
 

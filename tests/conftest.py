@@ -1,0 +1,54 @@
+"""Shared fixtures for the test suite."""
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+# The reference's message layout (ext/nnstreamer/include/nnstreamer.proto),
+# expressed independently for interop tests. ONE generated module for the
+# whole session: the protobuf runtime registers message types globally by
+# full name, so two protoc runs of the same package in one process collide.
+REFERENCE_PROTO_SRC = """
+syntax = "proto3";
+package nnstreamer.protobuf;
+message Tensor {
+  string name = 1;
+  enum Tensor_type {
+    NNS_INT32 = 0; NNS_UINT32 = 1; NNS_INT16 = 2; NNS_UINT16 = 3;
+    NNS_INT8 = 4; NNS_UINT8 = 5; NNS_FLOAT64 = 6; NNS_FLOAT32 = 7;
+    NNS_INT64 = 8; NNS_UINT64 = 9;
+  }
+  Tensor_type type = 2;
+  repeated uint32 dimension = 3;
+  bytes data = 4;
+}
+message Tensors {
+  uint32 num_tensor = 1;
+  message frame_rate { int32 rate_n = 1; int32 rate_d = 2; }
+  frame_rate fr = 2;
+  repeated Tensor tensor = 3;
+  enum Tensor_format { NNS_TENSOR_FORAMT_STATIC = 0;
+    NNS_TENSOR_FORMAT_FLEXIBLE = 1; NNS_TENSOR_FORMAT_SPARSE = 2; }
+  Tensor_format format = 4;
+}
+"""
+
+
+@pytest.fixture(scope="session")
+def pb2(tmp_path_factory):
+    """protoc-generated module for the reference Tensors message."""
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not available")
+    d = tmp_path_factory.mktemp("reference_proto")
+    (d / "nns_wire.proto").write_text(REFERENCE_PROTO_SRC)
+    subprocess.run(
+        ["protoc", f"--python_out={d}", "-I", str(d), "nns_wire.proto"],
+        check=True)
+    sys.path.insert(0, str(d))
+    try:
+        import nns_wire_pb2
+
+        return nns_wire_pb2
+    finally:
+        sys.path.remove(str(d))

@@ -8,9 +8,6 @@ code + the real protobuf runtime, not our codec — can talk to our
 elements in both directions, and that our elements can run the protobuf
 IDL between themselves (``idl=protobuf``).
 """
-import shutil
-import subprocess
-import sys
 import time
 
 import numpy as np
@@ -21,52 +18,11 @@ pytest.importorskip("grpc")
 from nnstreamer_tpu.query.grpc_io import PB_RECV_METHOD, PB_SEND_METHOD
 from nnstreamer_tpu.runtime.parse import parse_launch
 
-# the reference's message layout, expressed independently for interop tests
-# (same layout test_wire_formats.py uses against the codec)
-_PROTO_SRC = """
-syntax = "proto3";
-package nnstreamer.protobuf;
-message Tensor {
-  string name = 1;
-  enum Tensor_type {
-    NNS_INT32 = 0; NNS_UINT32 = 1; NNS_INT16 = 2; NNS_UINT16 = 3;
-    NNS_INT8 = 4; NNS_UINT8 = 5; NNS_FLOAT64 = 6; NNS_FLOAT32 = 7;
-    NNS_INT64 = 8; NNS_UINT64 = 9;
-  }
-  Tensor_type type = 2;
-  repeated uint32 dimension = 3;
-  bytes data = 4;
-}
-message Tensors {
-  uint32 num_tensor = 1;
-  message frame_rate { int32 rate_n = 1; int32 rate_d = 2; }
-  frame_rate fr = 2;
-  repeated Tensor tensor = 3;
-  enum Tensor_format { NNS_TENSOR_FORAMT_STATIC = 0;
-    NNS_TENSOR_FORMAT_FLEXIBLE = 1; NNS_TENSOR_FORMAT_SPARSE = 2; }
-  Tensor_format format = 4;
-}
-"""
+# pb2 fixture (protoc-generated reference Tensors message) lives in
+# tests/conftest.py — ONE generated module per session, since the protobuf
+# runtime registers message full-names globally.
 
 _IDENT = lambda b: bytes(b)  # noqa: E731
-
-
-@pytest.fixture(scope="module")
-def pb2(tmp_path_factory):
-    if shutil.which("protoc") is None:
-        pytest.skip("protoc not available")
-    d = tmp_path_factory.mktemp("proto_idl")
-    (d / "nns_idl.proto").write_text(_PROTO_SRC)
-    subprocess.run(
-        ["protoc", f"--python_out={d}", "-I", str(d), "nns_idl.proto"],
-        check=True)
-    sys.path.insert(0, str(d))
-    try:
-        import nns_idl_pb2
-
-        return nns_idl_pb2
-    finally:
-        sys.path.remove(str(d))
 
 
 def _pb_frame(pb2, arrays):
@@ -241,13 +197,10 @@ class TestOwnElementsProtobufIdl:
         finally:
             send.stop()
 
-    def test_bad_idl_rejected(self):
-        from nnstreamer_tpu.core import MessageType
+    def test_bad_idl_rejected_at_construction(self):
+        from nnstreamer_tpu.runtime.element import ElementError
 
-        pipe = parse_launch(
-            "tensor_src num-buffers=1 dimensions=4 types=float32 "
-            "! tensor_sink_grpc server=false port=1 idl=capnproto timeout=1")
-        pipe.play()
-        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
-        pipe.stop()
-        assert msg is not None
+        with pytest.raises(ElementError, match="idl"):
+            parse_launch(
+                "tensor_src num-buffers=1 dimensions=4 types=float32 "
+                "! tensor_sink_grpc server=false port=1 idl=capnproto timeout=1")

@@ -92,3 +92,95 @@ class TestElementRestriction:
         # allowed elements still construct
         parse_launch("tensor_src num-buffers=1 dimensions=1 types=float32 "
                      "! tensor_sink")
+
+    def test_reference_ini_section(self, tmp_path):
+        """The reference's exact ini spelling ([element-restriction]
+        enable_element_restriction / allowed_elements — meson.build:632,
+        nnstreamer.ini.in:37) must be honored."""
+        from nnstreamer_tpu.registry.config import reset_config
+
+        ini = tmp_path / "nns.ini"
+        ini.write_text(
+            "[element-restriction]\n"
+            "enable_element_restriction=True\n"
+            "allowed_elements=tensor_src,tensor_sink,queue\n")
+        reset_config(str(ini))
+        try:
+            parse_launch("tensor_src num-buffers=1 dimensions=1 "
+                         "types=float32 ! queue ! tensor_sink")
+            with pytest.raises(PermissionError):
+                parse_launch("tensor_src num-buffers=1 dimensions=1 "
+                             "types=float32 ! tensor_transform mode=typecast "
+                             "option=float64 ! tensor_sink")
+            # disabled flag: allowlist ignored
+            ini.write_text(
+                "[element-restriction]\n"
+                "enable_element_restriction=False\n"
+                "allowed_elements=tensor_src\n")
+            reset_config(str(ini))
+            parse_launch("tensor_src num-buffers=1 dimensions=1 "
+                         "types=float32 ! tensor_sink")
+            # enabled with EMPTY allowlist: fail closed, not silently open
+            ini.write_text(
+                "[element-restriction]\n"
+                "enable_element_restriction=True\n")
+            reset_config(str(ini))
+            with pytest.raises(PermissionError):
+                parse_launch("tensor_src num-buffers=1 dimensions=1 "
+                             "types=float32 ! tensor_sink")
+        finally:
+            reset_config()
+
+
+class TestFilterAliases:
+    def test_alias_resolves_explicit_framework(self, tmp_path):
+        """[filter-aliases] (reference nnstreamer.ini.in:34): an alias
+        usable as framework=<alias> end-to-end."""
+        from nnstreamer_tpu.registry.config import reset_config
+
+        ini = tmp_path / "nns.ini"
+        ini.write_text("[filter-aliases]\nmy-engine=jax\n")
+        reset_config(str(ini))
+        try:
+            pipe = parse_launch(
+                "tensor_src num-buffers=2 dimensions=4 types=float32 "
+                "pattern=ones "
+                "! tensor_filter framework=my-engine model=builtin://scaler?factor=3 "
+                "! tensor_sink name=out max-stored=4")
+            out = []
+            pipe.get("out").connect(out.append)
+            pipe.play(); pipe.wait(timeout=30); pipe.stop()
+            assert len(out) == 2
+            np.testing.assert_allclose(np.asarray(out[0].tensors[0]), 3.0)
+        finally:
+            reset_config()
+
+    def test_alias_applies_during_autodetect(self, tmp_path):
+        """A priority-list candidate that is an alias resolves before the
+        availability check (reference: auto-detect consults aliases)."""
+        from nnstreamer_tpu.registry.config import reset_config
+
+        ini = tmp_path / "nns.ini"
+        ini.write_text("[filter-aliases]\nfancy-npu=jax\n"
+                       "[filter]\nframework_priority_py=fancy-npu\n")
+        reset_config(str(ini))
+        try:
+            model = tmp_path / "m.py"
+            model.write_text("def model(*t):\n    return t[0] * 2\n")
+            pipe = parse_launch(
+                "tensor_src num-buffers=2 dimensions=4 types=float32 "
+                "pattern=ones "
+                f"! tensor_filter framework=auto model={model} "
+                "! tensor_sink name=out max-stored=4")
+            out = []
+            pipe.get("out").connect(out.append)
+            pipe.play(); pipe.wait(timeout=30); pipe.stop()
+            assert len(out) == 2
+            np.testing.assert_allclose(np.asarray(out[0].tensors[0]), 2.0)
+        finally:
+            reset_config()
+
+    def test_no_alias_passthrough(self):
+        from nnstreamer_tpu.registry.config import get_config
+
+        assert get_config().filter_alias("jax") == "jax"
