@@ -20,11 +20,17 @@ The flatbuffer is parsed ONCE at load: op options and weights are copied
 into plain python/numpy structures, so the returned callable holds no
 references to the raw model bytes or schema objects.
 
-Supported builtin ops (the set covering the reference's test models —
-mobilenet_v2_1.0_224_quant, deeplabv3_257_mv_gpu, add, simple_32):
-CONV_2D, DEPTHWISE_CONV_2D, FULLY_CONNECTED, ADD, SUB, MUL, DIV, PAD,
-AVERAGE_POOL_2D, MAX_POOL_2D, MEAN, RESHAPE, SOFTMAX, RESIZE_BILINEAR,
-CONCATENATION, RELU, RELU6, LOGISTIC, TANH, DEQUANTIZE, QUANTIZE.
+Supported builtin ops — the reference zoo set (mobilenet_v2_1.0_224_quant,
+deeplabv3_257_mv_gpu, add, simple_32): CONV_2D, DEPTHWISE_CONV_2D,
+FULLY_CONNECTED, ADD, SUB, MUL, DIV, PAD, AVERAGE_POOL_2D, MAX_POOL_2D,
+MEAN, RESHAPE, SOFTMAX, RESIZE_BILINEAR, CONCATENATION, RELU, RELU6,
+LOGISTIC, TANH, DEQUANTIZE, QUANTIZE — plus the detection/post-process
+vocabulary arbitrary reference-era .tflite files hit next: STRIDED_SLICE,
+TRANSPOSE_CONV, SPLIT, SPLIT_V, PACK, UNPACK, CAST, SQUEEZE, EXPAND_DIMS,
+SLICE, GATHER, ARG_MAX, SUM, REDUCE_MAX/MIN, EXP, RSQRT, SQRT, NEG, ABS,
+POW, SQUARED_DIFFERENCE, LEAKY_RELU, HARD_SWISH, PRELU, L2_NORMALIZATION,
+RESIZE_NEAREST_NEIGHBOR, SPACE_TO_DEPTH, DEPTH_TO_SPACE, MAXIMUM, MINIMUM,
+SHAPE, TRANSPOSE, BROADCAST_TO.
 """
 from __future__ import annotations
 
@@ -170,6 +176,28 @@ def _resize_bilinear(x, out_hw, align_corners: bool, half_pixel: bool):
     return top * (1 - wy) + bot * wy
 
 
+def _resize_nearest(x, out_hw, align_corners: bool, half_pixel: bool):
+    """tflite RESIZE_NEAREST_NEIGHBOR index rule (reference kernel
+    reference_ops::ResizeNearestNeighbor): scale = (in-1)/(out-1) with
+    align-corners else in/out; half-pixel adds 0.5 to the output index
+    before scaling; align-corners rounds half AWAY from zero
+    (TfLiteRound — coords are nonnegative, so floor(v+0.5)), else floor."""
+    import jax.numpy as jnp
+
+    _, ih, iw, _ = x.shape
+    oh, ow = int(out_hw[0]), int(out_hw[1])
+
+    def idx(out_n, in_n):
+        i = jnp.arange(out_n, dtype=jnp.float32)
+        scale = ((in_n - 1) / (out_n - 1)
+                 if align_corners and out_n > 1 else in_n / out_n)
+        v = (i + (0.5 if half_pixel else 0.0)) * scale
+        j = jnp.floor(v + 0.5) if align_corners else jnp.floor(v)
+        return jnp.clip(j, 0, in_n - 1).astype(jnp.int32)
+
+    return x[:, idx(oh, ih)][:, :, idx(ow, iw)]
+
+
 def _parse_step(code: str, op, tensors: List[_Tensor]) -> dict:
     """Extract everything an op needs into a plain dict, so execution never
     touches flatbuffer schema objects (and the model bytes can be freed)."""
@@ -218,6 +246,52 @@ def _parse_step(code: str, op, tensors: List[_Tensor]) -> dict:
         o = _options(op, s.ResizeBilinearOptions)
         cfg = {"align_corners": bool(o.AlignCorners()),
                "half_pixel": bool(o.HalfPixelCenters())}
+    elif code == "RESIZE_NEAREST_NEIGHBOR":
+        o = _options(op, s.ResizeNearestNeighborOptions)
+        cfg = {"align_corners": bool(o.AlignCorners()) if o else False,
+               "half_pixel": bool(o.HalfPixelCenters()) if o else False}
+    elif code == "STRIDED_SLICE":
+        o = _options(op, s.StridedSliceOptions)
+        cfg = {"begin_mask": o.BeginMask(), "end_mask": o.EndMask(),
+               "ellipsis_mask": o.EllipsisMask(),
+               "new_axis_mask": o.NewAxisMask(),
+               "shrink_axis_mask": o.ShrinkAxisMask()}
+    elif code == "TRANSPOSE_CONV":
+        o = _options(op, s.TransposeConvOptions)
+        cfg = {"strides": (o.StrideH(), o.StrideW()),
+               "padding": _conv_padding(o.Padding()),
+               "act": (o.FusedActivationFunction()
+                       if hasattr(o, "FusedActivationFunction") else _ACT_NONE)}
+    elif code == "SPLIT":
+        o = _options(op, s.SplitOptions)
+        cfg = {"num": o.NumSplits()}
+    elif code == "PACK":
+        o = _options(op, s.PackOptions)
+        cfg = {"axis": o.Axis()}
+    elif code == "UNPACK":
+        o = _options(op, s.UnpackOptions)
+        cfg = {"axis": o.Axis(), "num": o.Num()}
+    elif code == "SQUEEZE":
+        o = _options(op, s.SqueezeOptions)
+        cfg = {"dims": [int(v) for v in o.SqueezeDimsAsNumpy()]
+               if o is not None and o.SqueezeDimsLength() else []}
+    elif code == "GATHER":
+        o = _options(op, s.GatherOptions)
+        cfg = {"axis": o.Axis() if o is not None else 0,
+               "batch_dims": (int(o.BatchDims())
+                              if o is not None and hasattr(o, "BatchDims")
+                              else 0)}
+    elif code in ("SUM", "REDUCE_MAX", "REDUCE_MIN"):
+        o = _options(op, s.ReducerOptions)
+        cfg = {"keepdims": bool(o.KeepDims()) if o is not None else False}
+    elif code == "LEAKY_RELU":
+        o = _options(op, s.LeakyReluOptions)
+        cfg = {"alpha": float(o.Alpha()) if o is not None else 0.2}
+    elif code in ("SPACE_TO_DEPTH", "DEPTH_TO_SPACE"):
+        cls = (s.SpaceToDepthOptions if code == "SPACE_TO_DEPTH"
+               else s.DepthToSpaceOptions)
+        o = _options(op, cls)
+        cfg = {"block": int(o.BlockSize())}
     return cfg
 
 
@@ -439,6 +513,157 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
             elif code == "TRANSPOSE":
                 perm = np.asarray(_const(ins[1])).reshape(-1).tolist()
                 env[outs[0]] = jnp.transpose(_in(env, ins[0]), perm)
+            elif code == "STRIDED_SLICE":
+                x = _in(env, ins[0])
+                if cfg["ellipsis_mask"] or cfg["new_axis_mask"]:
+                    raise NotImplementedError(
+                        "tflite import: STRIDED_SLICE ellipsis/new-axis mask")
+                begin = np.asarray(_const(ins[1])).reshape(-1)
+                end = np.asarray(_const(ins[2])).reshape(-1)
+                strides = np.asarray(_const(ins[3])).reshape(-1)
+                index: List[Any] = []
+                for d in range(len(begin)):
+                    b = int(begin[d]); e = int(end[d]); st = int(strides[d])
+                    if cfg["shrink_axis_mask"] & (1 << d):
+                        index.append(b if b >= 0 else b + x.shape[d])
+                        continue
+                    index.append(slice(
+                        None if cfg["begin_mask"] & (1 << d) else b,
+                        None if cfg["end_mask"] & (1 << d) else e,
+                        st))
+                env[outs[0]] = x[tuple(index)]
+            elif code == "TRANSPOSE_CONV":
+                out_shape = tuple(int(v) for v in
+                                  np.asarray(_const(ins[0])).reshape(-1))
+                w, x = _in(env, ins[1]), _in(env, ins[2])
+                # tflite weights OHWI [oc, kh, kw, ic]; the forward conv
+                # whose input-gradient this computes has kernel HWIO with
+                # I=oc (transpose-conv output), O=ic (x channels)
+                y = jax.lax.conv_transpose(
+                    x, jnp.transpose(w, (1, 2, 0, 3)),
+                    strides=cfg["strides"], padding=cfg["padding"],
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    transpose_kernel=True, precision=precision)
+                if y.shape[1:] != out_shape[1:]:
+                    raise NotImplementedError(
+                        f"tflite import: TRANSPOSE_CONV output shape "
+                        f"{y.shape} != recorded {out_shape}")
+                if len(ins) > 3 and ins[3] >= 0:
+                    y = y + _in(env, ins[3])
+                env[outs[0]] = _fused(cfg["act"], y)
+            elif code == "SPLIT":
+                axis = int(np.asarray(_const(ins[0])).reshape(-1)[0])
+                parts = jnp.split(_in(env, ins[1]), cfg["num"], axis=axis)
+                for o_idx, part in zip(outs, parts):
+                    env[o_idx] = part
+            elif code == "SPLIT_V":
+                x = _in(env, ins[0])
+                sizes = [int(v) for v in np.asarray(_const(ins[1])).reshape(-1)]
+                axis = int(np.asarray(_const(ins[2])).reshape(-1)[0])
+                if sizes.count(-1) == 1:  # one wildcard: infer the remainder
+                    sizes[sizes.index(-1)] = (
+                        int(x.shape[axis]) - sum(v for v in sizes if v >= 0))
+                offsets = np.cumsum(sizes)[:-1].tolist()
+                parts = jnp.split(x, offsets, axis=axis)
+                for o_idx, part in zip(outs, parts):
+                    env[o_idx] = part
+            elif code == "PACK":
+                env[outs[0]] = jnp.stack([_in(env, i) for i in ins],
+                                         axis=cfg["axis"])
+            elif code == "UNPACK":
+                x = _in(env, ins[0])
+                for k, o_idx in enumerate(outs):
+                    env[o_idx] = jnp.take(x, k, axis=cfg["axis"])
+            elif code == "CAST":
+                env[outs[0]] = _in(env, ins[0]).astype(tensors[outs[0]].dtype)
+            elif code == "SQUEEZE":
+                x = _in(env, ins[0])
+                dims = cfg["dims"] or [d for d, n in enumerate(x.shape) if n == 1]
+                env[outs[0]] = jnp.squeeze(
+                    x, axis=tuple(d % x.ndim for d in dims))
+            elif code == "EXPAND_DIMS":
+                axis = int(np.asarray(_const(ins[1])).reshape(-1)[0])
+                env[outs[0]] = jnp.expand_dims(_in(env, ins[0]), axis)
+            elif code == "SLICE":
+                x = _in(env, ins[0])
+                begin = np.asarray(_const(ins[1])).reshape(-1)
+                size = np.asarray(_const(ins[2])).reshape(-1)
+                idx = tuple(
+                    slice(int(b), None if int(sz) == -1 else int(b) + int(sz))
+                    for b, sz in zip(begin, size))
+                env[outs[0]] = x[idx]
+            elif code == "GATHER":
+                params, indices = _in(env, ins[0]), _in(env, ins[1])
+                bd = cfg["batch_dims"]
+                if bd == 0:
+                    env[outs[0]] = jnp.take(params, indices, axis=cfg["axis"])
+                else:
+                    # batched gather: vmap over the shared leading dims
+                    # (tflite axis counts those dims, the mapped take
+                    # doesn't)
+                    inner_axis = cfg["axis"] - bd
+                    take = lambda p, i: jnp.take(p, i, axis=inner_axis)  # noqa: E731
+                    for _ in range(bd):
+                        take = jax.vmap(take)
+                    env[outs[0]] = take(params, jnp.asarray(indices))
+            elif code == "ARG_MAX":
+                axis = int(np.asarray(_const(ins[1])).reshape(-1)[0])
+                env[outs[0]] = jnp.argmax(_in(env, ins[0]), axis=axis).astype(
+                    tensors[outs[0]].dtype)
+            elif code in ("SUM", "REDUCE_MAX", "REDUCE_MIN"):
+                axes = tuple(int(a) for a in
+                             np.atleast_1d(np.asarray(_const(ins[1]))))
+                red = {"SUM": jnp.sum, "REDUCE_MAX": jnp.max,
+                       "REDUCE_MIN": jnp.min}[code]
+                env[outs[0]] = red(_in(env, ins[0]), axis=axes,
+                                   keepdims=cfg["keepdims"])
+            elif code == "EXP":
+                env[outs[0]] = jnp.exp(_in(env, ins[0]))
+            elif code == "RSQRT":
+                env[outs[0]] = jax.lax.rsqrt(_in(env, ins[0]))
+            elif code == "SQRT":
+                env[outs[0]] = jnp.sqrt(_in(env, ins[0]))
+            elif code == "NEG":
+                env[outs[0]] = -_in(env, ins[0])
+            elif code == "ABS":
+                env[outs[0]] = jnp.abs(_in(env, ins[0]))
+            elif code == "POW":
+                env[outs[0]] = jnp.power(_in(env, ins[0]), _in(env, ins[1]))
+            elif code == "SQUARED_DIFFERENCE":
+                d = _in(env, ins[0]) - _in(env, ins[1])
+                env[outs[0]] = d * d
+            elif code == "LEAKY_RELU":
+                x = _in(env, ins[0])
+                env[outs[0]] = jnp.where(x >= 0, x, cfg["alpha"] * x)
+            elif code == "HARD_SWISH":
+                x = _in(env, ins[0])
+                env[outs[0]] = x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+            elif code == "PRELU":
+                x, alpha = _in(env, ins[0]), _in(env, ins[1])
+                env[outs[0]] = jnp.where(x >= 0, x, alpha * x)
+            elif code == "L2_NORMALIZATION":
+                x = _in(env, ins[0])
+                env[outs[0]] = x * jax.lax.rsqrt(
+                    jnp.maximum(jnp.sum(x * x, axis=-1, keepdims=True), 1e-12))
+            elif code == "RESIZE_NEAREST_NEIGHBOR":
+                out_hw = np.asarray(_const(ins[1])).reshape(-1)
+                env[outs[0]] = _resize_nearest(
+                    _in(env, ins[0]), out_hw,
+                    cfg["align_corners"], cfg["half_pixel"])
+            elif code == "SPACE_TO_DEPTH":
+                x = _in(env, ins[0])
+                n, h, w2, c = x.shape
+                bs = cfg["block"]
+                y = x.reshape(n, h // bs, bs, w2 // bs, bs, c)
+                env[outs[0]] = jnp.transpose(y, (0, 1, 3, 2, 4, 5)).reshape(
+                    n, h // bs, w2 // bs, c * bs * bs)
+            elif code == "DEPTH_TO_SPACE":
+                x = _in(env, ins[0])
+                n, h, w2, c = x.shape
+                bs = cfg["block"]
+                y = x.reshape(n, h, w2, bs, bs, c // (bs * bs))
+                env[outs[0]] = jnp.transpose(y, (0, 1, 3, 2, 4, 5)).reshape(
+                    n, h * bs, w2 * bs, c // (bs * bs))
             elif code in ("DEQUANTIZE", "QUANTIZE"):
                 t = tensors[ins[0]]
                 x = _in(env, ins[0])
