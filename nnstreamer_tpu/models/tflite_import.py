@@ -525,6 +525,11 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
                 for d in range(len(begin)):
                     b = int(begin[d]); e = int(end[d]); st = int(strides[d])
                     if cfg["shrink_axis_mask"] & (1 << d):
+                        # tflite StartForAxis applies begin_mask BEFORE the
+                        # shrink (stop = start + 1): a set begin bit resets
+                        # the start to 0 (positive stride)
+                        if cfg["begin_mask"] & (1 << d):
+                            b = 0
                         index.append(b if b >= 0 else b + x.shape[d])
                         continue
                     index.append(slice(
@@ -600,8 +605,12 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
                 else:
                     # batched gather: vmap over the shared leading dims
                     # (tflite axis counts those dims, the mapped take
-                    # doesn't)
-                    inner_axis = cfg["axis"] - bd
+                    # doesn't); negative axis resolves against the full
+                    # rank first (tflite kernel: axis += rank)
+                    ax = cfg["axis"]
+                    if ax < 0:
+                        ax += params.ndim
+                    inner_axis = ax - bd
                     take = lambda p, i: jnp.take(p, i, axis=inner_axis)  # noqa: E731
                     for _ in range(bd):
                         take = jax.vmap(take)

@@ -1,0 +1,114 @@
+"""SingleShot invoke timeout + input validation (VERDICT r02 weak #6).
+
+Reference analog: the ml_single layer above tensor_filter_single
+(ml_single_set_timeout / ml_single_invoke): a bounded invoke that raises
+instead of hanging, discards the late result of a timed-out call, and
+validates inputs against the model's declared info before dispatch.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.custom_easy import (register_custom_easy,
+                                                 unregister_custom_easy)
+from nnstreamer_tpu.core import TensorsInfo
+from nnstreamer_tpu.core.tensors import TensorSpec
+from nnstreamer_tpu.single import SingleShot
+
+
+@pytest.fixture()
+def slow_model():
+    delay = {"s": 0.0}
+
+    def fn(tensors):
+        time.sleep(delay["s"])
+        return [np.asarray(tensors[0]) * 2]
+
+    register_custom_easy(
+        "single_slow", fn,
+        in_info=TensorsInfo.of(TensorSpec((4,), np.float32)),
+        out_info=TensorsInfo.of(TensorSpec((4,), np.float32)))
+    yield delay
+    unregister_custom_easy("single_slow")
+
+
+class TestInvokeTimeout:
+    def test_fast_invoke_within_timeout(self, slow_model):
+        with SingleShot("custom-easy", "single_slow", timeout_ms=2000) as s:
+            out = s.invoke(np.ones(4, np.float32))
+            np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+            assert s.stats.total_invokes == 1
+
+    def test_wedged_invoke_raises_and_late_result_discarded(self, slow_model):
+        with SingleShot("custom-easy", "single_slow", timeout_ms=120) as s:
+            slow_model["s"] = 0.5
+            with pytest.raises(TimeoutError, match="120 ms"):
+                s.invoke(np.ones(4, np.float32))
+            # while the stale invoke still runs, a new one must refuse
+            # (one invoke thread — the reference's serialization guarantee)
+            with pytest.raises(RuntimeError, match="still running"):
+                s.invoke(np.ones(4, np.float32))
+            time.sleep(0.6)  # let the stale invoke land
+            slow_model["s"] = 0.0
+            out = s.invoke(np.full(4, 3.0, np.float32))
+            # MUST be the fresh answer (3*2), not the stale one (1*2)
+            np.testing.assert_allclose(np.asarray(out[0]), 6.0)
+
+    def test_per_call_timeout_overrides_instance(self, slow_model):
+        with SingleShot("custom-easy", "single_slow") as s:  # unbounded
+            slow_model["s"] = 0.2
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError):
+                s.invoke(np.ones(4, np.float32), timeout_ms=50)
+            assert time.monotonic() - t0 < 0.19
+            time.sleep(0.3)
+
+    def test_set_timeout_zero_restores_blocking(self, slow_model):
+        with SingleShot("custom-easy", "single_slow", timeout_ms=50) as s:
+            s.set_timeout(0)
+            slow_model["s"] = 0.15
+            out = s.invoke(np.ones(4, np.float32))  # blocks, no raise
+            np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+
+
+class TestInputValidation:
+    def test_wrong_tensor_count(self, slow_model):
+        with SingleShot("custom-easy", "single_slow") as s:
+            with pytest.raises(ValueError, match="1"):
+                s.invoke(np.ones(4, np.float32), np.ones(4, np.float32))
+
+    def test_wrong_dtype(self, slow_model):
+        with SingleShot("custom-easy", "single_slow") as s:
+            with pytest.raises(TypeError, match="float64"):
+                s.invoke(np.ones(4, np.float64))
+
+    def test_wrong_shape(self, slow_model):
+        with SingleShot("custom-easy", "single_slow") as s:
+            with pytest.raises(ValueError, match="shape"):
+                s.invoke(np.ones((2, 3), np.float32))
+
+    def test_wrong_length_rank1_rejected(self, slow_model):
+        """Leading-dim leniency must not excuse a rank-1 size mismatch
+        (declared (4,) is not a batch dim)."""
+        with SingleShot("custom-easy", "single_slow") as s:
+            with pytest.raises(ValueError, match="shape"):
+                s.invoke(np.ones(3, np.float32))
+
+    def test_validate_false_skips(self, slow_model):
+        with SingleShot("custom-easy", "single_slow", validate=False) as s:
+            out = s.invoke(np.ones(8, np.float32))  # model tolerates it
+            assert np.asarray(out[0]).shape == (8,)
+
+    def test_batch_polymorphic_leading_dim_allowed(self):
+        register_custom_easy(
+            "single_batchy", lambda t: [np.asarray(t[0]) + 1],
+            in_info=TensorsInfo.of(TensorSpec((1, 4), np.float32)),
+            out_info=TensorsInfo.of(TensorSpec((1, 4), np.float32)))
+        try:
+            with SingleShot("custom-easy", "single_batchy") as s:
+                out = s.invoke(np.zeros((16, 4), np.float32))
+                assert np.asarray(out[0]).shape == (16, 4)
+        finally:
+            unregister_custom_easy("single_batchy")
