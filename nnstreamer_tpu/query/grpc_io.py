@@ -8,7 +8,7 @@ flatbuf IDL). TPU redesign: grpcio with *generic* bytes methods — the IDL is
 our own ``core/serialize`` tensor frame (already the wire format of the
 query/edge/mqtt layers), so no codegen step and one serialization everywhere.
 
-Service surface (bytes in/out, identity serializers). TWO IDLs:
+Service surface (bytes in/out, identity serializers). THREE IDLs:
 
 * own wire (default client idl):
     /nnstreamer.Tensor/Send   client-streaming — remote pushes frames to us
@@ -17,15 +17,17 @@ Service surface (bytes in/out, identity serializers). TWO IDLs:
   first), ``D`` serialized tensor frame (core/serialize — pts/meta/sparse
   ride along), ``E`` EOS.
 
-* reference protobuf IDL (``idl=protobuf`` on the client role; servers
-  speak BOTH at once, so a reference peer connects unmodified):
-    /nnstreamer.protobuf.TensorService/SendTensors  (stream Tensors → Empty)
-    /nnstreamer.protobuf.TensorService/RecvTensors  (Empty → stream Tensors)
-  Messages are the reference's ``Tensors`` proto
-  (ext/nnstreamer/include/nnstreamer.proto, byte-level codec in
-  core/wire_protobuf). That IDL carries no caps/pts/meta channel: caps
-  derive from each message's dimension/type fields and stream close is
-  the EOS, matching the reference's semantics.
+* the reference's TensorService in BOTH its serializations
+  (``idl=protobuf`` / ``idl=flatbuf`` on the client role; servers host
+  all of them at once, so a reference peer connects unmodified):
+    /nnstreamer.protobuf.TensorService/{Send,Recv}Tensors
+    /nnstreamer.flatbuf.TensorService/{Send,Recv}Tensors
+  Messages are the reference's ``Tensors`` in proto3 wire
+  (ext/nnstreamer/include/nnstreamer.proto → core/wire_protobuf) or
+  flatbuffers wire (include/nnstreamer.fbs → core/wire_flatbuf). These
+  IDLs carry no caps/pts/meta channel: caps derive from each message's
+  dimension/type fields and stream close is the EOS, matching the
+  reference's semantics.
 
 Like the reference, BOTH elements speak BOTH roles (``server=true/false``):
   sink(server=false) --Send-->  src(server=true)     (push topology)
@@ -36,6 +38,7 @@ from __future__ import annotations
 import queue as _queue
 import threading
 from concurrent import futures
+from struct import error as struct_error
 from typing import Optional, Tuple
 
 import numpy as np
@@ -43,9 +46,9 @@ import numpy as np
 from ..core import (Buffer, Caps, TensorFormat, TensorsInfo,
                     caps_from_tensors_info, parse_caps_string,
                     tensors_info_from_caps)
+from ..core import wire_flatbuf, wire_protobuf
 from ..core.serialize import pack_tensors, unpack_tensors
 from ..core.tensors import TensorSpec
-from ..core.wire_protobuf import decode_tensors, encode_tensors
 from ..registry.elements import register_element
 from ..runtime.element import ElementError, Prop, SinkElement, SourceElement, prop_bool
 from ..runtime.pad import PadDirection, PadTemplate
@@ -56,7 +59,15 @@ SEND_METHOD = "/nnstreamer.Tensor/Send"
 RECV_METHOD = "/nnstreamer.Tensor/Recv"
 PB_SEND_METHOD = "/nnstreamer.protobuf.TensorService/SendTensors"
 PB_RECV_METHOD = "/nnstreamer.protobuf.TensorService/RecvTensors"
-IDLS = ("own", "protobuf")
+FB_SEND_METHOD = "/nnstreamer.flatbuf.TensorService/SendTensors"
+FB_RECV_METHOD = "/nnstreamer.flatbuf.TensorService/RecvTensors"
+# external IDLs: the reference's TensorService in either serialization
+# (nnstreamer.proto / nnstreamer.fbs), message codec per idl
+_EXT_IDL = {
+    "protobuf": (PB_SEND_METHOD, PB_RECV_METHOD, wire_protobuf),
+    "flatbuf": (FB_SEND_METHOD, FB_RECV_METHOD, wire_flatbuf),
+}
+IDLS = ("own",) + tuple(_EXT_IDL)
 _IDENT = lambda b: bytes(b)  # noqa: E731 — identity (de)serializer
 
 
@@ -72,9 +83,10 @@ def _check_idl(idl: str) -> str:
     return idl
 
 
-def _buffer_to_pb(buf: Buffer, info: Optional[TensorsInfo] = None) -> bytes:
-    """Buffer → reference ``Tensors`` bytes; tensor names and stream format
-    come from the negotiated ``info`` when available."""
+def _buffer_to_ext(idl: str, buf: Buffer,
+                   info: Optional[TensorsInfo] = None) -> bytes:
+    """Buffer → reference ``Tensors`` bytes (per-idl codec); tensor names
+    and stream format come from the negotiated ``info`` when available."""
     arrays = [np.ascontiguousarray(np.asarray(t))
               for t in buf.as_numpy().tensors]
     names = None
@@ -83,14 +95,13 @@ def _buffer_to_pb(buf: Buffer, info: Optional[TensorsInfo] = None) -> bytes:
         fmt = info.format
         if any(s.name for s in info.specs):
             names = [s.name for s in info.specs]
-    return encode_tensors(arrays, names=names, fmt=fmt)
+    return _EXT_IDL[idl][2].encode_tensors(arrays, names=names, fmt=fmt)
 
 
-def _pb_to_buffer(msg: bytes) -> Tuple[Buffer, Caps]:
+def _ext_to_buffer(idl: str, msg: bytes) -> Tuple[Buffer, Caps]:
     """Reference ``Tensors`` message → (Buffer, caps derived from the
-    per-message dimension/type fields — the protobuf IDL's only config
-    channel)."""
-    arrays, names, fmt, _rate = decode_tensors(bytes(msg))
+    per-message dimension/type fields — these IDLs' only config channel)."""
+    arrays, names, fmt, _rate = _EXT_IDL[idl][2].decode_tensors(bytes(msg))
     info = TensorsInfo(
         tuple(TensorSpec(a.shape, a.dtype, name) for a, name in
               zip(arrays, names)), fmt)
@@ -115,7 +126,7 @@ class GrpcTensorService:
         self._stopped = threading.Event()
         self._subs_lock = threading.Lock()
         self._subs: list = []                     # (queue, idl) per subscriber
-        self._pb_encode_warned = False
+        self._ext_encode_warned: set = set()  # idl names warned
         self._grpc = grpc
 
         def accept_caps(caps: Caps, context) -> None:
@@ -197,37 +208,48 @@ class GrpcTensorService:
             finally:
                 _unregister_sub(q, "own")
 
-        def pb_send_handler(request_iterator, context):
-            """Reference SendTensors: stream of Tensors messages; caps come
-            from each message's own config fields, stream close is EOS."""
-            for msg in request_iterator:
+        def ext_send_handler(idl):
+            """Reference SendTensors (either IDL): stream of Tensors
+            messages; caps come from each message's own config fields,
+            stream close is EOS."""
+
+            def handle(request_iterator, context):
+                for msg in request_iterator:
+                    try:
+                        buf, caps = _ext_to_buffer(idl, msg)
+                    except (ValueError, IndexError, KeyError,
+                            struct_error) as e:
+                        context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                      f"bad {idl} Tensors message: {e}")
+                    accept_caps(caps, context)
+                    if not self._inbox_put(buf, context):
+                        return b""
+                self._inbox_put(None, context)  # stream close = EOS
+                return b""  # Empty
+
+            return handle
+
+        def ext_recv_handler(idl):
+            def handle(request, context):
+                q = _register_sub(idl)
                 try:
-                    buf, caps = _pb_to_buffer(msg)
-                except (ValueError, IndexError, KeyError) as e:
-                    context.abort(grpc.StatusCode.INVALID_ARGUMENT,
-                                  f"bad Tensors message: {e}")
-                accept_caps(caps, context)
-                if not self._inbox_put(buf, context):
-                    return b""
-            self._inbox_put(None, context)  # stream close = EOS
-            return b""  # google.protobuf.Empty
+                    # no caps preamble in these IDLs: config rides in every
+                    # message, but frames only exist once the pipeline
+                    # negotiated
+                    if not self._out_caps_set.wait(timeout=10.0):
+                        context.abort(
+                            grpc.StatusCode.FAILED_PRECONDITION,
+                            "server pipeline has no negotiated caps yet")
+                    for item in _drain(q, context):
+                        if item is None:
+                            return  # EOS = end of stream (reference)
+                        yield bytes(item)
+                finally:
+                    _unregister_sub(q, idl)
 
-        def pb_recv_handler(request, context):
-            q = _register_sub("protobuf")
-            try:
-                # no caps preamble in this IDL: config rides in every
-                # message, but frames only exist once the pipeline negotiated
-                if not self._out_caps_set.wait(timeout=10.0):
-                    context.abort(grpc.StatusCode.FAILED_PRECONDITION,
-                                  "server pipeline has no negotiated caps yet")
-                for item in _drain(q, context):
-                    if item is None:
-                        return  # EOS = end of stream (reference semantics)
-                    yield bytes(item)
-            finally:
-                _unregister_sub(q, "protobuf")
+            return handle
 
-        handler = grpc.method_handlers_generic_handler(
+        handlers = [grpc.method_handlers_generic_handler(
             "nnstreamer.Tensor",
             {
                 "Send": grpc.stream_unary_rpc_method_handler(
@@ -237,23 +259,26 @@ class GrpcTensorService:
                     recv_handler, request_deserializer=_IDENT,
                     response_serializer=_IDENT),
             },
-        )
-        # the reference's service, hosted SIMULTANEOUSLY: a peer built
-        # against ext/nnstreamer/include/nnstreamer.proto connects as-is
-        pb_handler = grpc.method_handlers_generic_handler(
-            "nnstreamer.protobuf.TensorService",
-            {
-                "SendTensors": grpc.stream_unary_rpc_method_handler(
-                    pb_send_handler, request_deserializer=_IDENT,
-                    response_serializer=_IDENT),
-                "RecvTensors": grpc.unary_stream_rpc_method_handler(
-                    pb_recv_handler, request_deserializer=_IDENT,
-                    response_serializer=_IDENT),
-            },
-        )
+        )]
+        # the reference's TensorService in BOTH serializations, hosted
+        # SIMULTANEOUSLY: a peer built against nnstreamer.proto or
+        # nnstreamer.fbs connects as-is
+        for idl, (send_m, _recv_m, _codec) in _EXT_IDL.items():
+            service = send_m.rsplit("/", 2)[1]
+            handlers.append(grpc.method_handlers_generic_handler(
+                service,
+                {
+                    "SendTensors": grpc.stream_unary_rpc_method_handler(
+                        ext_send_handler(idl), request_deserializer=_IDENT,
+                        response_serializer=_IDENT),
+                    "RecvTensors": grpc.unary_stream_rpc_method_handler(
+                        ext_recv_handler(idl), request_deserializer=_IDENT,
+                        response_serializer=_IDENT),
+                },
+            ))
         self._executor = futures.ThreadPoolExecutor(max_workers=8)
         self._server = grpc.server(self._executor)
-        self._server.add_generic_rpc_handlers((handler, pb_handler))
+        self._server.add_generic_rpc_handlers(tuple(handlers))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         if self.port == 0:
             raise ElementError(f"grpc: cannot bind {host}:{port}")
@@ -303,18 +328,19 @@ class GrpcTensorService:
             if idl not in payloads:
                 if buf is None:
                     payloads[idl] = None
-                elif idl == "protobuf":
+                elif idl in _EXT_IDL:
                     try:
-                        payloads[idl] = _buffer_to_pb(buf, self._out_info)
+                        payloads[idl] = _buffer_to_ext(idl, buf,
+                                                       self._out_info)
                     except ValueError as e:
                         # e.g. bfloat16: not on the reference wire — a
-                        # connected pb peer must not kill the pipeline or
-                        # starve the own-wire subscribers
-                        if not self._pb_encode_warned:
-                            self._pb_encode_warned = True
+                        # connected external peer must not kill the
+                        # pipeline or starve the own-wire subscribers
+                        if idl not in self._ext_encode_warned:
+                            self._ext_encode_warned.add(idl)
                             logger.warning(
                                 "grpc: frame not representable in the "
-                                "protobuf IDL, skipping pb subscribers: %s", e)
+                                "%s IDL, skipping its subscribers: %s", idl, e)
                         payloads[idl] = _skip
                 else:
                     payloads[idl] = pack_tensors(buf)
@@ -338,8 +364,9 @@ class GrpcTensorService:
 
 
 class GrpcTensorClient:
-    """Client side of both methods, in either IDL (``idl="protobuf"``
-    speaks the reference's TensorService, e.g. to a reference server)."""
+    """Client side of both methods, in any IDL (``idl="protobuf"`` /
+    ``"flatbuf"`` speak the reference's TensorService in either
+    serialization, e.g. to a reference server)."""
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
                  idl: str = "own"):
@@ -358,8 +385,8 @@ class GrpcTensorClient:
     # -- push topology: we stream frames to a remote Send ------------------
     def start_send(self, caps: Caps) -> None:
         self._send_q = _queue.Queue(64)
-        if self._idl == "protobuf":
-            method = PB_SEND_METHOD  # no caps preamble in this IDL
+        if self._idl in _EXT_IDL:
+            method = _EXT_IDL[self._idl][0]  # no caps preamble in these IDLs
             try:  # names/format for the Tensors messages
                 self._send_info = tensors_info_from_caps(caps)
             except (ValueError, KeyError):
@@ -380,26 +407,26 @@ class GrpcTensorClient:
         self._send_future = stub.future(gen())
 
     def send(self, buf: Buffer) -> None:
-        if self._idl == "protobuf":
-            self._send_q.put(_buffer_to_pb(buf, self._send_info))
+        if self._idl in _EXT_IDL:
+            self._send_q.put(_buffer_to_ext(self._idl, buf, self._send_info))
         else:
             self._send_q.put(b"D" + bytes(pack_tensors(buf)))
 
     def finish_send(self, timeout: float = 10.0) -> None:
-        if self._idl != "protobuf":
+        if self._idl not in _EXT_IDL:
             self._send_q.put(b"E")
-        self._send_q.put(None)  # close the request stream (pb: EOS itself)
+        self._send_q.put(None)  # close the request stream (ext: EOS itself)
         if self._send_future is not None:
             self._send_future.result(timeout=timeout)
 
     # -- pull topology: we consume a remote Recv stream --------------------
     def recv_stream(self):
         """Yields (caps, iterator-of-Buffer-or-None)."""
-        if self._idl == "protobuf":
+        if self._idl in _EXT_IDL:
             stub = self._channel.unary_stream(
-                PB_RECV_METHOD, request_serializer=_IDENT,
+                _EXT_IDL[self._idl][1], request_serializer=_IDENT,
                 response_deserializer=_IDENT)
-            stream = stub(b"")  # google.protobuf.Empty
+            stream = stub(b"")  # Empty
             self._recv_call = stream
             # caps derive from the first Tensors message's config fields;
             # bound the wait (gRPC streams have no timed next, and an RPC
@@ -418,21 +445,21 @@ class GrpcTensorClient:
             except _queue.Empty:
                 stream.cancel()
                 raise ConnectionError(
-                    f"grpc pb Recv: no frame within {self._timeout}s "
+                    f"grpc ext Recv: no frame within {self._timeout}s "
                     "(remote negotiated but never published?)")
             if kind == "err":
                 raise ConnectionError(
-                    f"grpc pb Recv stream ended before the first frame: {val}")
-            first_buf, caps = _pb_to_buffer(val)
+                    f"grpc ext Recv stream ended before the first frame: {val}")
+            first_buf, caps = _ext_to_buffer(self._idl, val)
 
-            def pb_frames():
+            def ext_frames():
                 yield first_buf
                 for msg in stream:
-                    buf, _caps = _pb_to_buffer(msg)
+                    buf, _caps = _ext_to_buffer(self._idl, msg)
                     yield buf
                 yield None  # stream close = EOS
 
-            return caps, pb_frames()
+            return caps, ext_frames()
         stub = self._channel.unary_stream(
             RECV_METHOD, request_serializer=_IDENT, response_deserializer=_IDENT)
         stream = stub(b"")
@@ -480,8 +507,9 @@ class TensorSrcGrpc(SourceElement):
         "caps": Prop(None, str, "expected caps (optional in server mode)"),
         "timeout": Prop(10.0, float, "caps handshake timeout"),
         "idl": Prop("own", str,
-                    "client-role wire: own | protobuf (reference "
-                    "TensorService IDL); servers host both at once"),
+                    "client-role wire: own | protobuf | flatbuf (the "
+                    "reference TensorService in either serialization); "
+                    "servers host all three at once"),
     }
 
     def __init__(self, name=None, **props):
@@ -560,8 +588,9 @@ class TensorSinkGrpc(SinkElement):
         "port": Prop(0, int, "connect/listen port (0 server = ephemeral)"),
         "timeout": Prop(10.0, float, "connect timeout"),
         "idl": Prop("own", str,
-                    "client-role wire: own | protobuf (reference "
-                    "TensorService IDL); servers host both at once"),
+                    "client-role wire: own | protobuf | flatbuf (the "
+                    "reference TensorService in either serialization); "
+                    "servers host all three at once"),
     }
 
     def __init__(self, name=None, **props):

@@ -143,7 +143,8 @@ class TestReferencePeer:
 
 
 class TestOwnElementsProtobufIdl:
-    def test_push_loopback_idl_protobuf(self):
+    @pytest.mark.parametrize("idl", ["protobuf", "flatbuf"])
+    def test_push_loopback_ext_idl(self, idl):
         recv = parse_launch(
             "tensor_src_grpc name=g server=true port=0 "
             "caps=other/tensors,format=static,dimensions=4,types=float32 "
@@ -157,7 +158,7 @@ class TestOwnElementsProtobufIdl:
             send = parse_launch(
                 "tensor_src num-buffers=4 dimensions=4 types=float32 "
                 "pattern=counter "
-                f"! tensor_sink_grpc server=false port={port} idl=protobuf")
+                f"! tensor_sink_grpc server=false port={port} idl={idl}")
             send.play()
             send.wait(timeout=10)
             _wait(lambda: len(out) >= 4)
@@ -167,7 +168,8 @@ class TestOwnElementsProtobufIdl:
         finally:
             recv.stop()
 
-    def test_pull_loopback_idl_protobuf(self):
+    @pytest.mark.parametrize("idl", ["protobuf", "flatbuf"])
+    def test_pull_loopback_ext_idl(self, idl):
         send = parse_launch(
             "appsrc name=in "
             "caps=other/tensors,format=static,dimensions=2:3,types=uint8 "
@@ -177,7 +179,7 @@ class TestOwnElementsProtobufIdl:
         port = send.get("g").bound_port
         try:
             recv = parse_launch(
-                f"tensor_src_grpc server=false port={port} idl=protobuf "
+                f"tensor_src_grpc server=false port={port} idl={idl} "
                 "! tensor_sink name=out max-stored=8")
             out = []
             recv.get("out").connect(out.append)
