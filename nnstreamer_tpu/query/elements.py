@@ -34,21 +34,59 @@ _TENSOR_CAPS = Caps.new("other/tensors")
 
 
 def _connect_type(v) -> str:
-    """reference connect-type values TCP|HYBRID|AITT; only TCP exists here
-    (HYBRID/AITT are broker transports covered by the mqtt/edge elements).
-    Validated at property-set so a launch-line typo fails immediately."""
+    """reference connect-type values TCP|HYBRID|AITT. TCP = direct
+    address; HYBRID = MQTT broker carries the topic→address advertisement,
+    data still flows direct TCP (query/hybrid.py). AITT is a Samsung
+    transport with no analog here. Validated at property-set so a
+    launch-line typo fails immediately."""
     s = str(v).upper()
-    if s != "TCP":
+    if s not in ("TCP", "HYBRID"):
         raise ValueError(
-            f"connect-type {v!r} not supported: only TCP (use the mqtt/edge "
-            "elements for broker transports)")
+            f"connect-type {v!r} not supported: TCP | HYBRID (AITT is a "
+            "Samsung-stack transport with no TPU-rig analog)")
     return s
 
 _CONNECT_TYPE_PROP = Prop(
     "TCP", _connect_type,
-    "transport (reference connect-type); only TCP is implemented — "
-    "HYBRID/AITT are edge-broker transports this framework covers via "
-    "its own MQTT/edge elements")
+    "transport (reference connect-type): TCP = direct host/port; HYBRID = "
+    "discover the data server via an MQTT broker (dest-host/dest-port + "
+    "topic), then direct TCP data")
+
+
+def _hybrid_topic(el) -> str:
+    """The discovery topic; HYBRID is meaningless without one, so an empty
+    topic fails at start instead of hanging a discovery timeout."""
+    topic = el.props["topic"]
+    if not topic:
+        raise ElementError(
+            f"{el.describe()}: connect-type=HYBRID requires topic=")
+    return topic
+
+
+def _hybrid_advertise(el, data_port: int) -> None:
+    """Publish this element's data-server address for its topic. The
+    advertised host is ``advertise-host`` when set (REQUIRED knowledge for
+    wildcard binds: 0.0.0.0/:: is connectable only from the same machine)."""
+    from .hybrid import advertise
+
+    host = el.props["advertise_host"] or el.props["host"]
+    if host in ("0.0.0.0", "::") and not el.props["advertise_host"]:
+        logger.warning(
+            "%s: advertising wildcard bind address %s — remote clients "
+            "cannot connect to it; set advertise-host to this machine's "
+            "reachable address", el.name, host)
+    advertise(el.props["dest_host"], el.props["dest_port"],
+              _hybrid_topic(el), host, data_port)
+
+
+def _hybrid_withdraw(el) -> None:
+    from .hybrid import withdraw
+
+    try:  # best effort: the broker may already be gone at teardown
+        withdraw(el.props["dest_host"], el.props["dest_port"],
+                 _hybrid_topic(el))
+    except (ConnectionError, OSError):
+        pass
 
 
 
@@ -64,8 +102,14 @@ class TensorQueryClient(Element):
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
     PROPERTIES = {
         "connect_type": _CONNECT_TYPE_PROP,
-        "host": Prop("127.0.0.1", str, "server host (reference dest-host)"),
-        "port": Prop(0, int, "server port (reference dest-port)"),
+        "host": Prop("127.0.0.1", str,
+                     "server host (reference dest-host); with "
+                     "connect-type=HYBRID this is the MQTT broker host"),
+        "port": Prop(0, int,
+                     "server port (reference dest-port); with HYBRID the "
+                     "MQTT broker port"),
+        "topic": Prop("", str,
+                      "HYBRID: discovery topic the server advertised under"),
         "timeout": Prop(10.0, float,
                         "connect/handshake timeout seconds (reference "
                         "QUERY_DEFAULT_TIMEOUT_SEC, tensor_query_common.h:28)"),
@@ -91,8 +135,16 @@ class TensorQueryClient(Element):
         self._reconnect_error: Optional[str] = None
 
     def _new_client(self) -> QueryClient:
-        return QueryClient(self.props["host"], self.props["port"],
-                           self.props["timeout"])
+        host, port = self.props["host"], self.props["port"]
+        if self.props["connect_type"] == "HYBRID":
+            # re-discovered on EVERY connect (incl. reconnects): a server
+            # that came back on a different address is found via the broker
+            from .hybrid import discover
+
+            host, port = discover(host, port, _hybrid_topic(self),
+                                  self.props["timeout"],
+                                  abort=self._stopping)
+        return QueryClient(host, port, self.props["timeout"])
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
         self._in_caps = caps
@@ -223,6 +275,13 @@ class TensorQueryServerSrc(SourceElement):
         "port": Prop(0, int, "listen port (0 = ephemeral; see bound_port)"),
         "id": Prop(0, int, "shared server id (pairs src and sink)"),
         "caps": Prop(None, str, "caps this server accepts/produces on its src"),
+        "dest_host": Prop("127.0.0.1", str,
+                          "HYBRID: MQTT broker host to advertise on"),
+        "dest_port": Prop(1883, int, "HYBRID: MQTT broker port"),
+        "topic": Prop("", str, "HYBRID: discovery topic to advertise under"),
+        "advertise_host": Prop("", str,
+                               "HYBRID: address to advertise instead of the "
+                               "bind host (required when binding 0.0.0.0)"),
     }
 
     def __init__(self, name=None, **props):
@@ -242,6 +301,8 @@ class TensorQueryServerSrc(SourceElement):
             # remote caps negotiation: reject clients whose stream cannot
             # intersect this server's declared input caps
             self.server.accept_caps = accepted.can_intersect
+        if self.props["connect_type"] == "HYBRID":
+            _hybrid_advertise(self, self.server.port)
         super().start()
 
     def get_src_caps(self) -> Caps:
@@ -263,6 +324,8 @@ class TensorQueryServerSrc(SourceElement):
     def stop(self) -> None:
         super().stop()
         if self.server is not None:
+            if self.props["connect_type"] == "HYBRID":
+                _hybrid_withdraw(self)
             release_shared_server(self.props["id"])
             self.server = None
 
@@ -321,6 +384,12 @@ class EdgeSink(SinkElement):
         "host": Prop("127.0.0.1", str),
         "port": Prop(0, int, "broker listen port (0 = ephemeral)"),
         "topic": Prop("", str),
+        "dest_host": Prop("127.0.0.1", str,
+                          "HYBRID: MQTT broker host to advertise on"),
+        "dest_port": Prop(1883, int, "HYBRID: MQTT broker port"),
+        "advertise_host": Prop("", str,
+                               "HYBRID: address to advertise instead of the "
+                               "bind host (required when binding 0.0.0.0)"),
     }
 
     def __init__(self, name=None, **props):
@@ -333,6 +402,8 @@ class EdgeSink(SinkElement):
 
     def start(self) -> None:
         self.broker = get_broker(self.props["host"], self.props["port"])
+        if self.props["connect_type"] == "HYBRID":
+            _hybrid_advertise(self, self.broker.port)
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
         self.broker.set_topic_caps(self.props["topic"], caps)
@@ -342,6 +413,8 @@ class EdgeSink(SinkElement):
 
     def stop(self) -> None:
         if self.broker is not None:
+            if self.props["connect_type"] == "HYBRID":
+                _hybrid_withdraw(self)
             release_broker(self.broker)
             self.broker = None
 
@@ -367,8 +440,16 @@ class EdgeSrc(SourceElement):
     def get_src_caps(self) -> Caps:
         from .edge import Subscriber
 
-        self._sub = Subscriber(self.props["dest_host"], self.props["dest_port"],
-                               self.props["topic"], self.props["timeout"])
+        host, port = self.props["dest_host"], self.props["dest_port"]
+        if self.props["connect_type"] == "HYBRID":
+            # dest-host/dest-port name the MQTT broker; the data broker's
+            # address comes from its retained advertisement
+            from .hybrid import discover
+
+            host, port = discover(host, port, _hybrid_topic(self),
+                                  self.props["timeout"])
+        self._sub = Subscriber(host, port, self.props["topic"],
+                               self.props["timeout"])
         return self._sub.caps
 
     def create(self) -> Optional[Buffer]:

@@ -256,7 +256,12 @@ class MiniBroker:
                     body = payload[2 + tlen:]
                     if flags & 0x01:  # retain
                         with self._lock:
-                            self._retained[topic] = body
+                            if body:
+                                self._retained[topic] = body
+                            else:
+                                # MQTT 3.1.1 [3.3.1.3]: a zero-length
+                                # retained payload DELETES the slot
+                                self._retained.pop(topic, None)
                     self._fanout(topic, body)
                 elif ptype == SUBSCRIBE:
                     (pkt_id,) = struct.unpack_from(">H", payload, 0)

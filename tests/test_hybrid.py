@@ -1,0 +1,192 @@
+"""MQTT-hybrid connect-type (reference nnstreamer-edge HYBRID: MQTT
+broker for topic→address discovery, direct TCP for tensor data —
+CHANGES:11 "mqtt control + tcp data", SURVEY §2.8/§5.8).
+
+The broker carries only tiny retained advertisements; these tests pin
+discovery, full query offload and edge pub/sub over HYBRID, withdrawal,
+and the elastic win TCP mode can't have: a client re-discovers a server
+that came back on a DIFFERENT port.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.query.hybrid import advertise, discover, withdraw
+from nnstreamer_tpu.query.mqtt import MiniBroker
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+@pytest.fixture()
+def broker():
+    b = MiniBroker()
+    yield b
+    b.stop()
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert cond()
+
+
+class TestDiscovery:
+    def test_advertise_discover_roundtrip(self, broker):
+        advertise(broker.host, broker.port, "cam0", "10.0.0.5", 5001)
+        assert discover(broker.host, broker.port, "cam0") == ("10.0.0.5", 5001)
+
+    def test_retained_for_late_subscriber(self, broker):
+        advertise(broker.host, broker.port, "late", "h", 7)
+        time.sleep(0.05)  # discovery starts well after the publish
+        assert discover(broker.host, broker.port, "late") == ("h", 7)
+
+    def test_discover_timeout_when_unadvertised(self, broker):
+        with pytest.raises(ConnectionError, match="no data server"):
+            discover(broker.host, broker.port, "ghost", timeout=0.3)
+
+    def test_withdraw_clears(self, broker):
+        advertise(broker.host, broker.port, "gone", "h", 9)
+        withdraw(broker.host, broker.port, "gone")
+        with pytest.raises(ConnectionError):
+            discover(broker.host, broker.port, "gone", timeout=0.3)
+
+    def test_ipv6_host_parses(self, broker):
+        advertise(broker.host, broker.port, "v6", "::1", 5001)
+        assert discover(broker.host, broker.port, "v6") == ("::1", 5001)
+
+    def test_empty_topic_fails_fast(self, broker):
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            f"appsrc name=in caps={CAPS} "
+            f"! tensor_query_client connect-type=HYBRID host={broker.host} "
+            f"port={broker.port} "
+            "! tensor_sink name=out")
+        import time as _t
+        t0 = _t.monotonic()
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+        pipe.stop()
+        assert msg is not None and "topic" in str(msg.data)
+        assert _t.monotonic() - t0 < 5, "must fail fast, not discovery-timeout"
+
+    def test_live_publish_resolves_waiting_discover(self, broker):
+        """Client starts BEFORE the server: discover blocks on the
+        subscription and the live advertisement releases it."""
+        import threading
+
+        got = {}
+
+        def late_advertise():
+            time.sleep(0.2)
+            advertise(broker.host, broker.port, "race", "hh", 42)
+
+        threading.Thread(target=late_advertise, daemon=True).start()
+        got["addr"] = discover(broker.host, broker.port, "race", timeout=5)
+        assert got["addr"] == ("hh", 42)
+
+
+CAPS = "other/tensors,format=static,dimensions=4,types=float32"
+
+
+def _start_hybrid_server(broker, topic, server_id, model="builtin://scaler?factor=3"):
+    pipe = parse_launch(
+        f"tensor_query_serversrc name=ssrc id={server_id} port=0 "
+        f"connect-type=HYBRID dest-host={broker.host} dest-port={broker.port} "
+        f"topic={topic} caps={CAPS} "
+        f"! tensor_filter framework=jax model={model} "
+        f"! tensor_query_serversink id={server_id}")
+    pipe.play()
+    _wait(lambda: pipe.get("ssrc").bound_port != 0)
+    return pipe
+
+
+class TestHybridQueryOffload:
+    def test_offload_via_discovery(self, broker):
+        server = _start_hybrid_server(broker, "offload", 60)
+        try:
+            client = parse_launch(
+                f"appsrc name=in caps={CAPS} "
+                f"! tensor_query_client connect-type=HYBRID "
+                f"host={broker.host} port={broker.port} topic=offload "
+                "! tensor_sink name=out max-stored=8")
+            out = []
+            client.get("out").connect(out.append)
+            client.play()
+            src = client.get("in")
+            for i in range(3):
+                src.push_buffer(np.full(4, i, np.float32))
+            src.end_of_stream()
+            _wait(lambda: len(out) >= 3)
+            client.stop()
+            np.testing.assert_allclose(np.asarray(out[2].tensors[0]), 6.0)
+        finally:
+            server.stop()
+
+    def test_client_rediscovers_moved_server(self, broker):
+        """The elastic payoff: the server dies and comes back on a NEW
+        ephemeral port; the client's reconnect re-runs discovery and the
+        stream continues — impossible with a fixed dest-host/dest-port."""
+        server = _start_hybrid_server(broker, "moving", 61)
+        client = parse_launch(
+            f"appsrc name=in caps={CAPS} "
+            f"! tensor_query_client name=qc connect-type=HYBRID "
+            f"host={broker.host} port={broker.port} topic=moving "
+            "reconnect-window=15 "
+            "! tensor_sink name=out max-stored=16")
+        out = []
+        client.get("out").connect(out.append)
+        client.play()
+        src = client.get("in")
+        try:
+            src.push_buffer(np.full(4, 1.0, np.float32))
+            _wait(lambda: len(out) >= 1)
+            port_a = server.get("ssrc").bound_port
+            server.stop()  # withdraws its advertisement
+            # new server, same topic, NEW port (id differs too)
+            server = _start_hybrid_server(broker, "moving", 62)
+            assert server.get("ssrc").bound_port != port_a
+            # wait for the client to re-establish, then stream again
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                src.push_buffer(np.full(4, 5.0, np.float32))
+                if len(out) >= 2:
+                    break
+                time.sleep(0.3)
+            _wait(lambda: len(out) >= 2)
+            np.testing.assert_allclose(np.asarray(out[-1].tensors[0]), 15.0)
+        finally:
+            client.stop()
+            server.stop()
+
+
+class TestHybridEdge:
+    def test_edge_pubsub_via_discovery(self, broker):
+        pub = parse_launch(
+            "tensor_src num-buffers=30 framerate=30/1 dimensions=4 "
+            "types=float32 pattern=counter "
+            "! edgesink name=es connect-type=HYBRID topic=sensor0 port=0 "
+            f"dest-host={broker.host} dest-port={broker.port}")
+        pub.play()
+        _wait(lambda: pub.get("es").bound_port != 0)
+        try:
+            sub = parse_launch(
+                f"edgesrc connect-type=HYBRID topic=sensor0 "
+                f"dest-host={broker.host} dest-port={broker.port} "
+                "! tensor_sink name=out max-stored=8")
+            out = []
+            sub.get("out").connect(out.append)
+            sub.play()
+            _wait(lambda: len(out) >= 3)
+            sub.stop()
+            vals = [float(np.asarray(b.tensors[0])[0]) for b in out]
+            assert vals == sorted(vals)
+        finally:
+            pub.stop()
+
+    def test_bad_connect_type_rejected(self):
+        with pytest.raises(ValueError, match="AITT"):
+            parse_launch(f"appsrc caps={CAPS} "
+                         "! tensor_query_client connect-type=AITT "
+                         "! tensor_sink")
