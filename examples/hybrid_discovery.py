@@ -35,7 +35,10 @@ def start_worker(broker, server_id, factor):
         f"! tensor_filter framework=jax model=builtin://scaler?factor={factor} "
         f"! tensor_query_serversink id={server_id}")
     pipe.play()
+    deadline = time.monotonic() + 10
     while pipe.get("src").bound_port == 0:
+        if time.monotonic() > deadline:
+            raise RuntimeError("worker never bound a port (see bus errors)")
         time.sleep(0.01)
     print(f"worker up on port {pipe.get('src').bound_port} "
           f"(advertised on the broker under 'demo')")
@@ -58,7 +61,10 @@ def main():
     src = client.get("in")
 
     src.push_buffer(np.full(4, 1.0, np.float32))
+    deadline = time.monotonic() + 15
     while len(got) < 1:
+        if time.monotonic() > deadline:
+            raise RuntimeError("no answer from the discovered worker")
         time.sleep(0.02)
     print(f"answer via discovered worker: {np.asarray(got[0].tensors[0])[0]}")
 

@@ -18,9 +18,10 @@ from ..core import (
     TensorsInfo,
     caps_from_tensors_info,
     parse_caps_string,
+    tensors_info_from_caps,
 )
 from ..registry.elements import register_element
-from ..runtime.element import Prop, SinkElement, SourceElement
+from ..runtime.element import Prop, SinkElement, SourceElement, prop_bool
 from ..runtime.pad import PadDirection, PadTemplate
 
 
@@ -90,19 +91,56 @@ class TensorRepoSrc(SourceElement):
         "slot_index": Prop(0, int, "repository slot id"),
         "caps": Prop(None, str, "stream caps (repo carries no negotiation)"),
         "timeout": Prop(5.0, float, "seconds to wait per frame before EOS"),
+        "initial_dummy": Prop(False, prop_bool,
+                              "emit one ZERO buffer before the slot's first "
+                              "frame — bootstraps mux-feedback (RNN/LSTM) "
+                              "loops that would otherwise deadlock on frame "
+                              "0 (reference reposrc does this always, "
+                              "gsttensor_reposrc.c:287-338)"),
     }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._primed = False
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._primed = False
 
     def get_src_caps(self) -> Caps:
         if not self.props["caps"]:
             raise ValueError(f"{self.describe()}: caps property required")
         return parse_caps_string(self.props["caps"])
 
+    def _dummy_buffer(self) -> Buffer:
+        """Zeros shaped from the declared caps (the reference's
+        gen_dummy_buffer: memset-0 memories per tensor)."""
+        import numpy as np
+
+        info = tensors_info_from_caps(parse_caps_string(self.props["caps"]))
+        if not info.specs or any(None in s.shape or not s.shape
+                                 for s in info.specs):
+            raise ValueError(
+                f"{self.describe()}: initial-dummy requires fully-fixated "
+                "static caps to shape the zero buffer")
+        return Buffer([np.zeros(tuple(s.shape), s.dtype.np_dtype)
+                       for s in info.specs])
+
     def create(self) -> Optional[Buffer]:
+        import time
+
+        if self.props["initial_dummy"] and not self._primed:
+            self._primed = True
+            return self._dummy_buffer()
         slot = REPO.slot(self.props["slot_index"])
+        timeout = self.props["timeout"]
+        deadline = time.monotonic() + timeout if timeout > 0 else None
         while self.running:
             buf = slot.pop(timeout=0.1)
             if buf is not None:
                 return buf
             if slot.eos:
                 return None
+            if deadline is not None and time.monotonic() >= deadline:
+                return None  # documented per-frame timeout: stream ends
         return None

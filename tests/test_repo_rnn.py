@@ -1,0 +1,105 @@
+"""RNN/LSTM-style feedback pipelines over tensor_repo (reference
+tests/nnstreamer_repo_rnn + _lstm: tensor_mux joins the input stream with
+the previous output replayed through a repo slot, a stateful filter
+produces the next state, tee feeds it back via tensor_repo_sink).
+
+The loop bootstraps through reposrc's initial ZERO dummy buffer
+(gsttensor_reposrc.c:287-338) — without it frame 0 deadlocks waiting on a
+state that doesn't exist yet. Here that behavior is the opt-in
+``initial-dummy`` property (our default preserves exact frame counts for
+replay pipelines; the reference emits the dummy unconditionally).
+"""
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.backends.custom_easy import (register_custom_easy,
+                                                 unregister_custom_easy)
+from nnstreamer_tpu.elements.repo import REPO
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+@pytest.fixture()
+def rnn_cell():
+    # the reference's dummyRNN role: next_state = (x + prev_state) / 2
+    register_custom_easy(
+        "avg_rnn", lambda t: [(np.asarray(t[0]) + np.asarray(t[1])) / 2.0])
+    yield
+    unregister_custom_easy("avg_rnn")
+
+
+class TestRepoRnnLoop:
+    def test_recurrence_values_exact(self, rnn_cell):
+        """The reference RNN topology, golden-checked analytically:
+        h_k = (x_k + h_{k-1})/2 with h_{-1} = 0 and x_k = k."""
+        REPO.reset()
+        pipe = parse_launch(
+            "tensor_mux name=mux sync-mode=nosync "
+            "! tensor_filter framework=custom-easy model=avg_rnn "
+            "! tee name=t "
+            "t. ! queue ! tensor_repo_sink slot-index=31 "
+            "t. ! queue ! tensor_sink name=out max-stored=0 "
+            "tensor_src num-buffers=8 dimensions=4 types=float32 "
+            "pattern=counter ! mux.sink_0 "
+            "tensor_repo_src slot-index=31 initial-dummy=true "
+            "caps=other/tensors,format=static,dimensions=4,types=float32 "
+            "! mux.sink_1")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        deadline = time.monotonic() + 15
+        while len(got) < 8 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        pipe.stop()
+        assert len(got) >= 8, f"feedback loop stalled at {len(got)} states"
+        h = 0.0
+        for k in range(8):
+            h = (k + h) / 2.0
+            np.testing.assert_allclose(
+                np.asarray(got[k].tensors[0]), np.full(4, h, np.float32),
+                rtol=1e-6, err_msg=f"state {k}")
+
+    def test_without_initial_dummy_loop_stalls(self, rnn_cell):
+        """Negative control: the same loop minus initial-dummy deadlocks
+        on frame 0 (state never exists), proving the dummy is what
+        bootstraps it."""
+        REPO.reset()
+        pipe = parse_launch(
+            "tensor_mux name=mux sync-mode=nosync "
+            "! tensor_filter framework=custom-easy model=avg_rnn "
+            "! tee name=t "
+            "t. ! queue ! tensor_repo_sink slot-index=32 "
+            "t. ! queue ! tensor_sink name=out max-stored=0 "
+            "tensor_src num-buffers=4 dimensions=4 types=float32 "
+            "pattern=counter ! mux.sink_0 "
+            "tensor_repo_src slot-index=32 timeout=0.5 "
+            "caps=other/tensors,format=static,dimensions=4,types=float32 "
+            "! mux.sink_1")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        time.sleep(1.0)
+        pipe.stop()
+        assert len(got) == 0
+
+    def test_initial_dummy_requires_fixated_caps(self):
+        REPO.reset()
+        from nnstreamer_tpu.elements.repo import TensorRepoSrc
+
+        src = TensorRepoSrc(slot_index=33, initial_dummy=True,
+                            caps="other/tensors,format=flexible")
+        with pytest.raises(ValueError, match="fixated"):
+            src._dummy_buffer()
+
+    def test_dummy_is_zeros_with_declared_shape(self):
+        REPO.reset()
+        from nnstreamer_tpu.elements.repo import TensorRepoSrc
+
+        src = TensorRepoSrc(
+            slot_index=34, initial_dummy=True,
+            caps="other/tensors,format=static,dimensions=2:3,types=int16")
+        buf = src._dummy_buffer()
+        a = np.asarray(buf.tensors[0])
+        assert a.shape == (3, 2) and a.dtype == np.int16
+        assert not a.any()
