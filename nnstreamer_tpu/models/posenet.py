@@ -43,8 +43,9 @@ def build_posenet(num_keypoints: int = _NUM_KEYPOINTS, image_size: int = 224,
             return jax.nn.sigmoid(heat.astype(jnp.float32))
 
     model = PoseNet()
-    rng = jax.random.PRNGKey(0)
-    params = model.init(rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32))
+    from ._blocks import init_params
+
+    params = init_params(model, (1, image_size, image_size, 3))
 
     def apply_fn(params, x):
         return model.apply(params, x)

@@ -131,8 +131,9 @@ def build_ssd_mobilenet(num_classes: int = 91, image_size: int = 224,
             return loc, conf
 
     model = SSD()
-    rng = jax.random.PRNGKey(0)
-    params = model.init(rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32))
+    from ._blocks import init_params
+
+    params = init_params(model, (1, image_size, image_size, 3))
     anchors_j = jnp.asarray(anchors)
     vy, vx, vh, vw = _VARIANCES
 

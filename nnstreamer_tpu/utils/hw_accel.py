@@ -191,6 +191,47 @@ def configure_default_platform(log=None) -> Optional[str]:
     return err
 
 
+def enable_persistent_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point XLA's persistent compilation cache at a directory so warm
+    starts skip recompiles (first MobileNet batch graph costs ~26-34 s to
+    compile; a second process pays ~0 with the cache). The bench/driver
+    paths call this so the round-end measurement never burns its budget
+    recompiling what the watcher already compiled.
+
+    Default dir ``/tmp/nns_xla_cache``; override with ``NNS_XLA_CACHE``
+    (set to ``0``/``off`` to disable). Returns the path in use, or None.
+
+    Accelerators only: on CPU the cached AOT result embeds exact machine
+    features and the loader warns about SIGILL risk on mismatch (observed
+    on this rig: prefer-no-scatter/gather features rejected at load) —
+    the ~1-2 s it would save there isn't worth executing suspect code.
+    """
+    import jax
+
+    # read the CONFIGURED platform string — never jax.default_backend(),
+    # which forces in-process backend init (the exact multi-minute hang
+    # the probe machinery exists to avoid). The bench/driver paths always
+    # set jax_platforms before calling; unset = don't enable.
+    plats = getattr(jax.config, "jax_platforms", None)
+    first = (plats or "").split(",")[0].strip().lower()
+    if first in ("", "cpu"):
+        return None
+    path = path if path is not None else os.environ.get(
+        "NNS_XLA_CACHE", "/tmp/nns_xla_cache")
+    if not path or str(path).lower() in ("0", "off", "none", "false"):
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache even fast compiles (default threshold is 1s): the bench
+        # sweeps several batch sizes and every skipped compile is
+        # measurement budget
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001 — older jax w/o the knobs: run uncached
+        return None
+    return path
+
+
 def available_accelerators(timeout_s: float = 15.0) -> Dict[str, Optional[bool]]:
     """Probe the platforms this build cares about (cpu always; tpu/axon
     for the device path). Probes run concurrently so the worst case is

@@ -80,6 +80,11 @@ def main() -> None:
         from nnstreamer_tpu.utils.hw_accel import configure_default_platform
 
         configure_default_platform(log=_log)
+    from nnstreamer_tpu.utils.hw_accel import enable_persistent_compilation_cache
+
+    cache_dir = enable_persistent_compilation_cache()
+    if cache_dir:
+        _log(f"persistent XLA compile cache: {cache_dir}")
     platform = jax.devices()[0].platform
     _log(f"platform: {platform}")
 
