@@ -1,0 +1,60 @@
+/* offset.cc — C++ CLASS custom filter (static shapes).
+ *
+ * The C++-class flavor of a custom filter (reference tensor_filter_cpp):
+ * derive from nns::CustomFilter, register with one macro, build as a
+ * normal shared object:
+ *
+ *   g++ -shared -fPIC -O2 -std=c++17 -I <repo>/nnstreamer_tpu/native/csrc \
+ *       offset.cc -o liboffset.so
+ *
+ *   tensor_filter framework=custom model=./liboffset.so custom=offset:1.5
+ *
+ * Adds a constant offset to a fixed 1x4 float32 tensor.
+ */
+#include <cstring>
+#include <string>
+
+#include "nns_custom_filter.hh"
+
+class Offset : public nns::CustomFilter {
+ public:
+  explicit Offset(const std::string &options) : offset_(0.0f) {
+    const std::string key = "offset:";
+    auto pos = options.find(key);
+    if (pos == std::string::npos) return;
+    try {
+      offset_ = std::stof(options.substr(pos + key.size()));
+    } catch (const std::exception &) {
+      // malformed value: keep the 0.0 default rather than failing open
+      // with an opaque error (this file is the template users copy)
+    }
+  }
+
+  bool get_info(nns_tensors_spec *in, nns_tensors_spec *out) override {
+    std::memset(in, 0, sizeof(*in));
+    std::memset(out, 0, sizeof(*out));
+    in->num = out->num = 1;
+    for (nns_tensors_spec *s : {in, out}) {
+      s->spec[0].dtype = NNS_FLOAT32;
+      s->spec[0].rank = 2;
+      s->spec[0].dims[0] = 1;
+      s->spec[0].dims[1] = 4;
+    }
+    return true;
+  }
+
+  int invoke(const nns_tensor_view *in, uint32_t n_in, nns_tensor_view *out,
+             uint32_t n_out) override {
+    if (n_in != 1 || n_out != 1 || in[0].size != out[0].size) return -2;
+    const float *src = static_cast<const float *>(in[0].data);
+    float *dst = static_cast<float *>(out[0].data);
+    for (uint64_t i = 0; i < in[0].size / sizeof(float); ++i)
+      dst[i] = src[i] + offset_;
+    return 0;
+  }
+
+ private:
+  float offset_;
+};
+
+NNS_REGISTER_CUSTOM_FILTER(Offset)

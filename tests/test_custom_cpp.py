@@ -1,0 +1,122 @@
+"""C++ CLASS custom filters over the C ABI (reference tensor_filter_cpp:
+user C++ classes as filters, ext/nnstreamer/tensor_filter/
+tensor_filter_cpp.cc). nns_custom_filter.hh adapts a nns::CustomFilter
+subclass into the C vtable with one macro; these tests compile real .so
+plugins with g++ and drive them through the backend and a pipeline.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from custom_c_util import REPO, compile_plugin
+from nnstreamer_tpu.backends.base import FilterProperties
+from nnstreamer_tpu.core import DataType, TensorsInfo
+from nnstreamer_tpu.core.tensors import TensorSpec
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+OFFSET_SRC = os.path.join(REPO, "examples", "custom_filters", "offset.cc")
+
+# dynamic-shape class: overrides set_input (reference setInputDimension) —
+# output spec mirrors whatever input was negotiated; invoke negates
+DYNAMIC_SRC = r"""
+#include <cstring>
+#include "nns_custom_filter.hh"
+
+class Negate : public nns::CustomFilter {
+ public:
+  explicit Negate(const std::string &) {}
+  bool set_input(const nns_tensors_spec *in, nns_tensors_spec *out) override {
+    std::memcpy(out, in, sizeof(*out));  // same shape/dtype out
+    return true;
+  }
+  int invoke(const nns_tensor_view *in, uint32_t n_in, nns_tensor_view *out,
+             uint32_t n_out) override {
+    if (n_in != 1 || n_out != 1) return -2;
+    const float *s = static_cast<const float *>(in[0].data);
+    float *d = static_cast<float *>(out[0].data);
+    for (uint64_t i = 0; i < in[0].size / sizeof(float); ++i) d[i] = -s[i];
+    return 0;
+  }
+};
+NNS_REGISTER_CUSTOM_FILTER(Negate)
+"""
+
+# a constructor that throws must surface as a clean open failure
+THROWING_SRC = r"""
+#include <stdexcept>
+#include "nns_custom_filter.hh"
+
+class Broken : public nns::CustomFilter {
+ public:
+  explicit Broken(const std::string &) { throw std::runtime_error("boom"); }
+  int invoke(const nns_tensor_view *, uint32_t, nns_tensor_view *,
+             uint32_t) override { return 0; }
+  bool get_info(nns_tensors_spec *, nns_tensors_spec *) override {
+    return true;
+  }
+};
+NNS_REGISTER_CUSTOM_FILTER(Broken)
+"""
+
+
+@pytest.fixture(scope="module")
+def offset_so():
+    return compile_plugin(OFFSET_SRC, "offset_cpp")
+
+
+class TestStaticClassFilter:
+    def test_vtable_info_and_invoke(self, offset_so):
+        from nnstreamer_tpu.backends.custom_c import CustomCBackend
+
+        b = CustomCBackend()
+        b.open(FilterProperties(model=offset_so, custom="offset:1.5"))
+        in_info, out_info = b.get_model_info()
+        assert tuple(in_info.specs[0].shape) == (1, 4)
+        assert out_info.specs[0].dtype is DataType.FLOAT32
+        outs = b.invoke([np.arange(4, dtype=np.float32).reshape(1, 4)])
+        np.testing.assert_allclose(
+            outs[0].reshape(-1), np.arange(4, dtype=np.float32) + 1.5)
+        b.close()
+
+    def test_pipeline_end_to_end(self, offset_so):
+        pipe = parse_launch(
+            "tensor_src num-buffers=2 dimensions=4:1 types=float32 "
+            "pattern=ones "
+            f"! tensor_filter framework=custom model={offset_so} "
+            "custom=offset:2.0 "
+            "! tensor_sink name=out max-stored=4")
+        out = []
+        pipe.get("out").connect(out.append)
+        pipe.play(); pipe.wait(timeout=30); pipe.stop()
+        assert len(out) == 2
+        np.testing.assert_allclose(np.asarray(out[0].tensors[0]), 3.0)
+
+
+class TestDynamicClassFilter:
+    def test_set_input_negotiates_any_shape(self, tmp_path):
+        src = tmp_path / "negate.cc"
+        src.write_text(DYNAMIC_SRC)
+        so = compile_plugin(str(src), "negate_cpp")
+        from nnstreamer_tpu.backends.custom_c import CustomCBackend
+
+        b = CustomCBackend()
+        b.open(FilterProperties(model=so))
+        out_info = b.set_input_info(
+            TensorsInfo.of(TensorSpec((2, 3), DataType.FLOAT32)))
+        assert tuple(out_info.specs[0].shape) == (2, 3)
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(b.invoke([x])[0], -x)
+        b.close()
+
+
+class TestExceptionSafety:
+    def test_throwing_constructor_fails_open_cleanly(self, tmp_path):
+        src = tmp_path / "broken.cc"
+        src.write_text(THROWING_SRC)
+        so = compile_plugin(str(src), "broken_cpp")
+        from nnstreamer_tpu.backends.custom_c import CustomCBackend
+
+        b = CustomCBackend()
+        with pytest.raises(RuntimeError, match="open failed"):
+            b.open(FilterProperties(model=so))
