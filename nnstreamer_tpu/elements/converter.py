@@ -52,6 +52,15 @@ _IN_CAPS = Caps(
 
 _VIDEO_CHANNELS = {"RGB": 3, "BGR": 3, "GRAY8": 1, "RGBA": 4, "BGRx": 4, "BGRA": 4}
 
+# reference audio/x-raw sample formats -> numpy dtypes
+# (gst_tensor_converter audio path: dtype from format string)
+_AUDIO_FORMATS = {
+    "S8": np.int8, "U8": np.uint8,
+    "S16LE": np.int16, "U16LE": np.uint16,
+    "S32LE": np.int32, "U32LE": np.uint32,
+    "F32LE": np.float32, "F64LE": np.float64,
+}
+
 
 @register_element
 class TensorConverter(TransformElement):
@@ -109,8 +118,19 @@ class TensorConverter(TransformElement):
             self._out_info = TensorsInfo.of(TensorSpec(shape, "uint8"))
         elif media == AUDIO_MIME:
             # audio frame counts vary per buffer; stream is flexible unless
-            # the app constrains it downstream (reference frames-per-buffer)
+            # the app constrains it downstream (reference frames-per-buffer).
+            # PCM interpretation follows the caps like the reference
+            # (gst_tensor_converter audio: dtype from format, dimension
+            # channels:frames): raw byte payloads are viewed as the sample
+            # dtype and shaped (frames, channels)
             self._mode = "audio"
+            self._audio_dtype = _AUDIO_FORMATS.get(
+                str(s.get("format", "S16LE")).upper())
+            if self._audio_dtype is None:
+                raise ElementError(
+                    f"{self.describe()}: unsupported audio format "
+                    f"'{s.get('format')}' (known: {sorted(_AUDIO_FORMATS)})")
+            self._audio_channels = int(s.get("channels", 1) or 1)
             self._out_info = TensorsInfo((), TensorFormat.FLEXIBLE)
         elif media in (TEXT_MIME, OCTET_MIME):
             self._mode = "bytes"
@@ -160,14 +180,49 @@ class TensorConverter(TransformElement):
             return None
         chunk = self._pending
         self._pending = []
-        stacked = [
-            np.stack([c.tensors[i] for c in chunk], axis=0)
-            for i in range(chunk[0].num_tensors)
-        ]
+        if self._mode == "audio":
+            # audio buffers legitimately vary in sample count (the element's
+            # own flexible-caps rationale), so chunking CONCATENATES along
+            # the frames axis — the reference adapter-accumulates sample
+            # frames the same way — instead of stacking equal-shape buffers
+            stacked = [
+                np.concatenate([c.tensors[i] for c in chunk], axis=0)
+                for i in range(chunk[0].num_tensors)
+            ]
+        else:
+            stacked = [
+                np.stack([c.tensors[i] for c in chunk], axis=0)
+                for i in range(chunk[0].num_tensors)
+            ]
         out = Buffer(stacked).copy_metadata_from(chunk[0])
         return out
 
     def _to_array(self, t) -> np.ndarray:
+        if self._mode == "audio":
+            a = np.asarray(t)
+            if a.dtype != self._audio_dtype:
+                if a.dtype != np.uint8:
+                    # a typed payload disagreeing with the caps is a caps/
+                    # payload mismatch, not bytes to reinterpret — a silent
+                    # byte view would turn the samples into garbage
+                    raise ElementError(
+                        f"{self.describe()}: audio payload dtype {a.dtype} "
+                        f"contradicts caps format "
+                        f"({np.dtype(self._audio_dtype).name})")
+                itemsize = np.dtype(self._audio_dtype).itemsize
+                if a.nbytes % itemsize:
+                    raise ElementError(
+                        f"{self.describe()}: {a.nbytes}B PCM payload not a "
+                        f"multiple of the {itemsize}B sample size")
+                # raw PCM bytes (filesrc/appsrc payloads): view per caps
+                a = a.reshape(-1).view(self._audio_dtype)
+            if a.ndim == 1 and self._audio_channels > 1:
+                if a.size % self._audio_channels:
+                    raise ElementError(
+                        f"{self.describe()}: {a.size} samples not divisible "
+                        f"by {self._audio_channels} channels")
+                a = a.reshape(-1, self._audio_channels)
+            return a
         if self._mode == "bytes":
             raw = np.asarray(t).view(np.uint8).reshape(-1)
             dim = self.props["input_dim"]

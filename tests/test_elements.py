@@ -787,3 +787,108 @@ class TestReferencePropParity:
             "else=repeat-previous ! tensor_sink name=out")
         assert len(got) == 4
         assert all(b.num_tensors == 1 for b in got)
+
+
+class TestAudioConverter:
+    """audio/raw -> tensors (reference gst_tensor_converter audio path:
+    sample dtype from the caps format, PCM bytes shaped frames×channels —
+    previously untested here; reference suite tests/nnstreamer_converter)."""
+
+    def test_pcm_bytes_shaped_by_caps(self):
+        pipe = parse_launch(
+            "appsrc name=in caps=audio/raw,format=S16LE,channels=2,rate=16000 "
+            "! tensor_converter ! tensor_sink name=out max-stored=4")
+        out = []
+        pipe.get("out").connect(out.append)
+        pipe.play()
+        pcm = np.arange(8, dtype=np.int16)  # 4 stereo frames
+        # raw PCM byte payload, as filesrc would deliver it
+        pipe.get("in").push_buffer(np.frombuffer(pcm.tobytes(), np.uint8))
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+        a = np.asarray(out[0].tensors[0])
+        assert a.dtype == np.int16 and a.shape == (4, 2)
+        np.testing.assert_array_equal(a.reshape(-1), pcm)
+
+    def test_typed_samples_pass_through(self):
+        pipe = parse_launch(
+            "appsrc name=in caps=audio/raw,format=F32LE,channels=1,rate=8000 "
+            "! tensor_converter ! tensor_sink name=out max-stored=4")
+        out = []
+        pipe.get("out").connect(out.append)
+        pipe.play()
+        pipe.get("in").push_buffer(np.linspace(0, 1, 160, dtype=np.float32))
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+        a = np.asarray(out[0].tensors[0])
+        assert a.dtype == np.float32 and a.shape == (160,)
+
+    def test_frames_per_tensor_concatenates_audio(self):
+        """Audio buffers vary in sample count, so chunking CONCATENATES
+        along the frames axis (the reference adapter-accumulates sample
+        frames) — including unequal buffer sizes."""
+        pipe = parse_launch(
+            "appsrc name=in caps=audio/raw,format=S16LE,channels=1,rate=8000 "
+            "! tensor_converter frames-per-tensor=2 "
+            "! tensor_sink name=out max-stored=4")
+        out = []
+        pipe.get("out").connect(out.append)
+        pipe.play()
+        for i, size in enumerate((10, 12, 8, 10)):  # unequal buffers
+            pipe.get("in").push_buffer(np.full(size, i, np.int16))
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=10)
+        pipe.stop()
+        assert len(out) == 2  # 4 buffers -> 2 chunks of 2
+        assert np.asarray(out[0].tensors[0]).shape == (22,)  # 10 + 12
+        assert np.asarray(out[1].tensors[0]).shape == (18,)  # 8 + 10
+
+    def test_typed_payload_contradicting_caps_rejected(self):
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            "appsrc name=in caps=audio/raw,format=S16LE,channels=1 "
+            "! tensor_converter ! tensor_sink name=out")
+        pipe.play()
+        pipe.get("in").push_buffer(np.ones(4, np.float32))  # not S16LE
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+        pipe.stop()
+        assert msg is not None and "contradicts caps" in str(msg.data)
+
+    def test_partial_sample_bytes_rejected(self):
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            "appsrc name=in caps=audio/raw,format=S16LE,channels=1 "
+            "! tensor_converter ! tensor_sink name=out")
+        pipe.play()
+        pipe.get("in").push_buffer(np.zeros(3, np.uint8))  # 3B % 2B != 0
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+        pipe.stop()
+        assert msg is not None and "sample size" in str(msg.data)
+
+    def test_bad_format_rejected(self):
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            "appsrc name=in caps=audio/raw,format=MULAW,channels=1 "
+            "! tensor_converter ! tensor_sink name=out")
+        pipe.play()
+        pipe.get("in").push_buffer(np.zeros(4, np.uint8))
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+        pipe.stop()
+        assert msg is not None and "audio format" in str(msg.data)
+
+    def test_odd_samples_for_channels_rejected(self):
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            "appsrc name=in caps=audio/raw,format=S16LE,channels=2 "
+            "! tensor_converter ! tensor_sink name=out")
+        pipe.play()
+        pipe.get("in").push_buffer(np.zeros(5, np.int16))  # 5 % 2 != 0
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+        pipe.stop()
+        assert msg is not None
