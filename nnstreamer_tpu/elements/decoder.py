@@ -20,10 +20,24 @@ _N_OPTIONS = 12  # reference supports option1..option9; 10-12 are ours
 # (bounding_boxes: option10=style, option11=track, option12=yolo-scaled)
 
 
+_OPTION_DOCS = {
+    10: "decoder option #10 — for bounding_boxes, `classic` selects the "
+        "reference-byte-compatible rendering (proven against the "
+        "reference's golden fixtures, tests/test_reference_parity.py)",
+    11: "decoder option #11 — for bounding_boxes, `1` enables centroid "
+        "tracking",
+    12: "decoder option #12 — for bounding_boxes, `1` marks yolo outputs "
+        "as pre-scaled",
+}
+
+
 def _option_props():
     props = {"mode": Prop(None, str, "decoder subplugin name")}
     for i in range(1, _N_OPTIONS + 1):
-        props[f"option{i}"] = Prop(None, str, f"decoder option #{i}")
+        props[f"option{i}"] = Prop(
+            None, str,
+            _OPTION_DOCS.get(i, f"decoder option #{i} (1-9 mirror the "
+                                "reference numbering per mode)"))
     return props
 
 
