@@ -274,7 +274,12 @@ PROTOBUF_MIME = "other/protobuf-tensor"
 FLATBUF_MIME = "other/flatbuf-tensor"
 
 ALL_MIMES = (TENSORS_MIME, VIDEO_MIME, AUDIO_MIME, TEXT_MIME, OCTET_MIME,
-             PROTOBUF_MIME, FLATBUF_MIME)
+             PROTOBUF_MIME, FLATBUF_MIME,
+             # compressed-image streams (filesrc ! image/png,... ! pngdec —
+             # the reference test idiom; imagedec sniffs the actual codec)
+             "image/png", "image/jpeg", "image/bmp",
+             "image/x-portable-graymap", "image/x-portable-pixmap",
+             "image/x-portable-anymap")
 
 
 def any_media_caps() -> Caps:
@@ -295,6 +300,10 @@ _LIST_RE = re.compile(r"^\{(.*)\}$")
 
 def _parse_field_value(text: str):
     text = text.strip()
+    # GStreamer typed values: `(string)RGB`, `(int)640`, `(fraction)30/1`
+    # — strip the annotation, the value parser below infers the type
+    if text.startswith("(") and ")" in text:
+        text = text[text.index(")") + 1:].strip()
     m = _RANGE_RE.match(text)
     if m:
         return IntRange(int(m.group(1)), int(m.group(2)))
@@ -311,17 +320,35 @@ def _parse_field_value(text: str):
     return text
 
 
+# GStreamer MIME spellings → our canonical media types, so the
+# reference's launch lines (`video/x-raw`, `audio/x-raw`,
+# `application/octet-stream`, `text/x-raw`, `other/tensor` singular)
+# parse unchanged (reference caps strings appear throughout its
+# tests/*/runTest.sh)
+_MEDIA_ALIASES = {
+    "video/x-raw": VIDEO_MIME,
+    "audio/x-raw": AUDIO_MIME,
+    "text/x-raw": TEXT_MIME,
+    "application/octet-stream": OCTET_MIME,
+    "other/tensor": TENSORS_MIME,
+}
+
+# field spellings that differ between GStreamer caps and ours
+_FIELD_ALIASES = {"dimension": "dimensions", "type": "types"}
+
+
 def parse_caps_string(text: str) -> Caps:
     structures = []
     for struct_text in text.split(";"):
         parts = _split_fields(struct_text.strip())
-        media = parts[0]
+        media = _MEDIA_ALIASES.get(parts[0], parts[0])
         fields = {}
         for p in parts[1:]:
             if not p:
                 continue
             k, _, v = p.partition("=")
-            fields[k.strip()] = _parse_field_value(v)
+            k = k.strip()
+            fields[_FIELD_ALIASES.get(k, k)] = _parse_field_value(v)
         structures.append(Structure.new(media, **fields))
     return Caps(tuple(structures))
 

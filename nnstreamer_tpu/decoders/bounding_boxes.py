@@ -24,24 +24,24 @@ Reference analog: ``ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c``
     (:1726-1770, :2160);
   * ``custom``: a registered python callback (register_bbox_parser).
 
-Options (reference option2..): option2 = "W:H" output video size;
-option3 = labels file; option4 = score threshold; option5 = IoU threshold
-(both default per mode: 0.25/0.5 generally, 0.8/none for ov-*, 0.5/0.05
-for mp-palm); option8 = "W:H" model input size (palm decode scale,
-default 192:192); option9 = palm anchor params
-"layers:min_scale:max_scale:offset_x:offset_y:stride0:stride1:..."
-(reference option3 tail for mp-palm-detection).
+Options — THE REFERENCE'S NUMBERING (tensordec-boundingbox.c:30-103):
+option2 = label file; option3 = mode-dependent values exactly as the
+reference documents them (yolo "scaled[:conf[:iou]]", raw ssd
+"priors[:thresh[:yscale[:xscale[:hscale[:wscale[:iou]]]]]]" — priors may
+be the reference's box_priors.txt text format or ``.npy`` (N,4)
+[cy,cx,h,w] —, ssd-postprocess "loc:cls:score:num,thresh%%", mp-palm
+"score[:layers:min:max:xoff:yoff:strides...]"); option4 = "W:H" output
+video size; option5 = "W:H" model input size; option6 = track (0|1:
+centroid tracking, reference option6); option7 = log results.
 
-Rendering styles (option10): ``overlay`` (default — this framework's
-design: per-class colors, thickness-2 boxes) or ``classic`` — the
-reference decoder's byte-compatible output (1px 0xFF0000FF outlines,
-integer coordinate math, 8×13 label cells; see ``bbox_classic.py``),
-proven against the reference's own golden fixtures in
-``tests/test_reference_parity.py``. option11 = track (0|1, classic only:
-centroid tracking ids appended to labels, reference option6);
-option12 = yolo scaled-output flag (classic only, reference option3[0]).
-In classic style option7 priors may be the reference's ``box_priors.txt``
-text format (4 lines) as well as ``.npy``.
+option8 (the slot the reference reserves for Box Style) selects the
+rendering: ``overlay`` (default — this framework's design: per-class
+colors, thickness-2 boxes) or ``classic`` — the reference decoder's
+byte-compatible output (1px 0xFF0000FF outlines, integer coordinate
+math, 8×13 label cells; see ``bbox_classic.py``), proven against the
+reference's own golden fixtures in ``tests/test_reference_parity.py``.
+option9 = our yolov8 tensor-layout override (auto|boxes-first|
+coords-first).
 
 Output: RGBA video frame with box rectangles drawn (transparent background,
 to be alpha-blended over the source video — the reference's ``compositor``
@@ -61,6 +61,14 @@ from .base import Decoder, register_decoder
 _custom_parsers: Dict[str, Callable] = {}
 
 
+def _log_detections(fmt, dets) -> None:
+    """reference option7 (log result bounding boxes)."""
+    from ..utils.log import logger
+
+    logger.info("bounding_boxes[%s]: %d detection(s): %s", fmt, len(dets),
+                dets)
+
+
 def register_bbox_parser(name: str, fn: Callable) -> None:
     """fn(tensors) -> (boxes (N,4) normalized [ymin,xmin,ymax,xmax], scores
     (N,), classes (N,))."""
@@ -72,65 +80,105 @@ class BoundingBoxes(Decoder):
     MODE = "bounding_boxes"
 
     def init(self, options):
+        """Reference option numbering (tensordec-boundingbox.c:30-103):
+        option1 mode, option2 label file, option3 mode-dependent values,
+        option4 output W:H, option5 model-input W:H, option6 track,
+        option7 log. option8 (the reference's reserved Box Style slot) is
+        ``overlay`` (default) | ``classic`` (reference-byte-compatible
+        rendering); option9 is our yolov8 tensor-layout override
+        (auto | boxes-first | coords-first — auto transposes when the
+        first dim is smaller, right for real (84, 8400) heads but
+        ambiguous when N < 4+C)."""
         super().init(options)
         self.fmt = self.option(1, "mobilenet-ssd-postprocess")
-        wh = self.option(2, "320:240").split(":")
-        self.width, self.height = int(wh[0]), int(wh[1])
         self.labels: List[str] = []
-        path = self.option(3)
+        path = self.option(2)
         if path:
             with open(path) as fh:
                 self.labels = [ln.strip() for ln in fh if ln.strip()]
-        # per-mode reference defaults: ov-* uses a fixed 0.8 confidence gate
-        # and no NMS (OV_PERSON_DETECTION_CONF_THRESHOLD); mp-palm uses
-        # sigmoid-score 0.5 and a tight 0.05 IoU NMS (tensordec-boundingbox.c)
-        if self.fmt in ("ov-person-detection", "ov-face-detection"):
-            default_score, default_iou, self.use_nms = "0.8", "0.5", False
-        elif self.fmt == "mp-palm-detection":
-            default_score, default_iou, self.use_nms = "0.5", "0.05", True
-        else:
-            default_score, default_iou, self.use_nms = "0.25", "0.5", True
-        self.score_threshold = float(self.option(4, default_score))
-        self.iou_threshold = float(self.option(5, default_iou))
-        in_wh = self.option(8, "192:192").split(":")
+        wh = self.option(4, "320:240").split(":")
+        self.width, self.height = int(wh[0]), int(wh[1])
+        in_wh = self.option(5, "192:192").split(":")
         self.in_width, self.in_height = int(in_wh[0]), int(in_wh[1])
-        if self.fmt == "mp-palm-detection":
-            self.palm_anchors = _palm_anchors(self.option(9), self.in_width)
-        # yolov8 tensor layout: auto | boxes-first ((N,4+C) rows) |
-        # coords-first ((4+C,N) columns). auto transposes when the first dim
-        # is smaller — right for real heads (84, 8400) but ambiguous when
-        # N < 4+C, hence the override.
-        self.layout = self.option(6, "auto")
-        self.style = self.option(10, "overlay")
-        self.track = self.option(11, "0") not in ("0", "", "false")
-        self.yolo_scaled = self.option(12, "0") not in ("0", "", "false")
+        self.track = self.option(6, "0") not in ("0", "", "false")
+        self.log_results = self.option(7, "0") not in ("0", "", "false")
+        self.style = self.option(8, "overlay")
+        self.layout = self.option(9, "auto")
+        self._apply_mode_option3(self.option(3))
         self._tracker = None
-        if self.style == "classic":
+        if self.style == "classic" and self.track:
             from . import bbox_classic as bc
 
-            # reference per-mode threshold defaults differ from ours
-            if self.option(4) is None:
-                if self.fmt in ("mobilenet-ssd", "tflite-ssd"):
-                    self.score_threshold = 0.5
-                elif self.fmt in ("mobilenet-ssd-postprocess", "tf-ssd"):
-                    self.score_threshold = float(bc.G_MINFLOAT)
-            if self.option(5) is None and self.fmt in ("yolov5", "yolov8"):
-                self.iou_threshold = 0.45
-            if self.track:
-                self._tracker = bc.CentroidTracker()
+            self._tracker = bc.CentroidTracker()
+        if self.fmt == "mp-palm-detection":
+            self.palm_anchors = _palm_anchors(self._palm_param, self.in_width)
+
+    def _apply_mode_option3(self, opt3: Optional[str]) -> None:
+        """option3 carries the mode-dependent values exactly as the
+        reference documents them (thresholds, priors, tensor mapping,
+        anchor generation)."""
+        from . import bbox_classic as bc
+
+        parts = (opt3 or "").split(":")
+
+        def part(i, default=""):
+            return parts[i] if i < len(parts) and parts[i] != "" else default
+
+        self.use_nms = True
+        self.yolo_scaled = False
         self.anchors = None
-        priors = self.option(7)
-        if priors:
+        self.ssd_pp_indices = (0, 1, 2, 3)  # num:classes:scores:locations
+        self._palm_param: Optional[str] = None
+        fmt = self.fmt
+        if fmt in ("yolov5", "yolov8"):
+            # "scaled[:conf[:iou]]" — defaults 0, 0.25, 0.45
+            self.yolo_scaled = part(0, "0") not in ("0", "", "false")
+            self.score_threshold = float(part(1, "0.25"))
+            self.iou_threshold = float(part(2, "0.45"))
+        elif fmt in ("mobilenet-ssd", "tflite-ssd"):
+            # "priors.txt[:thresh[:yscale[:xscale[:hscale[:wscale[:iou]]]]]]"
+            priors = part(0)
+            if not priors:
+                raise ValueError(
+                    "bounding_boxes: mobilenet-ssd (raw) needs "
+                    "option3=<box-priors file>")
             if priors.endswith(".npy"):
                 self.anchors = np.load(priors).astype(np.float32)
             else:
-                from .bbox_classic import load_priors_txt
-
                 # reference text format, rows [cy, cx, h, w] → (N, 4)
-                self.anchors = load_priors_txt(priors).T
-        elif self.fmt in ("mobilenet-ssd", "tflite-ssd"):
-            raise ValueError(
-                "bounding_boxes: mobilenet-ssd (raw) needs option7=<priors>")
+                self.anchors = bc.load_priors_txt(priors).T
+            self.score_threshold = float(part(1, "0.5"))
+            self.ssd_scales = (float(part(2, "10.0")), float(part(3, "10.0")),
+                               float(part(4, "5.0")), float(part(5, "5.0")))
+            self.iou_threshold = float(part(6, "0.5"))
+        elif fmt in ("mobilenet-ssd-postprocess", "tf-ssd"):
+            # "%i:%i:%i:%i,%i" — locations:classes:scores:num , thresh%
+            self.score_threshold = float(bc.G_MINFLOAT) \
+                if self.style == "classic" else 0.25
+            self.iou_threshold = 0.5
+            if opt3:
+                head, _, thresh = opt3.partition(",")
+                idx = head.split(":")
+                if len(idx) == 4:
+                    loc, cls, score, num = (int(v) for v in idx)
+                    self.ssd_pp_indices = (num, cls, score, loc)
+                if thresh.strip():
+                    self.score_threshold = float(thresh) / 100.0
+        elif fmt == "mp-palm-detection":
+            # "score[:layers:min:max:xoff:yoff:strides...]"
+            self.score_threshold = float(part(0, "0.5"))
+            self.iou_threshold = 0.05
+            if len(parts) > 1:
+                self._palm_param = ":".join(parts[1:])
+        elif fmt in ("ov-person-detection", "ov-face-detection"):
+            # fixed 0.8 confidence gate, no NMS (model output already
+            # suppressed — OV_PERSON_DETECTION_CONF_THRESHOLD)
+            self.score_threshold = 0.8
+            self.iou_threshold = 0.5
+            self.use_nms = False
+        else:  # custom-registered parsers: generic defaults
+            self.score_threshold = float(part(0, "0.25"))
+            self.iou_threshold = float(part(1, "0.5"))
 
     def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
         return Caps.new(VIDEO_MIME, format="RGBA", width=self.width, height=self.height)
@@ -144,7 +192,9 @@ class BoundingBoxes(Decoder):
             loc = np.asarray(tensors[0]).reshape(-1, 4).astype(np.float32)
             logits = np.asarray(tensors[1]).astype(np.float32)
             logits = logits.reshape(loc.shape[0], -1)
-            boxes = decode_boxes_np(loc, self.anchors)
+            boxes = decode_boxes_np(
+                loc, self.anchors,
+                variances=tuple(1.0 / sc for sc in self.ssd_scales))
             scores = 1.0 / (1.0 + np.exp(-logits))  # sigmoid
             classes = scores.argmax(-1)
             return boxes, scores.max(-1), classes
@@ -166,8 +216,8 @@ class BoundingBoxes(Decoder):
             if len(raw) != len(anchors) or len(scores) != len(anchors):
                 raise ValueError(
                     f"mp-palm-detection: {len(raw)} box rows / {len(scores)} "
-                    f"scores vs {len(anchors)} anchors — check option8 "
-                    "(model input size) and option9 (anchor params)"
+                    f"scores vs {len(anchors)} anchors — check option5 "
+                    "(model input size) and option3 (anchor params)"
                 )
             n = len(anchors)
             anc = anchors
@@ -181,6 +231,13 @@ class BoundingBoxes(Decoder):
             boxes = np.stack([yc - h / 2, xc - w / 2, yc + h / 2, xc + w / 2], axis=1)
             return boxes, scores, np.zeros(n, np.int64)
         if fmt in ("mobilenet-ssd-postprocess", "tf-ssd"):
+            if len(tensors) >= 4:  # reference 4-tensor postprocess output
+                i_num, i_cls, i_score, i_loc = self.ssd_pp_indices
+                boxes = np.asarray(tensors[i_loc]).reshape(-1, 4).astype(np.float32)
+                scores = np.asarray(tensors[i_score]).astype(np.float32).reshape(-1)
+                classes = np.asarray(tensors[i_cls]).astype(np.int64).reshape(-1)
+                n = min(len(boxes), len(scores), len(classes))
+                return boxes[:n], scores[:n], classes[:n]
             boxes = np.asarray(tensors[0]).reshape(-1, 4).astype(np.float32)
             scores = np.asarray(tensors[1]).astype(np.float32)
             if scores.ndim > 1:
@@ -236,14 +293,17 @@ class BoundingBoxes(Decoder):
             dets = bc.parse_mobilenet_ssd(
                 np.asarray(tensors[0]).reshape(-1, 4),
                 np.asarray(tensors[1]),
-                self.anchors.T, i_w, i_h, self.score_threshold)
+                self.anchors.T, i_w, i_h, self.score_threshold,
+                scales=self.ssd_scales)
             dets = bc.nms_classic(dets, self.iou_threshold)
         elif fmt in ("mobilenet-ssd-postprocess", "tf-ssd"):
-            # reference default tensor mapping: num=0, classes=1, scores=2,
-            # locations=3 (MOBILENET_SSD_PP_BBOX_IDX_*_DEFAULT); no NMS
+            # tensor mapping: reference defaults num=0, classes=1,
+            # scores=2, locations=3 (MOBILENET_SSD_PP_BBOX_IDX_*_DEFAULT),
+            # remappable via option3 "%i:%i:%i:%i,%i"; no NMS
+            i_num, i_cls, i_score, i_loc = self.ssd_pp_indices
             dets = bc.parse_ssd_pp(
-                np.asarray(tensors[0]), np.asarray(tensors[1]),
-                np.asarray(tensors[2]), np.asarray(tensors[3]),
+                np.asarray(tensors[i_num]), np.asarray(tensors[i_cls]),
+                np.asarray(tensors[i_score]), np.asarray(tensors[i_loc]),
                 i_w, i_h, self.score_threshold)
         elif fmt in ("yolov5", "yolov8"):
             num_info = 5 if fmt == "yolov5" else 4
@@ -264,7 +324,7 @@ class BoundingBoxes(Decoder):
             if not hasattr(self, "_classic_anchors"):
                 # same grid generator as the overlay path, but pinned to the
                 # reference's hardcoded 192 input (feature_map=ceil(192/stride))
-                self._classic_anchors = _palm_anchors(self.option(9), 192)
+                self._classic_anchors = _palm_anchors(self._palm_param, 192)
             dets = bc.parse_palm(
                 np.asarray(tensors[0]), np.asarray(tensors[1]),
                 self._classic_anchors, i_w, i_h, self.score_threshold)
@@ -281,6 +341,8 @@ class BoundingBoxes(Decoder):
             dets, self.width, self.height, i_w, i_h,
             self.labels or None, track=self.track)
         out = Buffer([frame])
+        if self.log_results:
+            _log_detections(self.fmt, dets)
         out.meta["detections"] = [
             {"box": [d.x, d.y, d.width, d.height], "score": d.prob,
              "class": d.class_id, "tracking_id": d.tracking_id,
@@ -316,6 +378,8 @@ class BoundingBoxes(Decoder):
                 "label": self.labels[cls] if 0 <= cls < len(self.labels) else str(cls),
             })
         out = Buffer([frame])
+        if self.log_results:
+            _log_detections(self.fmt, detections)
         out.meta["detections"] = detections
         return out
 

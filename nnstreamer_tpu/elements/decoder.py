@@ -16,18 +16,22 @@ from ..registry.subplugin import SubpluginKind, get as get_subplugin
 from ..runtime.element import ElementError, Prop, TransformElement
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 
-_N_OPTIONS = 12  # reference supports option1..option9; 10-12 are ours
-# (bounding_boxes: option10=style, option11=track, option12=yolo-scaled)
+_N_OPTIONS = 12  # reference numbering throughout (bounding_boxes:
+# option3=mode values, option6=track, option8=style, option9=layout)
 
 
 _OPTION_DOCS = {
-    10: "decoder option #10 — for bounding_boxes, `classic` selects the "
-        "reference-byte-compatible rendering (proven against the "
-        "reference's golden fixtures, tests/test_reference_parity.py)",
-    11: "decoder option #11 — for bounding_boxes, `1` enables centroid "
-        "tracking",
-    12: "decoder option #12 — for bounding_boxes, `1` marks yolo outputs "
-        "as pre-scaled",
+    3: "decoder option #3 — mode-dependent values with the reference's "
+       "exact scheme (bounding_boxes: yolo scaled:conf:iou, ssd "
+       "priors:thresholds, ssd-postprocess loc:cls:score:num,thresh%, "
+       "palm score:anchor-params)",
+    6: "decoder option #6 — for bounding_boxes, `1` enables centroid "
+       "tracking (reference option6)",
+    8: "decoder option #8 — for bounding_boxes, `classic` selects the "
+       "reference-byte-compatible rendering (proven against the "
+       "reference's golden fixtures, tests/test_reference_parity.py)",
+    9: "decoder option #9 — for bounding_boxes, yolov8 tensor layout "
+       "auto|boxes-first|coords-first",
 }
 
 

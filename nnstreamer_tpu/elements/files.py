@@ -19,7 +19,8 @@ from typing import Optional
 import numpy as np
 
 from ..core import Buffer, Caps, parse_caps_string
-from ..core.caps import OCTET_MIME, VIDEO_MIME, any_media_caps
+from ..core.caps import (OCTET_MIME, VIDEO_MIME, Structure,
+                         any_media_caps)
 from ..registry.elements import register_element
 from ..runtime.element import Element, ElementError, Prop, SourceElement
 from ..runtime.pad import Pad, PadDirection, PadTemplate
@@ -46,6 +47,15 @@ class _FileSourceBase(SourceElement):
     def get_src_caps(self) -> Caps:
         if self.props["caps"]:
             return parse_caps_string(self.props["caps"])
+        # like GStreamer's caps-any filesrc, the downstream capsfilter
+        # decides what the bytes ARE (reference idiom: filesrc !
+        # image/x-portable-graymap,... ! pnmdec), looked up through
+        # transparent shims/queues
+        from .media import downstream_filter_caps
+
+        filter_caps = downstream_filter_caps(self)
+        if filter_caps is not None:
+            return filter_caps
         return _OCTET_CAPS
 
 
@@ -123,11 +133,14 @@ class MultiFileSrc(_FileSourceBase):
     ELEMENT_NAME = "multifilesrc"
     PROPERTIES = {
         "start_index": Prop(0, int, "first index"),
+        "index": Prop(None, int, "GStreamer spelling of start-index"),
         "stop_index": Prop(-1, int, "last index (-1 = until missing file)"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
+        if self.props["index"] is not None:  # GStreamer spelling wins
+            self.props["start_index"] = self.props["index"]
         pattern = self.props["location"]
         try:
             self._literal = (pattern % 0) == (pattern % 1)
@@ -201,7 +214,14 @@ class ImageDec(Element):
     """
 
     ELEMENT_NAME = "imagedec"
-    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _OCTET_CAPS),)
+    # accepts raw byte streams AND image-typed caps (the reference lines
+    # put e.g. image/png or image/x-portable-graymap filters before the
+    # decoder; Pillow sniffs the actual codec from the bytes)
+    SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps(tuple(
+        Structure.new(m) for m in (
+            OCTET_MIME, "image/png", "image/jpeg", "image/bmp",
+            "image/x-portable-graymap", "image/x-portable-pixmap",
+            "image/x-portable-anymap")))),)
     SRC_TEMPLATES = (PadTemplate(
         "src", PadDirection.SRC, Caps.new(VIDEO_MIME, format="RGB")),)
 
@@ -301,3 +321,19 @@ class ImageDec(Element):
                 f"{self.describe()}: stream ended with {len(self._pending)} "
                 "undecodable bytes")
         self.send_eos()
+
+
+@register_element
+class PngDec(ImageDec):
+    """GStreamer ``pngdec`` name for :class:`ImageDec` — reference launch
+    lines (`... ! pngdec ! ...`) run unchanged."""
+
+    ELEMENT_NAME = "pngdec"
+
+
+@register_element
+class PnmDec(ImageDec):
+    """GStreamer ``pnmdec`` name for :class:`ImageDec` (Pillow decodes
+    PGM/PPM/PNM the same way)."""
+
+    ELEMENT_NAME = "pnmdec"

@@ -144,3 +144,18 @@ class TensorRepoSrc(SourceElement):
             if deadline is not None and time.monotonic() >= deadline:
                 return None  # documented per-frame timeout: stream ends
         return None
+
+
+@register_element
+class TensorRepoSinkAlias(TensorRepoSink):
+    """The reference's element name (``tensor_reposink``) for
+    :class:`TensorRepoSink` — its launch lines run unchanged."""
+
+    ELEMENT_NAME = "tensor_reposink"
+
+
+@register_element
+class TensorRepoSrcAlias(TensorRepoSrc):
+    """The reference's element name (``tensor_reposrc``)."""
+
+    ELEMENT_NAME = "tensor_reposrc"

@@ -154,6 +154,27 @@ class VideoTestSrc(_PacedSource):
     _CHANNELS = {"RGB": 3, "BGR": 3, "GRAY8": 1, "RGBA": 4, "BGRx": 4}
 
     def get_src_caps(self) -> Caps:
+        # GStreamer test sources have no size props — size/format come from
+        # downstream caps negotiation. Our push-based analog: adopt the
+        # nearest downstream capsfilter's constraints (reference launch
+        # idiom: videotestsrc ! video/x-raw,width=...,format=RGB ! ...)
+        from .media import downstream_filter_fields
+
+        hint = downstream_filter_fields(self)
+        for key in ("width", "height"):
+            if isinstance(hint.get(key), int):  # scalars only, not ranges
+                self.props[key] = hint[key]
+        fmt = hint.get("format")
+        if isinstance(fmt, str) and fmt in self._CHANNELS:
+            # only formats this source can synthesize; anything else is
+            # videoconvert's job downstream
+            self.props["format"] = fmt
+        if not self.props["framerate"]:
+            fr = hint.get("framerate")
+            if isinstance(fr, tuple) and len(fr) == 2:
+                self.props["framerate"] = fr[0] / max(fr[1], 1)
+            elif isinstance(fr, (int, float)):
+                self.props["framerate"] = float(fr)
         p = self.props
         fps = p["framerate"]
         return Caps.new(

@@ -50,6 +50,7 @@ _STANDARD_MODULES = (
     "nnstreamer_tpu.elements.shard",
     "nnstreamer_tpu.elements.mqtt",
     "nnstreamer_tpu.elements.iio",
+    "nnstreamer_tpu.elements.media",
     "nnstreamer_tpu.query.elements",
     "nnstreamer_tpu.query.grpc_io",
 )

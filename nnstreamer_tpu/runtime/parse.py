@@ -87,6 +87,21 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
 
     pipe = pipeline or Pipeline()
     tokens = shlex.split(_pad_links(description))
+    # gst-launch allows spaces after commas inside caps strings
+    # ("video/x-raw, width=160, height=120"): a comma-terminated token
+    # continues in the next token — but ONLY for tokens that began as a
+    # caps string (media/type head), so a property value with a trailing
+    # comma (e.g. the reference's option3="0:1:2:3," grammar) is never
+    # merged with its neighbor
+    caps_head = re.compile(r"^[A-Za-z0-9.+-]+/[A-Za-z0-9.+-]+(,|$)")
+    merged: List[str] = []
+    for tok in tokens:
+        if (merged and merged[-1].endswith(",") and tok != "!"
+                and caps_head.match(merged[-1])):
+            merged[-1] += tok
+        else:
+            merged.append(tok)
+    tokens = merged
 
     # Group tokens into entries, entries into chains. Entries within a chain
     # are separated by '!'; a non-property token with no preceding '!' starts

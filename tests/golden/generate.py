@@ -53,11 +53,12 @@ def cases():
          [np.array([0.1, 0.9, 0.3, 0.2], np.float32)]),
         ("direct_video", "direct_video", [], [vid]),
         ("bbox_ssd_pp", "bounding_boxes",
-         ["mobilenet-ssd-postprocess", "64:64"], [boxes, scores]),
+         ["mobilenet-ssd-postprocess", None, None, "64:64"], [boxes, scores]),
         ("bbox_yolov8", "bounding_boxes",
-         ["yolov8", "64:64", None, "0.3", "0.5", "coords-first"], [yolo]),
+         ["yolov8", None, "0:0.3:0.5", "64:64", None, None, None, None,
+          "coords-first"], [yolo]),
         ("bbox_ov_person", "bounding_boxes",
-         ["ov-person-detection", "64:64"], [ov]),
+         ["ov-person-detection", None, None, "64:64"], [ov]),
         ("segment", "image_segment", [], [seg]),
         ("pose", "pose_estimation", ["64:64", "8:8"], [heat]),
         ("font", "font", ["64:32"], [np.frombuffer(b"NNS", np.uint8)]),

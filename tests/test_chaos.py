@@ -52,7 +52,7 @@ class TestCorruptionTolerance:
             "pattern=random "
             "! tensor_fault corrupt-prob=1.0 seed=11 "
             "! tensor_decoder mode=bounding_boxes option1=yolov5 "
-            "option2=64:64 option8=64:64 option10=classic "
+            "option4=64:64 option5=64:64 option8=classic "
             "! tensor_sink name=out max-stored=64")
         assert len(got) == 30  # every frame decoded, none crashed
         for b in got:

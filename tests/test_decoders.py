@@ -80,7 +80,7 @@ class TestBoundingBoxes:
         out = run_collect(
             "appsrc name=in caps=other/tensors,format=static,dimensions=4:3.3,types=float32 "
             "! tensor_decoder mode=bounding_boxes option1=mobilenet-ssd-postprocess "
-            "option2=100:100 ! tensor_sink name=out",
+            "option4=100:100 ! tensor_sink name=out",
             push=[[boxes, scores]],
         )
         frame = np.asarray(out[0].tensors[0])
@@ -92,8 +92,7 @@ class TestBoundingBoxes:
         from nnstreamer_tpu.core import TensorsInfo
 
         dec = BoundingBoxes()
-        dec.init(["mobilenet-ssd-postprocess", "100:100", None, "0.5", "0.5",
-                  None, None, None, None])
+        dec.init(["mobilenet-ssd-postprocess", None, ",50", "100:100"])
         boxes = np.array(
             [[0.1, 0.1, 0.5, 0.5], [0.11, 0.11, 0.51, 0.51], [0.6, 0.6, 0.9, 0.9]],
             np.float32,
@@ -111,7 +110,7 @@ class TestBoundingBoxes:
         from nnstreamer_tpu.core import TensorsInfo
 
         dec = BoundingBoxes()
-        dec.init(["yolov8", "640:640", None, "0.3", "0.5", None, None, None, None])
+        dec.init(["yolov8", None, "0:0.3:0.5", "640:640"])
         # (4+C, N) layout with C=2, N=10 (N >> 4+C, as real yolov8 heads emit)
         a = np.zeros((6, 10), np.float32)
         a[:4, 0] = [320, 320, 100, 100]  # cx,cy,w,h in pixels
@@ -140,7 +139,7 @@ class TestOvDetection:
         from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
 
         dec = BoundingBoxes()
-        dec.init([fmt, "100:100"])
+        dec.init([fmt, None, None, "100:100"])
         out = dec.decode(Buffer([self._rows()]), TensorsInfo())
         dets = out.meta["detections"]
         assert len(dets) == 2  # conf 0.95 + 0.85; 0.70 gated; row 4 ignored
@@ -157,7 +156,7 @@ class TestOvDetection:
         a[1] = [0, 1, 0.9, 0.11, 0.11, 0.51, 0.51]
         a[2, 0] = -1
         dec = BoundingBoxes()
-        dec.init(["ov-person-detection", "100:100"])
+        dec.init(["ov-person-detection", None, None, "100:100"])
         out = dec.decode(Buffer([a]), TensorsInfo())
         assert len(out.meta["detections"]) == 2
 
@@ -196,7 +195,7 @@ class TestMpPalmDetection:
         raw[k, :4] = [0.0, 0.0, 48.0, 48.0]
         scores[k] = 100.0  # sigmoid → ~1
         dec = BoundingBoxes()
-        dec.init(["mp-palm-detection", "192:192"])
+        dec.init(["mp-palm-detection", None, None, "192:192"])
         out = dec.decode(Buffer([raw, scores]), TensorsInfo())
         dets = out.meta["detections"]
         assert len(dets) == 1

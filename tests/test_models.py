@@ -72,7 +72,7 @@ class TestSSD:
             "types=float32 pattern=random "
             f"! tensor_filter framework=jax model={mf} "
             "! tensor_decoder mode=bounding_boxes "
-            "option1=mobilenet-ssd-postprocess option2=64:64 option4=0.0 "
+            "option1=mobilenet-ssd-postprocess option3=,0 option4=64:64 "
             "! tensor_sink name=out"
         )
         frame = np.asarray(got[0].tensors[0])
@@ -96,7 +96,7 @@ class TestSSD:
             "types=float32 pattern=random "
             f"! tensor_filter framework=jax model={mf} "
             "! tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
-            f"option2=64:64 option4=0.0 option7={priors} "
+            f"option3={priors}:0.0 option4=64:64 "
             "! tensor_sink name=out"
         )
         assert np.asarray(got[0].tensors[0]).shape == (64, 64, 4)
@@ -105,7 +105,7 @@ class TestSSD:
         from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
 
         dec = BoundingBoxes()
-        with pytest.raises(ValueError, match="option7"):
+        with pytest.raises(ValueError, match="option3"):
             dec.init(["mobilenet-ssd"])
 
 

@@ -93,14 +93,15 @@ def fixture(name, dtype=np.float32):
 
 
 class TestPalmDetection:
-    """reference: option1=mp-palm-detection option3=0.5:4:1:1:0.5:0.5:8:16:16:16
-    option4=160:120 option5=300:300 → full byte-equality (no labels)."""
+    """reference numbering verbatim: option1=mp-palm-detection
+    option3=0.5:4:1:1:0.5:0.5:8:16:16:16 option4=160:120 option5=300:300
+    → full byte-equality (no labels)."""
 
     @pytest.mark.parametrize("i", [0, 1])
     def test_full_byte_match(self, i):
         dec = make_decoder([
-            "mp-palm-detection", "160:120", None, "0.5", "0.05", None, None,
-            "300:300", "4:1.0:1.0:0.5:0.5:8:16:16:16", "classic"])
+            "mp-palm-detection", None, "0.5:4:1.0:1.0:0.5:0.5:8:16:16:16",
+            "160:120", "300:300", None, None, "classic"])
         out = decode(dec, [
             fixture(f"palm_detection_input_0.{i}").reshape(-1, 18),
             fixture(f"palm_detection_input_1.{i}").reshape(-1),
@@ -116,8 +117,8 @@ class TestYolo:
     @pytest.mark.parametrize("i", [0])
     def test_yolov5_masked_byte_match(self, i):
         dec = make_decoder([
-            "yolov5", "320:320", os.path.join(REF, "coco-80.txt"),
-            "0.25", "0.45", None, None, "320:320", None, "classic"])
+            "yolov5", os.path.join(REF, "coco-80.txt"), "0:0.25:0.45",
+            "320:320", "320:320", None, None, "classic"])
         out = decode(dec, [fixture("yolov5_decoder_input.raw").reshape(-1, 85)])
         frame, cells = np.asarray(out.tensors[0]), out.meta["label_cells"]
         assert len(out.meta["detections"]) == 4
@@ -126,8 +127,8 @@ class TestYolo:
 
     def test_yolov5_track_masked_byte_match(self):
         dec = make_decoder([
-            "yolov5", "320:320", os.path.join(REF, "coco-80.txt"),
-            "0.25", "0.45", None, None, "320:320", None, "classic", "1"])
+            "yolov5", os.path.join(REF, "coco-80.txt"), "0:0.25:0.45",
+            "320:320", "320:320", "1", None, "classic"])
         arr = fixture("yolov5_decoder_input.raw").reshape(-1, 85)
         gold = golden("yolov5_track_result_golden.raw", 320, 320)
         for _frame_no in range(3):  # same frame 3x: stable tracking ids
@@ -139,8 +140,8 @@ class TestYolo:
 
     def test_yolov8_masked_byte_match(self):
         dec = make_decoder([
-            "yolov8", "320:320", os.path.join(REF, "coco-80.txt"),
-            "0.25", "0.45", None, None, "320:320", None, "classic"])
+            "yolov8", os.path.join(REF, "coco-80.txt"), "0:0.25:0.45",
+            "320:320", "320:320", None, None, "classic"])
         out = decode(dec, [fixture("yolov8_decoder_input.raw").reshape(-1, 84)])
         frame, cells = np.asarray(out.tensors[0]), out.meta["label_cells"]
         gold = golden("yolov8_result_golden.raw", 320, 320)
@@ -155,9 +156,9 @@ class TestMobilenetSSD:
     @pytest.mark.parametrize("i", [0, 1])
     def test_raw_ssd_masked_byte_match(self, fmt, i):
         dec = make_decoder([
-            fmt, "160:120", os.path.join(REF, "coco_labels_list.txt"),
-            None, None, None, os.path.join(REF, "box_priors.txt"),
-            "300:300", None, "classic"])
+            fmt, os.path.join(REF, "coco_labels_list.txt"),
+            os.path.join(REF, "box_priors.txt"),
+            "160:120", "300:300", None, None, "classic"])
         out = decode(dec, [
             fixture(f"mobilenetssd_tensors.0.{i}").reshape(-1, 4),
             fixture(f"mobilenetssd_tensors.1.{i}").reshape(-1, 91),
@@ -170,8 +171,8 @@ class TestMobilenetSSD:
     @pytest.mark.parametrize("i", [0, 1])
     def test_postprocess_masked_byte_match(self, fmt, i):
         dec = make_decoder([
-            fmt, "160:120", os.path.join(REF, "coco_labels_list.txt"),
-            None, None, None, None, "640:480", None, "classic"])
+            fmt, os.path.join(REF, "coco_labels_list.txt"), None,
+            "160:120", "640:480", None, None, "classic"])
         out = decode(dec, [
             fixture(f"mobilenetssd_postprocess_tensors.0.{i}"),
             fixture(f"mobilenetssd_postprocess_tensors.1.{i}"),
@@ -215,8 +216,8 @@ class TestNmsSpec:
 
     def test_yolov8_classic_empty_candidates(self):
         dec = make_decoder([
-            "yolov8", "320:320", None, "0.25", "0.45", None, None,
-            "320:320", None, "classic"])
+            "yolov8", None, "0:0.25:0.45", "320:320", "320:320",
+            None, None, "classic"])
         out = decode(dec, [np.zeros((0, 84), np.float32)])
         assert out.meta["detections"] == []
         assert not np.asarray(out.tensors[0]).any()
@@ -310,11 +311,11 @@ class TestConfigFile:
             "# reference-style decoder config\n"
             "mode=bounding_boxes\n"
             "option1=mobilenet-ssd\n"
-            "option2=160:120\n"
-            f"option3={REF}/coco_labels_list.txt\n"
-            f"option7={REF}/box_priors.txt\n"
-            "option8=300:300\n"
-            "option10=classic\n")
+            f"option2={REF}/coco_labels_list.txt\n"
+            f"option3={REF}/box_priors.txt\n"
+            "option4=160:120\n"
+            "option5=300:300\n"
+            "option8=classic\n")
         pipe = parse_launch(
             "tensor_mux name=mux sync-mode=nosync "
             f"! tensor_decoder config-file={cfg} ! tensor_sink name=out "
@@ -337,7 +338,7 @@ class TestConfigFile:
 class TestReferenceTopology:
     """The reference's ACTUAL launch shape — multifilesrc feeding raw
     fixture files through tensor_converter input-dim/input-type into a
-    mux → decoder — runs unchanged (modulo our option numbering) and
+    mux → decoder — runs UNCHANGED — including the option numbering — and
     byte-matches both golden frames."""
 
     def test_multifilesrc_palm_pipeline(self):
@@ -346,8 +347,8 @@ class TestReferenceTopology:
         pipe = parse_launch(
             "tensor_mux name=mux sync-mode=nosync "
             "! tensor_decoder mode=bounding_boxes option1=mp-palm-detection "
-            "option2=160:120 option4=0.5 option5=0.05 option8=300:300 "
-            "option9=4:1.0:1.0:0.5:0.5:8:16:16:16 option10=classic "
+            "option3=0.5:4:1.0:1.0:0.5:0.5:8:16:16:16 "
+            "option4=160:120 option5=300:300 option8=classic "
             "! tensor_sink name=out "
             f"multifilesrc location={REF}/palm_detection_input_0.%d "
             "start-index=0 stop-index=1 "
@@ -377,8 +378,8 @@ class TestClassicPipeline:
         pipe = parse_launch(
             "tensor_mux name=mux sync-mode=nosync "
             "! tensor_decoder mode=bounding_boxes option1=mp-palm-detection "
-            "option2=160:120 option4=0.5 option5=0.05 option8=300:300 "
-            "option9=4:1.0:1.0:0.5:0.5:8:16:16:16 option10=classic "
+            "option3=0.5:4:1.0:1.0:0.5:0.5:8:16:16:16 "
+            "option4=160:120 option5=300:300 option8=classic "
             "! tensor_sink name=out "
             "appsrc name=src0 caps=other/tensors,format=static,dimensions=18:2016,types=float32 ! mux.sink_0 "
             "appsrc name=src1 caps=other/tensors,format=static,dimensions=2016,types=float32 ! mux.sink_1 "

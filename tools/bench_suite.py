@@ -136,7 +136,7 @@ def main() -> None:
         ("ssd_mobilenet_bounding_boxes", 224,
          "nnstreamer_tpu.models.ssd_mobilenet:filter_model",
          "tensor_decoder mode=bounding_boxes "
-         "option1=mobilenet-ssd-postprocess option2=224:224 option4=0.3"),
+         "option1=mobilenet-ssd-postprocess option3=,30 option4=224:224"),
         ("posenet_pose_estimation", size,
          "nnstreamer_tpu.models.posenet:filter_model",
          f"tensor_decoder mode=pose_estimation option1={size}:{size} "
