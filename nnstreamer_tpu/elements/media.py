@@ -20,11 +20,14 @@ from typing import Dict, Optional
 
 import numpy as np
 
+import threading
+
 from ..core import Buffer, Caps
 from ..core.caps import AUDIO_MIME, VIDEO_MIME, Structure
 from ..registry.elements import register_element
-from ..runtime.element import ElementError, Prop, TransformElement
-from ..runtime.pad import Pad, PadDirection, PadTemplate
+from ..runtime.element import (Element, ElementError, Prop,
+                               TransformElement)
+from ..runtime.pad import Pad, PadDirection, PadPresence, PadTemplate
 
 # elements safe to look THROUGH when searching for the constraining
 # capsfilter (passthrough-ish shims + queue)
@@ -202,6 +205,117 @@ class ImageFreeze(TransformElement):
 
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         return buf
+
+
+@register_element
+class VideoMixer(Element):
+    """Alpha compositor (GStreamer ``videomixer``/``compositor`` role):
+    N video inputs blended in pad order (sink_0 = bottom layer) — the
+    counterpart of the bounding-box/pose decoders' transparent RGBA
+    overlays (the reference pipelines end ``decoder ! mix.sink_1``).
+    Frames pair with tensor_mux's slowest sync; sizes must match."""
+
+    ELEMENT_NAME = "videomixer"
+    SINK_TEMPLATES = (PadTemplate("sink_%u", PadDirection.SINK,
+                                  Caps.new(VIDEO_MIME), PadPresence.REQUEST),)
+    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC,
+                                 Caps.new(VIDEO_MIME)),)
+    PROPERTIES = {
+        "sync_mode": Prop("slowest", str, "slowest | nosync (pairing policy)"),
+        "sync_option": Prop(None, str, "unused (tensor_mux signature compat)"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._queues = {}
+        self._latest = {}
+        self._mix_lock = threading.Lock()
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        with self._mix_lock:
+            self._queues.clear()
+            self._latest.clear()
+
+    def _zordered(self):
+        """Linked sink pads in PAD-INDEX order (sink_0 = bottom layer),
+        regardless of the order the launch string linked them."""
+
+        def idx(pad):
+            _, _, n = pad.name.rpartition("_")
+            return int(n) if n.isdigit() else 0
+
+        return sorted((p for p in self.sink_pads if p.is_linked), key=idx)
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        # output geometry/format follow the bottom layer (sink_0)
+        for pad in self._zordered():
+            if pad.caps is not None:
+                return pad.caps
+        return Caps.new(VIDEO_MIME)
+
+    @staticmethod
+    def _rgb_alpha(a: np.ndarray):
+        """Any 1/3/4-channel uint8 frame → (rgb float32, alpha|None)."""
+        if a.ndim == 2:
+            a = a[..., None]
+        c = a.shape[-1]
+        if c == 1:
+            return np.repeat(a, 3, axis=-1).astype(np.float32), None
+        if c == 3:
+            return a.astype(np.float32), None
+        if c == 4:
+            return (a[..., :3].astype(np.float32),
+                    a[..., 3:4].astype(np.float32) / 255.0)
+        raise ElementError(f"videomixer: {c}-channel frame unsupported")
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        from .muxdemux import collect_sync
+
+        with self._mix_lock:
+            parts = collect_sync(self, pad, buf)
+            if parts is None:
+                return
+            # collect_sync returns parts aligned with sink_pads LINK order;
+            # re-pair into pad-index z-order (sink_0 bottom)
+            linked = [p for p in self.sink_pads if p.is_linked]
+        by_pad = dict(zip((p.name for p in linked), parts))
+        parts = [by_pad[p.name] for p in self._zordered()]
+        frames = [np.asarray(p.as_numpy().tensors[0]) for p in parts]
+        base_raw = frames[0]
+        if base_raw.ndim == 2:
+            base_raw = base_raw[..., None]
+        base_channels = base_raw.shape[-1]
+        out, _base_alpha = self._rgb_alpha(base_raw)
+        for layer in frames[1:]:
+            if layer.shape[:2] != base_raw.shape[:2]:
+                raise ElementError(
+                    f"{self.describe()}: layer size {layer.shape[:2]} != "
+                    f"base {base_raw.shape[:2]} (scale upstream)")
+            rgb, alpha = self._rgb_alpha(layer)
+            if alpha is None:  # opaque layer replaces
+                out = rgb
+            else:
+                out = out * (1.0 - alpha) + rgb * alpha
+        blended = np.clip(out, 0, 255).astype(np.uint8)
+        if base_channels == 1:  # keep the negotiated grayscale format
+            blended = np.clip(
+                0.299 * blended[..., 0] + 0.587 * blended[..., 1]
+                + 0.114 * blended[..., 2], 0, 255).astype(np.uint8)[..., None]
+        elif base_channels == 4:  # reattach the base's alpha plane
+            blended = np.concatenate(
+                [blended, base_raw[..., 3:4]], axis=-1)
+        result = Buffer([blended]).copy_metadata_from(parts[0])
+        result.pts = max((p.pts for p in parts if p.pts is not None),
+                         default=None)
+        self.push(result)
+
+
+@register_element
+class Compositor(VideoMixer):
+    """GStreamer 1.x name for :class:`VideoMixer`."""
+
+    ELEMENT_NAME = "compositor"
 
 
 # -- audio ------------------------------------------------------------------

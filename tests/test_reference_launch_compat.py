@@ -88,3 +88,63 @@ def test_audiotestsrc_sine_respects_downstream_caps():
     a = np.asarray(out[0].tensors[0])
     assert a.dtype == np.float32 and a.shape == (400, 2)
     assert np.abs(a).max() <= 1.0 and np.abs(a).max() > 0.5
+
+
+def test_videomixer_composites_decoder_overlay():
+    """The reference image_segment/bbox pipelines blend the decoder's
+    transparent RGBA overlay over the source video through videomixer."""
+    from nnstreamer_tpu.runtime.parse import parse_launch
+
+    pipe = parse_launch(
+        "videomixer name=mix ! tensor_sink name=out max-stored=2 "
+        "appsrc name=base caps=video/raw,format=RGB,width=8,height=8 "
+        "! mix.sink_0 "
+        "appsrc name=over caps=video/raw,format=RGBA,width=8,height=8 "
+        "! mix.sink_1")
+    out = []
+    pipe.get("out").connect(out.append)
+    pipe.play()
+    base = np.full((8, 8, 3), 100, np.uint8)
+    over = np.zeros((8, 8, 4), np.uint8)
+    over[2, 3] = [255, 0, 0, 255]   # one opaque red pixel
+    over[5, 5] = [0, 255, 0, 128]   # one half-green pixel
+    pipe.get("base").push_buffer(base)
+    pipe.get("over").push_buffer(over)
+    pipe.get("base").end_of_stream()
+    pipe.get("over").end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
+    a = np.asarray(out[0].tensors[0])
+    assert a.shape == (8, 8, 3)
+    assert list(a[2, 3]) == [255, 0, 0]          # opaque overlay wins
+    assert list(a[0, 0]) == [100, 100, 100]      # untouched base
+    assert abs(int(a[5, 5][1]) - 178) <= 1       # 100*(1-.5)+255*.5
+
+
+def test_videomixer_zorder_and_channel_mixes():
+    """sink_0 is the bottom layer even when linked LAST, and gray/RGB/RGBA
+    combinations blend without shape errors."""
+    from nnstreamer_tpu.runtime.parse import parse_launch
+
+    pipe = parse_launch(  # overlay linked FIRST, base second
+        "videomixer name=mix ! tensor_sink name=out max-stored=2 "
+        "appsrc name=over caps=video/raw,format=RGBA,width=4,height=4 "
+        "! mix.sink_1 "
+        "appsrc name=base caps=video/raw,format=GRAY8,width=4,height=4 "
+        "! mix.sink_0")
+    out = []
+    pipe.get("out").connect(out.append)
+    pipe.play()
+    base = np.full((4, 4, 1), 50, np.uint8)
+    over = np.zeros((4, 4, 4), np.uint8)
+    over[1, 1] = [255, 255, 255, 255]
+    pipe.get("over").push_buffer(over)
+    pipe.get("base").push_buffer(base)
+    pipe.get("over").end_of_stream()
+    pipe.get("base").end_of_stream()
+    pipe.wait(timeout=10)
+    pipe.stop()
+    a = np.asarray(out[0].tensors[0])
+    assert a.shape == (4, 4, 1)          # base (sink_0) format kept: GRAY8
+    assert a[0, 0, 0] == 50              # untouched base pixel
+    assert a[1, 1, 0] == 255             # white overlay pixel composited
