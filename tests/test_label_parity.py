@@ -8,86 +8,48 @@ jax2tf → TFLite, then the identical input stream is run through
   (b) tensor_filter framework=tflite (the reference's flagship backend)
 with the image_labeling decoder, and the decoded label indices must match
 frame for frame.
+
+The flow itself lives in nnstreamer_tpu.utils.parity — shared with
+tools/device_parity.py, the standalone runner the tunnel watcher executes
+on the real TPU, so this test and the on-device evidence are one harness.
 """
+import sys
+
 import numpy as np
 import pytest
 
-tf = pytest.importorskip("tensorflow")
+pytest.importorskip("tensorflow")
 
-from nnstreamer_tpu.runtime.parse import parse_launch
+from nnstreamer_tpu.utils.parity import (
+    export_f32_mobilenet,
+    labels_through,
+    register_entry_module,
+)
 
 
 @pytest.fixture(scope="module")
 def exported(tmp_path_factory):
-    from nnstreamer_tpu.models.mobilenet_v2 import build_mobilenet_v2
-
-    import numpy as np
-
-    # float32 compute for the export: tflite has no bfloat16 kernels. The
-    # weights are identical; the TPU path's bf16 compute is separately
-    # checked for label agreement in test_bf16_compute_label_stable.
-    apply_fn, params = build_mobilenet_v2(compute_dtype="float32")
-
-    def fwd(x):
-        return apply_fn(params, x)
-
-    conv = tf.lite.TFLiteConverter.experimental_from_jax(
-        [fwd], [[("x", np.zeros((1, 224, 224, 3), np.float32))]])
     path = tmp_path_factory.mktemp("parity") / "mobilenet_v2.tflite"
-    path.write_bytes(conv.convert())
-    return fwd, str(path)
-
-
-def _labels_through(framework, model, frames):
-    from nnstreamer_tpu.elements.src import AppSrc  # noqa: F401 registered
-
-    pipe = parse_launch(
-        "appsrc name=in caps=other/tensors,format=static,"
-        "dimensions=3:224:224:1,types=float32 "
-        f"! tensor_filter framework={framework} model={model} "
-        "! tensor_decoder mode=image_labeling "
-        "! tensor_sink name=out max-stored=64"
-    )
-    got = []
-    pipe.get("out").connect(lambda b: got.append(b.meta["label_index"]))
-    pipe.play()
-    src = pipe.get("in")
-    for f in frames:
-        src.push_buffer(f)
-    src.end_of_stream()
-    pipe.wait(timeout=120)
-    pipe.stop()
-    return got
+    return export_f32_mobilenet(str(path))
 
 
 @pytest.mark.slow
-def test_label_parity_jax_vs_tflite(exported, _entry_module, tmp_path):
-    fwd, tflite_path = exported
+def test_label_parity_jax_vs_tflite(exported, _entry_module):
+    _, tflite_path = exported
     rng = np.random.default_rng(7)
     frames = [rng.random((1, 224, 224, 3), np.float32) * 2 - 1 for _ in range(8)]
 
-    jax_labels = _labels_through(
-        "jax", "tests_parity_entry:entry", frames)
-    tflite_labels = _labels_through("tflite", tflite_path, frames)
+    jax_labels = labels_through("jax", _entry_module, frames)
+    tflite_labels = labels_through("tflite", tflite_path, frames)
     assert len(jax_labels) == len(tflite_labels) == 8
     assert jax_labels == tflite_labels
 
 
-
 @pytest.fixture
-def _entry_module(exported, monkeypatch, tmp_path):
+def _entry_module(exported):
     """Expose the fixture's forward fn as an importable module:attr entry
     for the jax backend (module entries are its model format)."""
-    import sys
-    import types
-
     fwd, _ = exported
-
-    class _Entry:
-        @staticmethod
-        def make():
-            return fwd
-
-    mod = types.ModuleType("tests_parity_entry")
-    mod.entry = _Entry()
-    monkeypatch.setitem(sys.modules, "tests_parity_entry", mod)
+    model = register_entry_module("tests_parity_entry", fwd)
+    yield model
+    sys.modules.pop("tests_parity_entry", None)

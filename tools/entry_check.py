@@ -1,0 +1,52 @@
+"""On-device `__graft_entry__.entry()` check, one JSON line.
+
+The driver compile-checks entry() single-chip at round end; in a live
+tunnel window the watcher runs this FIRST-PARTY version so the round's
+artifacts include the flagship forward step actually compiled and timed
+on the device (compile_s + steady-state step_ms), not just the
+pipeline-level fps number.
+
+Run:  python tools/entry_check.py     (probed platform; CPU fallback)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main() -> None:
+    import jax
+
+    import __graft_entry__ as ge
+
+    t0 = time.monotonic()
+    fn, example_args = ge.entry()  # entry() handles the platform probe
+    platform = jax.devices()[0].platform
+    jit_fn = jax.jit(fn)
+    t_c = time.monotonic()
+    out = jit_fn(*example_args)
+    jax.block_until_ready(out)
+    compile_s = time.monotonic() - t_c
+    steps = []
+    for _ in range(10):
+        t_s = time.monotonic()
+        jax.block_until_ready(jit_fn(*example_args))
+        steps.append(time.monotonic() - t_s)
+    print(json.dumps({
+        "metric": "graft_entry_forward",
+        "platform": platform,
+        "compile_s": round(compile_s, 2),
+        "step_ms_p50": round(sorted(steps)[len(steps) // 2] * 1e3, 3),
+        "total_s": round(time.monotonic() - t0, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)  # skip axon teardown aborts (same stance as bench.py)
