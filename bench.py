@@ -240,6 +240,22 @@ def main() -> None:
                 lat.append(time.monotonic() - t0)
             p50_ms = sorted(lat)[len(lat) // 2] * 1e3
 
+    # FLOPs accounting (VERDICT r3 #2): model FLOP/s + MFU alongside fps.
+    # cost_analysis of the exact batch graph; the persistent cache (or the
+    # backend's warm shape) makes the lower+compile ~free. Skipped when the
+    # deadline already hit — same stance as the p50 block: a stalled device
+    # would hang the compile and the partial result would never print.
+    perf = {"model_tflops_per_s": None, "mfu": None}
+    if not partial:
+        from nnstreamer_tpu.models.mobilenet_v2 import filter_model_u8
+        from nnstreamer_tpu.utils.flops import compiled_flops, perf_record
+
+        _log("cost analysis for MFU accounting ...")
+        batch_flops = compiled_flops(
+            filter_model_u8.make(), np.zeros((BATCH, 224, 224, 3), np.uint8))
+        perf = perf_record(batch_flops / BATCH if batch_flops else None,
+                           fps, device=devices[0])
+
     result = {
         "metric": "mobilenet_v2_224_pipeline_fps",
         "value": round(fps, 1),
@@ -249,6 +265,7 @@ def main() -> None:
         "batch": BATCH,
         "platform": platform,
         "compile_s": round(compile_s, 1),
+        **perf,
     }
     if partial:
         result["partial"] = True

@@ -1,0 +1,121 @@
+"""FLOPs accounting + MFU — the perf-evidence substrate for bench.py and
+tools/bench_suite.py.
+
+The reference instruments invoke latency/throughput only
+(gst/nnstreamer/tensor_filter/tensor_filter.c:366-510 — 10-invoke sliding
+average, µs granularity); on TPU a raw fps number says nothing about how
+much of the chip it uses, so every benchmark here also reports
+**model FLOP/s and MFU** (model FLOPs / peak chip FLOPs — the
+scaling-book utilization metric). Model FLOPs come from XLA's own
+compiled-program cost analysis (exact for the executable actually run);
+peak comes from a public per-generation spec table keyed on
+``device_kind`` with the rig's TPU env vars as fallback.
+
+MFU is only reported for devices whose peak is known (TPUs); on CPU the
+accounting fields still flow (flops, flops_per_s) so the code path is
+CI-validated, with ``mfu: null``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+# bf16 dense peak FLOP/s per chip, public spec sheets (cloud.google.com/tpu
+# docs; "How to Scale Your Model" table). Ordered: first substring match
+# on a lowercased device_kind / accelerator-type string wins, so more
+# specific names come before their prefixes ("v5p" before "v5").
+_PEAK_BF16: Tuple[Tuple[str, float], ...] = (
+    ("v6e", 918e12), ("v6 lite", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops_per_chip(device=None) -> Optional[float]:
+    """Peak dense bf16 FLOP/s for ``device`` (default: jax.devices()[0]),
+    or None when unknown (CPU, unrecognized generation)."""
+    names = []
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    if getattr(device, "platform", "cpu") == "cpu":
+        return None
+    names.append(str(getattr(device, "device_kind", "")).lower())
+    # tunneled rigs report an opaque kind; the TPU env contract still
+    # names the generation (e.g. TPU_ACCELERATOR_TYPE=v5litepod-4)
+    names.append(os.environ.get("TPU_ACCELERATOR_TYPE", "").lower())
+    names.append(os.environ.get("PALLAS_AXON_TPU_GEN", "").lower())
+    for name in names:
+        for key, peak in _PEAK_BF16:
+            if key and key in name:
+                return peak
+    return None
+
+
+def compiled_flops(fn, *example_args, static_argnums=()) -> Optional[float]:
+    """FLOPs of one call of ``fn(*example_args)`` per XLA's cost analysis
+    of the compiled executable. Returns None when the backend doesn't
+    expose cost analysis. Compiles the fn for the example shapes — on a
+    warm jit/persistent cache this is ~free, cold it pays one compile."""
+    import jax
+
+    try:
+        compiled = (jax.jit(fn, static_argnums=static_argnums)
+                    .lower(*example_args).compile())
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returned [dict]
+            cost = cost[0] if cost else {}
+        flops = cost.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:  # noqa: BLE001 — accounting must never sink a bench
+        return None
+
+
+def transformer_flops(n_params: int, n_layers: int, d_model: int,
+                      seq_len: int, n_tokens: int,
+                      kv_cache_len: int = 0) -> float:
+    """Analytic decoder-transformer FLOPs for ``n_tokens`` processed
+    tokens: the standard 2·N·tokens matmul estimate plus attention-score
+    FLOPs (12·L·D·T·ctx per scaling-book appendix; dominant only at long
+    context). ``kv_cache_len``: context attended per token in cached
+    decode (0 ⇒ full causal ≈ seq_len/2 average)."""
+    ctx = kv_cache_len if kv_cache_len > 0 else max(seq_len, 1) / 2.0
+    matmul = 2.0 * n_params * n_tokens
+    attn = 12.0 * n_layers * d_model * n_tokens * ctx
+    return matmul + attn
+
+
+def mfu(flops_per_second: Optional[float], n_chips: int = 1,
+        device=None) -> Optional[float]:
+    """Model FLOP utilization in [0, 1]; None when either side is
+    unknown."""
+    if not flops_per_second:
+        return None
+    peak = peak_flops_per_chip(device)
+    if not peak:
+        return None
+    return flops_per_second / (peak * max(n_chips, 1))
+
+
+def count_params(params: Any) -> int:
+    """Total scalar count of a pytree of arrays."""
+    import jax
+
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params)
+               if hasattr(x, "size"))
+
+
+def perf_record(flops_per_item: Optional[float], items_per_second: float,
+                n_chips: int = 1, device=None) -> dict:
+    """The JSON fields every bench row carries: model_tflops_per_s + mfu
+    (null-safe)."""
+    if not flops_per_item or items_per_second <= 0:
+        return {"model_tflops_per_s": None, "mfu": None}
+    fps_flops = flops_per_item * items_per_second
+    u = mfu(fps_flops, n_chips=n_chips, device=device)
+    return {"model_tflops_per_s": round(fps_flops / 1e12, 4),
+            "mfu": round(u, 4) if u is not None else None}

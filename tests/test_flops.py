@@ -1,0 +1,80 @@
+"""FLOPs accounting / MFU substrate (utils/flops.py).
+
+The bench evidence depends on three properties: XLA cost analysis is
+close to the analytic matmul count, the peak-FLOPs table resolves TPU
+generations (including via the rig's env-var fallback), and the record
+helper degrades to nulls — never raises — when either side is unknown.
+"""
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.utils.flops import (
+    compiled_flops,
+    count_params,
+    mfu,
+    peak_flops_per_chip,
+    perf_record,
+    transformer_flops,
+)
+
+
+class _FakeDev:
+    def __init__(self, platform, kind):
+        self.platform = platform
+        self.device_kind = kind
+
+
+def test_compiled_flops_close_to_analytic():
+    import jax.numpy as jnp
+
+    def f(x, w):
+        return x @ w
+
+    got = compiled_flops(f, jnp.ones((8, 256), jnp.float32),
+                         jnp.ones((256, 512), jnp.float32))
+    analytic = 2 * 8 * 256 * 512
+    assert got is not None
+    # XLA counts a handful of extra elementwise flops; same order, >= matmul
+    assert analytic <= got <= analytic * 1.25
+
+
+def test_peak_table_matches_generations():
+    assert peak_flops_per_chip(_FakeDev("tpu", "TPU v5 lite")) == 197e12
+    assert peak_flops_per_chip(_FakeDev("tpu", "TPU v5p")) == 459e12
+    assert peak_flops_per_chip(_FakeDev("tpu", "TPU v4")) == 275e12
+    assert peak_flops_per_chip(_FakeDev("tpu", "TPU v6 lite")) == 918e12
+    # CPU has no published peak: accounting must say "unknown", not guess
+    assert peak_flops_per_chip(_FakeDev("cpu", "cpu")) is None
+
+
+def test_peak_env_fallback_for_opaque_kinds(monkeypatch):
+    # tunneled rigs report an opaque device_kind; the TPU env contract
+    # still names the generation
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+    assert peak_flops_per_chip(_FakeDev("axon", "unknown-kind")) == 197e12
+
+
+def test_mfu_and_record():
+    dev = _FakeDev("tpu", "TPU v5 lite")
+    # 19.7 TFLOP/s on a 197 TFLOP/s chip = 10% MFU
+    assert mfu(19.7e12, n_chips=1, device=dev) == pytest.approx(0.1)
+    rec = perf_record(1e9, 1000.0, device=dev)
+    assert rec["model_tflops_per_s"] == pytest.approx(1.0)
+    assert rec["mfu"] == pytest.approx(1e12 / 197e12, abs=5e-5)  # 4-dp rounded
+    # null-safe paths
+    assert perf_record(None, 1000.0) == {"model_tflops_per_s": None,
+                                         "mfu": None}
+    assert mfu(None) is None
+
+
+def test_transformer_flops_dominated_by_matmul_at_short_ctx():
+    n_params, toks = 125_000_000, 1024
+    got = transformer_flops(n_params, n_layers=12, d_model=768,
+                            seq_len=64, n_tokens=toks)
+    assert got >= 2.0 * n_params * toks
+    assert got <= 2.6 * n_params * toks  # attn term small at seq 64
+
+
+def test_count_params():
+    tree = {"a": np.zeros((3, 4)), "b": [np.zeros(5), np.zeros((2, 2))]}
+    assert count_params(tree) == 12 + 5 + 4

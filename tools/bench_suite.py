@@ -9,6 +9,14 @@ this suite is the full evidence set for the remaining headline configs:
   4. deeplab image_segment        (segmentation + palette render)
   5. tensor_query sharded inference (2 loopback workers, tensor_shard →
      query clients → ordered re-join — the among-device config)
+  6. transformer LM prefill + KV-cache decode (tokens/s, decode step
+     time, MFU at a few batch/seq points — models/decoding.py)
+
+Every model config also reports model FLOP/s + MFU (utils/flops.py,
+VERDICT r3 #2) and ``p50_pipeline_ms`` — batch=1 single-frame latency
+through the FULL pipeline topology including aggregator + queues
+(VERDICT r3 #6; the reference's per-frame operating point,
+tensor_filter.c:366-510 invoke statistics).
 
 Run:  python tools/bench_suite.py            (TPU when up, CPU fallback)
       BENCHS_FRAMES=64 BENCHS_BATCH=8 ...    (size knobs; CPU defaults
@@ -16,9 +24,10 @@ Run:  python tools/bench_suite.py            (TPU when up, CPU fallback)
       BENCHS_PERFRAME_BATCH=N                (model batch for the
       detection/pose/segment configs on accelerators — the decoder stays
       per-frame; 1 = the reference-style unbatched topology)
+      BENCHS_SKIP_LM=1 / BENCHS_LM_POINTS=B:P:S[,B:P:S...]  (LM knobs)
 
-Each config prints {"config", "fps", "frames", "batch", "platform"} on
-stdout; a summary table goes to stderr.
+Each config prints one JSON object on stdout; a summary table goes to
+stderr.
 """
 from __future__ import annotations
 
@@ -69,6 +78,167 @@ def _run_fps(pipe, sink_name: str, want: int, warmup: int,
     return (len(times) - warmup) / span if span > 0 else 0.0, len(times) - warmup
 
 
+def _pipeline_p50(model: str, in_size: int, dec: str, dtype: str = "float32",
+                  n: int = 20, warmup: int = 3,
+                  frame_timeout_s: float = 120.0) -> float:
+    """Batch=1 single-frame latency through the FULL topology (aggregator
+    + queues + filter + decoder), serialized push→sink round trips — the
+    reference's per-frame operating point, with element overheads that
+    SingleShot.invoke excludes. Returns p50 in ms."""
+    import threading
+
+    import numpy as np
+
+    from nnstreamer_tpu.elements.src import AppSrc  # noqa: F401 registered
+    from nnstreamer_tpu.runtime.parse import parse_launch
+
+    pipe = parse_launch(
+        f"appsrc name=in caps=other/tensors,format=static,"
+        f"dimensions=3:{in_size}:{in_size}:1,types={dtype} "
+        "! tensor_aggregator frames-out=1 frames-dim=0 concat=true "
+        "! queue max-size-buffers=4 "
+        f"! tensor_filter framework=jax model={model} "
+        "! queue max-size-buffers=8 "
+        f"! {dec} ! tensor_sink name=out max-stored=1")
+    done = threading.Event()
+    pipe.get("out").connect(lambda b: done.set())
+    pipe.play()
+    src = pipe.get("in")
+    rng = np.random.default_rng(1)
+    if dtype == "uint8":
+        x = (rng.random((1, in_size, in_size, 3)) * 255).astype(np.uint8)
+    else:
+        x = rng.random((1, in_size, in_size, 3)).astype(np.float32)
+    lats = []
+    try:
+        for i in range(n + warmup):
+            done.clear()
+            t0 = time.monotonic()
+            src.push_buffer(x)
+            if not done.wait(frame_timeout_s):
+                raise RuntimeError(f"latency frame {i} timed out")
+            if i >= warmup:
+                lats.append(time.monotonic() - t0)
+    finally:
+        pipe.stop()
+    return sorted(lats)[len(lats) // 2] * 1e3
+
+
+def _model_perf(model_entry, example_shape, example_dtype, fps: float,
+                batch: int) -> dict:
+    """model FLOP/s + MFU fields for a suite row (null-safe)."""
+    import numpy as np
+
+    import jax
+
+    from nnstreamer_tpu.utils.flops import compiled_flops, perf_record
+
+    flops = compiled_flops(model_entry.make(),
+                           np.zeros(example_shape, example_dtype))
+    return perf_record(flops / batch if flops else None, fps,
+                       device=jax.devices()[0])
+
+
+def _bench_lm_decode(platform: str, on_cpu: bool,
+                     deadline_s: float) -> None:
+    """Config 6: transformer LM prefill + KV-cache decode. Per (B, P, S)
+    point: tokens/s for the whole generate (prefill P tokens + S cached
+    decode steps), the marginal decode step time (subtracting a steps=1
+    run), and MFU from XLA cost analysis of the exact executables."""
+    import numpy as np
+
+    import jax
+
+    from nnstreamer_tpu.models.decoding import make_generate
+    from nnstreamer_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from nnstreamer_tpu.utils.flops import (
+        compiled_flops,
+        count_params,
+        mfu,
+    )
+
+    if on_cpu:
+        cfg = TransformerConfig(vocab=512, dim=128, heads=4, layers=2,
+                                max_seq=256)
+        points = [(2, 64, 32)]
+    else:
+        # ~215M-param decoder: big enough that decode is HBM/matmul bound
+        # like a real LM, small enough to init+compile inside a tunnel
+        # window alongside the rest of the suite
+        cfg = TransformerConfig(vocab=32000, dim=1024, heads=16, layers=12,
+                                max_seq=2048)
+        points = [(8, 512, 128), (32, 512, 128), (8, 1024, 256)]
+    if os.environ.get("BENCHS_LM_POINTS"):
+        points = [tuple(int(v) for v in p.split(":"))
+                  for p in os.environ["BENCHS_LM_POINTS"].split(",")]
+    reps = 1 if on_cpu else 3
+
+    _log(f"transformer_lm_decode: dim={cfg.dim} layers={cfg.layers} "
+         f"vocab={cfg.vocab} points={points}")
+    t_start = time.monotonic()
+    params = init_params(cfg)
+    n_params = count_params(params)
+    gen = make_generate(cfg)
+    rng = np.random.default_rng(3)
+    for B, P, S in points:
+        name = f"transformer_lm_decode_b{B}_p{P}_s{S}"
+        if time.monotonic() - t_start > deadline_s:
+            _log(f"{name}: skipped (suite LM deadline)")
+            continue
+        try:
+            prompt = rng.integers(0, cfg.vocab, (B, P)).astype(np.int32)
+            jax.block_until_ready(gen(params, prompt, 1))    # compile S=1
+            jax.block_until_ready(gen(params, prompt, S))    # compile S
+            t1 = min(_timed(gen, params, prompt, 1, reps=reps))
+            tS = min(_timed(gen, params, prompt, S, reps=reps))
+            f1 = compiled_flops(gen, params, prompt, 1, static_argnums=(2,))
+            fS = compiled_flops(gen, params, prompt, S, static_argnums=(2,))
+            if S > 1:  # marginal decode cost needs a second point
+                step_s = max(tS - t1, 1e-9) / (S - 1)
+                decode_flops_step = ((fS - f1) / (S - 1)
+                                     if fS and f1 and fS > f1 else None)
+            else:  # prefill-only point (e.g. BENCHS_LM_POINTS=8:512:1)
+                step_s = None
+                decode_flops_step = None
+            total_mfu = mfu(fS / tS if fS else None)
+            decode_mfu = mfu(decode_flops_step / step_s
+                             if decode_flops_step and step_s else None)
+            row = {
+                "config": name, "platform": platform,
+                "n_params": n_params,
+                "tokens_per_s": round(B * S / tS, 1),
+                "decode_tokens_per_s": (round(B / step_s, 1)
+                                        if step_s else None),
+                "decode_step_ms": (round(step_s * 1e3, 3)
+                                   if step_s else None),
+                "prefill_s": round(t1, 4),
+                "mfu": round(total_mfu, 4) if total_mfu else None,
+                "decode_mfu": round(decode_mfu, 4) if decode_mfu else None,
+            }
+            print(json.dumps(row), flush=True)
+            _log(f"{name}: {row['tokens_per_s']} tok/s, "
+                 f"step {row['decode_step_ms']} ms, mfu={row['mfu']}")
+        except Exception as e:  # noqa: BLE001 — one point must not sink the suite
+            _log(f"{name} FAILED: {e}")
+            print(json.dumps({"config": name, "platform": platform,
+                              "error": str(e)[:300]}), flush=True)
+
+
+def _timed(fn, *args, reps: int = 3):
+    """Wall time of reps calls of fn(*args), each blocked to completion."""
+    import jax
+
+    out = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        jax.block_until_ready(fn(*args))
+        out.append(time.monotonic() - t0)
+    return out
+
+
 def main() -> None:
     import numpy as np  # noqa: F401
 
@@ -99,10 +269,11 @@ def main() -> None:
 
     results = []
 
-    def record(name, fps, measured_frames, model_batch):
+    def record(name, fps, measured_frames, model_batch, extra=None):
         row = {"config": name, "fps": round(fps, 1),
                "measured_frames": measured_frames,
                "batch": model_batch, "platform": platform}
+        row.update(extra or {})
         results.append(row)
         print(json.dumps(row), flush=True)
 
@@ -124,7 +295,23 @@ def main() -> None:
             f"! tensor_decoder mode=image_labeling option1={labels} "
             "! tensor_sink name=out max-stored=1")
         fps_b, n = _run_fps(pipe, "out", frames // batch, warmup_batches, deadline)
-        record(name, fps_b * batch, n * batch, batch)
+        fps1 = fps_b * batch
+        # aux measurements (MFU, p50) must never cost the primary fps
+        # number already in hand — they fail soft onto the same row
+        extra = {}
+        try:
+            from nnstreamer_tpu.models import mobilenet_v2 as _mnv2
+
+            extra = _model_perf(_mnv2.filter_model_u8, (batch, 224, 224, 3),
+                                "uint8", fps1, batch)
+            _log(f"{name}: p50 pipeline latency (batch=1) ...")
+            extra["p50_pipeline_ms"] = round(_pipeline_p50(
+                "nnstreamer_tpu.models.mobilenet_v2:filter_model_u8", 224,
+                f"tensor_decoder mode=image_labeling option1={labels}",
+                dtype="uint8"), 2)
+        except Exception as e:  # noqa: BLE001
+            _log(f"{name} aux (mfu/p50) failed: {e}")
+        record(name, fps1, n * batch, batch, extra)
     except Exception as e:
         _log(f"{name} FAILED: {e}")
         record(name, 0.0, 0, batch)
@@ -179,7 +366,20 @@ def main() -> None:
                 "! queue max-size-buffers=8 "
                 f"! {dec} ! tensor_sink name=out max-stored=1")
             fps, n = _run_fps(pipe, "out", pf_frames, pf_warmup, deadline)
-            record(name, fps, n, pf_batch)
+            extra = {}
+            try:  # aux (MFU, p50) fails soft — never costs the fps number
+                import importlib
+
+                mod_name, attr = model.split(":")
+                entry = getattr(importlib.import_module(mod_name), attr)
+                extra = _model_perf(entry, (pf_batch, in_size, in_size, 3),
+                                    "float32", fps, pf_batch)
+                _log(f"{name}: p50 pipeline latency (batch=1) ...")
+                extra["p50_pipeline_ms"] = round(
+                    _pipeline_p50(model, in_size, dec), 2)
+            except Exception as e:  # noqa: BLE001
+                _log(f"{name} aux (mfu/p50) failed: {e}")
+            record(name, fps, n, pf_batch, extra)
         except Exception as e:
             _log(f"{name} FAILED: {e}")
             record(name, 0.0, 0, pf_batch)
@@ -225,9 +425,16 @@ def main() -> None:
         for srv in servers:
             srv.stop()
 
+    # -- 6. transformer LM prefill + KV-cache decode ------------------------
+    if not os.environ.get("BENCHS_SKIP_LM"):
+        _bench_lm_decode(platform, on_cpu,
+                         deadline_s=float(os.environ.get(
+                             "BENCHS_LM_DEADLINE", "600")))
+
     _log("---- summary ----")
     for row in results:
-        _log(f"{row['config']:34s} {row['fps']:10.1f} fps  ({row['platform']})")
+        _log(f"{row['config']:34s} {row['fps']:10.1f} fps  "
+             f"({row['platform']}, mfu={row.get('mfu')})")
 
 
 if __name__ == "__main__":

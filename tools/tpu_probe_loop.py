@@ -144,7 +144,7 @@ def capture_evidence(platform: str) -> None:
     env = dict(os.environ, NNS_TPU_PROBE_CACHE=cache,
                BENCH_INIT_TIMEOUT="120")
     for rel_cmd, out_name, timeout_s in EVIDENCE:
-        if os.path.exists(os.path.join(ROOT, out_name)):
+        if _artifact_on_device(os.path.join(ROOT, out_name)):
             continue  # captured in an earlier window; don't re-burn time
         cmd = [sys.executable] + [os.path.join(ROOT, *rel_cmd[0].split("/"))] \
             + rel_cmd[1:]
@@ -160,8 +160,26 @@ def capture_evidence(platform: str) -> None:
         _seed_cache(cache, platform)
 
 
+def _artifact_on_device(path: str) -> bool:
+    """True only when the saved artifact was actually measured on an
+    accelerator. A window can die mid-capture, making the script fall back
+    to CPU and still emit parseable JSON — such an artifact must NOT block
+    re-capture in a later live window (it carries a CPU number in a
+    TPU-named file)."""
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return False
+    rows = data if isinstance(data, list) else [data]
+    plats = [r.get("platform") or r.get("jax_platform")
+             for r in rows if isinstance(r, dict)]
+    plats = [p for p in plats if p]
+    return bool(plats) and all(p != "cpu" for p in plats)
+
+
 def _evidence_missing() -> bool:
-    return any(not os.path.exists(os.path.join(ROOT, name))
+    return any(not _artifact_on_device(os.path.join(ROOT, name))
                for _, name, _ in EVIDENCE)
 
 
