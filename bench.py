@@ -247,14 +247,18 @@ def main() -> None:
     # would hang the compile and the partial result would never print.
     perf = {"model_tflops_per_s": None, "mfu": None}
     if not partial:
-        from nnstreamer_tpu.models.mobilenet_v2 import filter_model_u8
-        from nnstreamer_tpu.utils.flops import compiled_flops, perf_record
+        try:  # aux accounting must never cost the fps number already in hand
+            from nnstreamer_tpu.models.mobilenet_v2 import filter_model_u8
+            from nnstreamer_tpu.utils.flops import compiled_flops, perf_record
 
-        _log("cost analysis for MFU accounting ...")
-        batch_flops = compiled_flops(
-            filter_model_u8.make(), np.zeros((BATCH, 224, 224, 3), np.uint8))
-        perf = perf_record(batch_flops / BATCH if batch_flops else None,
-                           fps, device=devices[0])
+            _log("cost analysis for MFU accounting ...")
+            batch_flops = compiled_flops(
+                filter_model_u8.make(),
+                np.zeros((BATCH, 224, 224, 3), np.uint8))
+            perf = perf_record(batch_flops / BATCH if batch_flops else None,
+                               fps, device=devices[0])
+        except Exception as e:  # noqa: BLE001
+            _log(f"MFU accounting failed: {e}")
 
     result = {
         "metric": "mobilenet_v2_224_pipeline_fps",

@@ -445,6 +445,12 @@ class TensorFilter(TransformElement):
         """The device the opened backend is pinned to (jax backends)."""
         return getattr(self.backend, "device", None)
 
+    @property
+    def backend_mesh(self):
+        """The device mesh the opened backend shards over
+        (``custom=mesh:...`` jax backends; None = single-device)."""
+        return getattr(self.backend, "mesh", None)
+
     def reload_model(self, new_model: Optional[str] = None) -> None:
         """Hot model swap without pipeline restart (reference ``is-updatable``
         + RELOAD_MODEL event, nnstreamer_plugin_api_filter.h:378-384)."""
