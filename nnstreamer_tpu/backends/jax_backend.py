@@ -210,6 +210,21 @@ class JaxBackend(FilterBackend):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
         devices = jax.devices()
+        # honor an explicit accelerator/platform request the same way
+        # _select_device does — a mesh over devices the user opted out of
+        # would be a silent placement override
+        accel = self.props.accelerator if self.props else Accelerator.AUTO
+        want = get_config().get("jax", "default_device", "auto")
+        if accel is not Accelerator.AUTO:
+            want = accel.value
+        if want not in ("auto", ""):
+            matching = [d for d in devices if d.platform.startswith(want)]
+            if not matching:
+                raise ValueError(
+                    f"custom=mesh with accelerator={want}: no {want} "
+                    f"devices present (have "
+                    f"{sorted({d.platform for d in devices})})")
+            devices = matching
         spec = spec.strip().lower()
         n: Optional[int] = None
         if spec in ("auto", "all", "dp=all", "dp=auto"):
@@ -389,16 +404,19 @@ class JaxBackend(FilterBackend):
         device_inputs = []
         for x in inputs:
             shape = getattr(x, "shape", None)
-            if shape and len(shape) >= 1 and shape[0] % n == 0:
-                x = jax.device_put(x, self._batch_sharding)
-            elif not self._mesh_warned:
-                self._mesh_warned = True
-                logger.warning(
-                    "jax mesh backend model=%s: input batch %s not "
-                    "divisible by mesh size %d — running this call "
-                    "unsharded (size the upstream tensor_aggregator to a "
-                    "multiple of the mesh)",
-                    self.props.model if self.props else "?", shape, n)
+            if shape:  # batched tensor: shard when the mesh divides it
+                if shape[0] % n == 0:
+                    x = jax.device_put(x, self._batch_sharding)
+                elif not self._mesh_warned:
+                    self._mesh_warned = True
+                    logger.warning(
+                        "jax mesh backend model=%s: input batch %s not "
+                        "divisible by mesh size %d — running this call "
+                        "unsharded (size the upstream tensor_aggregator "
+                        "to a multiple of the mesh)",
+                        self.props.model if self.props else "?", shape, n)
+            # rank-0 scalars / non-array aux inputs have no batch axis to
+            # shard: pass through (replicated by GSPMD), no warning
             device_inputs.append(x)
         out = self._jitted()(*device_inputs)
         return list(out)

@@ -25,31 +25,62 @@ import threading
 from ..core import Buffer, Caps
 from ..core.caps import AUDIO_MIME, VIDEO_MIME, Structure
 from ..registry.elements import register_element
+from ..utils.log import logger
 from ..runtime.element import (Element, ElementError, Prop,
                                TransformElement)
 from ..runtime.pad import Pad, PadDirection, PadPresence, PadTemplate
 
 # elements safe to look THROUGH when searching for the constraining
-# capsfilter (passthrough-ish shims + queue)
+# capsfilter (passthrough-ish shims + queue). Custom elements can opt in
+# by declaring ``CAPS_TRANSPARENT = True`` instead of editing this set.
 _TRANSPARENT = {"videoconvert", "videoscale", "audioconvert",
-                "imagefreeze", "queue"}
+                "imagefreeze", "queue", "tee"}
 
 
 def downstream_filter_caps(element, max_hops: int = 8) -> Optional[Caps]:
     """The nearest downstream capsfilter's caps, walking through
-    transparent elements; None when none is found."""
+    caps-transparent elements; None when none is found.
+
+    BOUNDARY (documented contract): the walk follows the FIRST src pad
+    only and looks through at most ``max_hops`` elements that are either
+    in ``_TRANSPARENT`` or declare ``CAPS_TRANSPARENT = True``. A
+    constraint sitting behind any other element is out of reach — the
+    caller falls back to its defaults, and the walk logs where it
+    stopped so the fallback is visible, not silent. GStreamer's real
+    negotiation propagates caps through every element; these shims only
+    need the reference's launch-line idioms (capsfilter right after the
+    src, possibly behind convert/scale/rate/queue), so a bounded,
+    logged walk is the deliberate trade.
+    """
     cur = element
     for _ in range(max_hops):
         pads = getattr(cur, "src_pads", ())
         if not pads or pads[0].peer is None:
+            # chain ends (or isn't linked yet) before any capsfilter —
+            # the no-capsfilter default case; debug, not info: this is
+            # the normal launch shape, not a missed constraint
+            logger.debug(
+                "%s: downstream chain ends before a capsfilter — "
+                "using defaults", getattr(element, "name", element))
             return None
         nxt = pads[0].peer.element
         filter_caps = getattr(nxt, "filter_caps", None)
         if filter_caps is not None:  # capsfilter (duck-typed: no import cycle)
             return filter_caps
-        if getattr(nxt, "ELEMENT_NAME", None) not in _TRANSPARENT:
+        if (getattr(nxt, "ELEMENT_NAME", None) not in _TRANSPARENT
+                and not getattr(nxt, "CAPS_TRANSPARENT", False)):
+            logger.info(
+                "%s: downstream capsfilter search stopped at opaque "
+                "element '%s' — using defaults (place the capsfilter "
+                "directly downstream, or mark the element "
+                "CAPS_TRANSPARENT)",
+                getattr(element, "name", element),
+                getattr(nxt, "name", nxt))
             return None
         cur = nxt
+    logger.info(
+        "%s: no capsfilter within %d downstream hops — using defaults",
+        getattr(element, "name", element), max_hops)
     return None
 
 

@@ -53,6 +53,10 @@ class Element:
     ELEMENT_NAME: str = ""
     SINK_TEMPLATES: Sequence[PadTemplate] = ()
     SRC_TEMPLATES: Sequence[PadTemplate] = ()
+    # caps-neutral elements (queue/convert/rate-style) set True so the
+    # media shims' downstream capsfilter search (elements/media.py
+    # downstream_filter_caps) can look through them
+    CAPS_TRANSPARENT: bool = False
     PROPERTIES: Dict[str, Prop] = {
         # reference: every tensor element carries `silent` (verbose
         # per-buffer logging when false, e.g. gsttensor_converter.c:263)

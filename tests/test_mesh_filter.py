@@ -23,7 +23,8 @@ def _run(launch, sink="out", timeout=60):
     pipe.get(sink).connect(got.append)
     pipe.play()
     pipe.wait(timeout=timeout)
-    mesh = pipe.get("f").backend_mesh if pipe.get("f") else None
+    f = pipe.elements.get("f")
+    mesh = f.backend_mesh if f is not None else None
     pipe.stop()
     return got, mesh
 

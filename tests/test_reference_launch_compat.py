@@ -148,3 +148,57 @@ def test_videomixer_zorder_and_channel_mixes():
     assert a.shape == (4, 4, 1)          # base (sink_0) format kept: GRAY8
     assert a[0, 0, 0] == 50              # untouched base pixel
     assert a[1, 1, 0] == 255             # white overlay pixel composited
+
+
+def test_caps_walk_through_declared_transparent_element():
+    """downstream_filter_caps honors CAPS_TRANSPARENT on elements that are
+    not in the built-in name set (the extensibility half of the walk's
+    documented boundary)."""
+    from nnstreamer_tpu.elements.media import downstream_filter_caps
+    from nnstreamer_tpu.registry.elements import register_element
+    from nnstreamer_tpu.runtime.element import Element
+    from nnstreamer_tpu.elements.debug import any_media_caps
+    from nnstreamer_tpu.runtime.pad import PadDirection, PadTemplate
+
+    @register_element
+    class _SeeThrough(Element):
+        ELEMENT_NAME = "test_seethrough"
+        CAPS_TRANSPARENT = True
+        SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK,
+                                      any_media_caps()), )
+        SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC,
+                                     any_media_caps()), )
+
+        def chain(self, pad, buf):
+            self.src_pads[0].push(buf)
+
+    pipe = parse_launch(
+        "videotestsrc num-buffers=1 name=src ! test_seethrough ! "
+        "video/x-raw,width=32,height=24,format=RGB,framerate=5/1 ! "
+        "videoconvert ! tensor_converter ! tensor_sink name=out")
+    caps = downstream_filter_caps(pipe.get("src"))
+    assert caps is not None
+    fields = dict(caps.first.fields)
+    assert fields["width"] == 32 and fields["height"] == 24
+    # and the pipeline actually produces a 32x24 frame through it
+    got = []
+    pipe.get("out").connect(got.append)
+    pipe.play(); pipe.wait(timeout=30); pipe.stop()
+    assert len(got) == 1
+    assert got[0].tensors[0].shape[1:3] == (24, 32)
+
+
+def test_caps_walk_stops_at_opaque_element(caplog):
+    """The fallback at an opaque element is logged, not silent (the
+    documented boundary of the shim heuristic)."""
+    import logging
+
+    from nnstreamer_tpu.elements.media import downstream_filter_caps
+
+    pipe = parse_launch(
+        "videotestsrc num-buffers=1 name=src ! tensor_converter ! "
+        "tensor_sink name=out")
+    with caplog.at_level(logging.INFO, logger="nnstreamer_tpu"):
+        caps = downstream_filter_caps(pipe.get("src"))
+    assert caps is None
+    assert any("stopped at opaque element" in r.message for r in caplog.records)
