@@ -117,15 +117,8 @@ class JaxBackend(FilterBackend):
         import jax
 
         self._select_device(props)
-        model = props.model
-        if self._fn is None:  # may be preset via set_model_callable
-            self._fn = self._load_model(model, props)
-        max_sig = props.custom_dict().get("max_signatures", "32")
-        try:
-            self._max_signatures = int(max_sig)
-        except ValueError:
-            raise ValueError(
-                f"custom=max_signatures:{max_sig!r} is not an integer")
+        # mesh BEFORE model load: shard-aware entries (make_sharded) need
+        # the mesh at build time to place their params
         mesh_spec = props.custom_dict().get("mesh")
         if mesh_spec is not None:
             if props.custom_dict().get("device") is not None:
@@ -136,6 +129,17 @@ class JaxBackend(FilterBackend):
                     "exclusive (a mesh shards over devices[0..N-1]; pin "
                     "stages OR shard one stage, not both)")
             self._setup_mesh(mesh_spec)
+        # cheap property validation before the (possibly expensive) model
+        # build — a bad knob must not cost a full param init first
+        max_sig = props.custom_dict().get("max_signatures", "32")
+        try:
+            self._max_signatures = int(max_sig)
+        except ValueError:
+            raise ValueError(
+                f"custom=max_signatures:{max_sig!r} is not an integer")
+        model = props.model
+        if self._fn is None:  # may be preset via set_model_callable
+            self._fn = self._load_model(model, props)
         logger.info("jax backend opened model=%s device=%s mesh=%s",
                     model, self._device, self._mesh)
 
@@ -194,17 +198,24 @@ class JaxBackend(FilterBackend):
         return self._mesh
 
     def _setup_mesh(self, spec: str) -> None:
-        """``custom=mesh:dp=N`` / ``mesh:auto`` — in-pipeline sharded
-        execution over the local device mesh (SURVEY §7: "inside a slice,
-        sharded execution via pjit mesh"). The batch axis is device_put
-        with a NamedSharding over ``dp`` and the SAME jitted callable
-        runs GSPMD-partitioned: XLA splits the batch across chips and
-        inserts the collectives, so ``tensor_aggregator →
-        tensor_filter(mesh)`` uses every chip over ICI with zero topology
-        plumbing in the launch line. This is the TPU-native replacement
-        for the reference's shared-model DP idiom (a tee fanning out to N
-        query clients; nnstreamer_plugin_api_filter.h:578-617 shared
-        model table) — one process, one program, no per-chip pipelines.
+        """``custom=mesh:dp=N`` / ``mesh:auto`` / ``mesh:DxT`` —
+        in-pipeline sharded execution over the local device mesh (SURVEY
+        §7: "inside a slice, sharded execution via pjit mesh"). The batch
+        axis is device_put with a NamedSharding over ``dp`` and the SAME
+        jitted callable runs GSPMD-partitioned: XLA splits the batch
+        across chips and inserts the collectives, so ``tensor_aggregator
+        → tensor_filter(mesh)`` uses every chip over ICI with zero
+        topology plumbing in the launch line. This is the TPU-native
+        replacement for the reference's shared-model DP idiom (a tee
+        fanning out to N query clients;
+        nnstreamer_plugin_api_filter.h:578-617 shared model table) — one
+        process, one program, no per-chip pipelines.
+
+        ``mesh:DxT`` builds a 2-D ``(dp=D, tp=T)`` mesh for shard-aware
+        model entries (objects exposing ``make_sharded(mesh)``, e.g. the
+        tensor-parallel LM serving entries in ``models/lm_serving.py``):
+        the entry places its own params/cache PartitionSpecs over ``tp``
+        while the backend still batch-shards inputs over ``dp``.
         """
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -227,6 +238,7 @@ class JaxBackend(FilterBackend):
             devices = matching
         spec = spec.strip().lower()
         n: Optional[int] = None
+        tp = 1
         if spec in ("auto", "all", "dp=all", "dp=auto"):
             n = len(devices)
         elif spec.startswith("dp="):
@@ -234,17 +246,29 @@ class JaxBackend(FilterBackend):
                 n = int(spec[3:])
             except ValueError:
                 pass
-        if n is None:
+        elif "x" in spec:  # mesh:DxT — 2-D dp×tp for shard-aware entries
+            try:
+                d_s, t_s = spec.split("x", 1)
+                n, tp = int(d_s), int(t_s)
+            except ValueError:
+                n = None
+        if n is None or tp < 1:
             raise ValueError(
-                f"custom=mesh:{spec!r} — expected 'mesh:dp=<N>' or "
-                "'mesh:auto' (data-parallel over N local devices)")
-        if not 1 <= n <= len(devices):
+                f"custom=mesh:{spec!r} — expected 'mesh:dp=<N>', "
+                "'mesh:auto', or 'mesh:<D>x<T>' (dp×tp)")
+        total = n * tp
+        if not 1 <= total <= len(devices):
             raise ValueError(
-                f"custom=mesh:dp={n} out of range (1..{len(devices)} "
-                "local devices)")
-        self._mesh = Mesh(np.asarray(devices[:n]), ("dp",))
-        # shard axis 0 (the batch axis the aggregator builds); trailing
-        # axes replicated
+                f"custom=mesh:{spec} needs {total} devices, out of range "
+                f"(1..{len(devices)} local devices)")
+        if tp == 1:
+            self._mesh = Mesh(np.asarray(devices[:total]), ("dp",))
+        else:
+            self._mesh = Mesh(
+                np.asarray(devices[:total]).reshape(n, tp), ("dp", "tp"))
+        # batch axis (dim 0, the one the aggregator builds) shards over
+        # dp; trailing axes replicate. On a 2-D mesh the tp axis belongs
+        # to the model's own param/cache shardings, never the batch.
         self._batch_sharding = NamedSharding(self._mesh, PartitionSpec("dp"))
 
     def set_model_callable(self, fn: Callable,
@@ -291,6 +315,12 @@ class JaxBackend(FilterBackend):
             mod_name, _, attr = model.partition(":")
             mod = importlib.import_module(mod_name)
             fn = getattr(mod, attr)
+            if self._mesh is not None:
+                # shard-aware entry: the model builds against the mesh
+                # (tp PartitionSpecs on params/cache; lm_serving.py)
+                sharded_maker = getattr(fn, "make_sharded", None)
+                if sharded_maker is not None:
+                    return sharded_maker(self._mesh)
             maker = getattr(fn, "make", None)
             return maker() if maker else fn
         raise ValueError(f"jax backend cannot load model '{model}'")
@@ -394,13 +424,15 @@ class JaxBackend(FilterBackend):
     def _invoke_sharded(self, inputs: List[Any]) -> List[Any]:
         """Mesh mode: batch-shard each input over ``dp`` and run the same
         jitted callable GSPMD-partitioned. Inputs whose leading dim does
-        not divide the mesh (e.g. a partial EOS tail the aggregator let
-        through) stay unsharded for that call — XLA still runs them
+        not divide the dp axis (e.g. a partial EOS tail the aggregator
+        let through) stay unsharded for that call — XLA still runs them
         correctly on the mesh-default device; correctness never depends
         on divisibility."""
         import jax
 
-        n = self._mesh.size
+        # the batch axis shards over dp only; on a 2-D (dp, tp) mesh the
+        # tp axis belongs to the model's own param/cache shardings
+        n = dict(self._mesh.shape).get("dp", self._mesh.size)
         device_inputs = []
         for x in inputs:
             shape = getattr(x, "shape", None)
@@ -411,9 +443,9 @@ class JaxBackend(FilterBackend):
                     self._mesh_warned = True
                     logger.warning(
                         "jax mesh backend model=%s: input batch %s not "
-                        "divisible by mesh size %d — running this call "
+                        "divisible by dp=%d — running this call "
                         "unsharded (size the upstream tensor_aggregator "
-                        "to a multiple of the mesh)",
+                        "to a multiple of the dp axis)",
                         self.props.model if self.props else "?", shape, n)
             # rank-0 scalars / non-array aux inputs have no batch axis to
             # shard: pass through (replicated by GSPMD), no warning

@@ -1,0 +1,103 @@
+"""Shard-aware LM serving entries: autoregressive generation as a
+``tensor_filter`` stage.
+
+The reference has no generative path at all (SURVEY.md §5.7); this is
+TPU-native capability beyond parity, and — paired with the jax backend's
+``custom=mesh:DxT`` 2-D mesh — it puts the tensor-parallel decoding stack
+(``models/decoding.py``) behind the PRODUCT surface: a launch line like
+
+    appsrc ! tensor_filter framework=jax
+        model=nnstreamer_tpu.models.lm_serving:tiny custom=mesh:2x4
+    ! tensor_sink
+
+serves batched greedy generation with the params sharded megatron-style
+over ``tp`` (param_pspecs), the KV cache sharded per ``cache_pspecs``,
+and the batch sharded over ``dp`` — all chips over ICI, zero topology
+plumbing in the pipeline description.
+
+Entry protocol (jax backend, backends/jax_backend.py _load_model):
+  * ``make()``             — single-device build.
+  * ``make_sharded(mesh)`` — build against the filter's device mesh; used
+    automatically when ``custom=mesh:...`` is set. On a dp-only mesh the
+    params stay replicated (jit constants) and only the batch shards; a
+    2-D ``(dp, tp)`` mesh additionally shards params + cache over ``tp``.
+
+The filter contract: input ``(B, P) int32`` prompt tokens → output
+``(B, P + steps) int32`` (prompt echoed, ``steps`` greedy continuations).
+``steps`` comes from the entry (env ``NNS_LM_STEPS`` overrides).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from .transformer import TransformerConfig
+
+
+def _steps(default: int) -> int:
+    raw = os.environ.get("NNS_LM_STEPS", str(default))
+    try:
+        steps = int(raw)
+    except ValueError:
+        raise ValueError(f"NNS_LM_STEPS={raw!r} is not an integer")
+    if steps < 1:
+        raise ValueError(f"NNS_LM_STEPS={steps} must be >= 1")
+    return steps
+
+
+@dataclass(frozen=True)
+class _LMServingEntry:
+    cfg: TransformerConfig
+    default_steps: int = 8
+    seed: int = 0
+
+    def _build(self, mesh=None):
+        import jax
+
+        from .decoding import make_generate
+        from .transformer import init_params, param_pspecs
+
+        params = init_params(self.cfg, seed=self.seed)
+        use_tp = (mesh is not None and "tp" in mesh.axis_names
+                  and mesh.shape["tp"] > 1)
+        if use_tp:
+            if self.cfg.heads % mesh.shape["tp"] != 0:
+                raise ValueError(
+                    f"lm_serving: heads={self.cfg.heads} not divisible by "
+                    f"mesh tp={mesh.shape['tp']}")
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec),
+                param_pspecs(self.cfg),
+                is_leaf=lambda x: isinstance(x, P))
+            params = jax.device_put(params, shardings)
+            gen = make_generate(self.cfg, mesh=mesh)
+        else:
+            # dp-only / single-device: params replicate as jit constants;
+            # the backend's dp batch sharding alone parallelizes the batch
+            gen = make_generate(self.cfg)
+        steps = _steps(self.default_steps)
+
+        def serve(tokens):
+            return (gen(params, tokens, steps),)
+
+        return serve
+
+    def make(self):
+        return self._build(mesh=None)
+
+    def make_sharded(self, mesh):
+        return self._build(mesh=mesh)
+
+
+# test-size entry: heads=4 supports tp in {1,2,4}; max_seq bounds P+steps
+tiny = _LMServingEntry(
+    TransformerConfig(vocab=64, dim=32, heads=4, layers=2, max_seq=64))
+
+# bench-size entry (~raises to a realistic serving shape on a real chip)
+base = _LMServingEntry(
+    TransformerConfig(vocab=32000, dim=1024, heads=16, layers=12,
+                      max_seq=2048),
+    default_steps=64)
