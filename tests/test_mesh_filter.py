@@ -137,3 +137,45 @@ def test_mesh_oversized_posts_error():
     pipe.stop()
     assert msg is not None
     assert "out of range" in str(msg.data.get("error", ""))
+
+
+def test_query_server_with_mesh_sharded_filter():
+    """Among-device + in-slice compose: a tensor_query server whose filter
+    stage is mesh-sharded serves remote clients — the reference's
+    distribution layer riding the TPU-native DP path in one launch line."""
+    import time
+
+    server = parse_launch(
+        "tensor_query_serversrc name=ssrc id=40 port=0 "
+        "caps=other/tensors,format=static,dimensions=16:8,types=float32 "
+        "! tensor_filter framework=jax model=builtin://scaler?factor=2 "
+        "custom=mesh:dp=8 name=f "
+        "! tensor_query_serversink id=40")
+    server.play()
+    ssrc = server.get("ssrc")
+    deadline = time.monotonic() + 5
+    while ssrc.bound_port == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ssrc.bound_port != 0
+    try:
+        client = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=16:8,types=float32 "
+            f"! tensor_query_client host=127.0.0.1 port={ssrc.bound_port} "
+            "! tensor_sink name=out max-stored=4")
+        got = []
+        client.get("out").connect(lambda b: got.append(np.asarray(b.tensors[0])))
+        client.play()
+        x = np.arange(128, dtype=np.float32).reshape(8, 16)
+        for _ in range(2):
+            client.get("in").push_buffer(x)
+        deadline = time.monotonic() + 30
+        while len(got) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        client.stop()
+        assert len(got) == 2
+        for g in got:
+            np.testing.assert_allclose(g, x * 2)
+        assert server.get("f").backend_mesh.size == 8
+    finally:
+        server.stop()
