@@ -109,6 +109,23 @@ def count_params(params: Any) -> int:
                if hasattr(x, "size"))
 
 
+def bench_mesh_policy(n_devices: int, on_cpu: bool, batch: int):
+    """Shared bench policy for multi-chip windows (bench.py and
+    tools/bench_suite.py must measure the SAME configuration): mesh the
+    model stage over every chip unless BENCH_NO_MESH, with
+    BENCH_FORCE_MESH enabling the path on the CPU virtual mesh for
+    validation. Returns ``(mesh_custom, batch)`` — batch rounded UP to a
+    multiple of the dp axis, because an indivisible batch silently falls
+    back to unsharded invoke and the reported MFU/devices would claim
+    chips that did no work."""
+    if n_devices <= 1 or os.environ.get("BENCH_NO_MESH") \
+            or (on_cpu and not os.environ.get("BENCH_FORCE_MESH")):
+        return "", batch
+    if batch % n_devices:
+        batch = ((batch + n_devices - 1) // n_devices) * n_devices
+    return "mesh:auto", batch
+
+
 def perf_record(flops_per_item: Optional[float], items_per_second: float,
                 n_chips: int = 1, device=None) -> dict:
     """The JSON fields every bench row carries: model_tflops_per_s + mfu

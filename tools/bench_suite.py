@@ -124,9 +124,13 @@ def _pipeline_p50(model: str, in_size: int, dec: str, dtype: str = "float32",
     return sorted(lats)[len(lats) // 2] * 1e3
 
 
-def _model_perf(model_entry, example_shape, example_dtype, fps: float,
-                batch: int) -> dict:
-    """model FLOP/s + MFU fields for a suite row (null-safe)."""
+def _model_perf(model_entry, frame_shape, example_dtype, fps: float,
+                n_chips: int = 1) -> dict:
+    """model FLOP/s + MFU fields for a suite row (null-safe). FLOPs come
+    from a batch=1 lower (``frame_shape`` has leading dim 1): per-frame
+    work is linear in batch for these models and the small compile avoids
+    building a second large (possibly GSPMD-sharded) graph just for
+    accounting."""
     import numpy as np
 
     import jax
@@ -134,8 +138,8 @@ def _model_perf(model_entry, example_shape, example_dtype, fps: float,
     from nnstreamer_tpu.utils.flops import compiled_flops, perf_record
 
     flops = compiled_flops(model_entry.make(),
-                           np.zeros(example_shape, example_dtype))
-    return perf_record(flops / batch if flops else None, fps,
+                           np.zeros(frame_shape, example_dtype))
+    return perf_record(flops, fps, n_chips=n_chips,
                        device=jax.devices()[0])
 
 
@@ -277,6 +281,14 @@ def main() -> None:
     frames = int(os.environ.get("BENCHS_FRAMES", "64" if on_cpu else "2048"))
     deadline = float(os.environ.get("BENCHS_DEADLINE", "240"))
     warmup_batches = 2
+    # multi-chip window: mesh the batched model stages over every chip
+    # (ONE policy shared with bench.py — utils/flops.bench_mesh_policy)
+    from nnstreamer_tpu.utils.flops import bench_mesh_policy
+
+    n_dev = len(jax.devices())
+    mesh_custom, batch = bench_mesh_policy(n_dev, on_cpu, batch)
+    if mesh_custom:
+        _log(f"mesh mode: dp over {n_dev} chips (batch={batch})")
 
     from nnstreamer_tpu.runtime.parse import parse_launch
 
@@ -304,7 +316,8 @@ def main() -> None:
             "! queue max-size-buffers=4 "
             "! tensor_filter framework=jax "
             "model=nnstreamer_tpu.models.mobilenet_v2:filter_model_u8 "
-            "sync-invoke=false "
+            + (f"custom={mesh_custom} " if mesh_custom else "")
+            + "sync-invoke=false "
             f"! tensor_decoder mode=image_labeling option1={labels} "
             "! tensor_sink name=out max-stored=1")
         fps_b, n = _run_fps(pipe, "out", frames // batch, warmup_batches, deadline)
@@ -315,8 +328,12 @@ def main() -> None:
         try:
             from nnstreamer_tpu.models import mobilenet_v2 as _mnv2
 
-            extra = _model_perf(_mnv2.filter_model_u8, (batch, 224, 224, 3),
-                                "uint8", fps1, batch)
+            extra = _model_perf(_mnv2.filter_model_u8, (1, 224, 224, 3),
+                                "uint8", fps1,
+                                n_chips=n_dev if mesh_custom else 1)
+            if mesh_custom:
+                extra["mesh"] = mesh_custom
+                extra["devices"] = n_dev
             _log(f"{name}: p50 pipeline latency (batch=1) ...")
             extra["p50_pipeline_ms"] = round(_pipeline_p50(
                 "nnstreamer_tpu.models.mobilenet_v2:filter_model_u8", 224,
@@ -362,8 +379,13 @@ def main() -> None:
     for name, in_size, model, dec in per_frame:
         _log(f"{name}: size={in_size} frames={pf_frames} model_batch={pf_batch}")
         try:
+            # mesh the batched model stage only when the batch divides the
+            # dp axis (same rule as config 1; the decoder stays per-frame)
+            pf_mesh = mesh_custom if (mesh_custom
+                                      and pf_batch % n_dev == 0) else ""
             stage = (f"tensor_filter framework=jax model={model} "
-                     "sync-invoke=false")
+                     + (f"custom={pf_mesh} " if pf_mesh else "")
+                     + "sync-invoke=false")
             if pf_batch > 1:
                 stage = (
                     f"tensor_aggregator frames-out={pf_batch} frames-dim=0 "
@@ -385,8 +407,12 @@ def main() -> None:
 
                 mod_name, attr = model.split(":")
                 entry = getattr(importlib.import_module(mod_name), attr)
-                extra = _model_perf(entry, (pf_batch, in_size, in_size, 3),
-                                    "float32", fps, pf_batch)
+                extra = _model_perf(entry, (1, in_size, in_size, 3),
+                                    "float32", fps,
+                                    n_chips=n_dev if pf_mesh else 1)
+                if pf_mesh:
+                    extra["mesh"] = pf_mesh
+                    extra["devices"] = n_dev
                 _log(f"{name}: p50 pipeline latency (batch=1) ...")
                 extra["p50_pipeline_ms"] = round(
                     _pipeline_p50(model, in_size, dec), 2)
