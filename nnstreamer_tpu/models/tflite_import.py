@@ -34,6 +34,7 @@ SHAPE, TRANSPOSE, BROADCAST_TO.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -305,6 +306,9 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
     emit float32. ``options['precision']`` = highest (default; exact
     fake-quant parity) | default (bf16 MXU passes — faster on TPU, top-1
     usually stable but byte-exactness is not guaranteed).
+    ``options['batch']`` = N → relabel the recorded batch-1 contract to N
+    (graph must be batch-polymorphic — validated at load), so aggregated
+    batches flow into the MXU instead of per-frame dispatch.
     """
     import jax
     import jax.numpy as jnp
@@ -462,12 +466,15 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
                     shape = list(cfg["new_shape"])
                 else:
                     shape = [int(v) for v in np.asarray(_const(ins[1])).reshape(-1)]
-                # batch-polymorphism: rewrite a recorded batch-1 leading dim
-                # to the runtime batch ONLY when the recorded shape cannot
-                # hold the actual element count
-                if (shape and shape[0] == 1 and x.shape[0] != 1
-                        and -1 not in shape
-                        and int(np.prod(shape)) != int(np.prod(x.shape))):
+                # batch-polymorphism: rewrite a recorded batch-1 leading
+                # dim to the runtime batch when (a) the recorded shape
+                # cannot hold the actual element count, or (b) the shape
+                # carries a -1 ([1, -1]-style flatten heads: folding the
+                # batch into the -1 axis would interleave frames — the -1
+                # must absorb per-frame elements only)
+                if shape and shape[0] == 1 and x.shape[0] != 1 and (
+                        -1 in shape
+                        or int(np.prod(shape)) != int(np.prod(x.shape))):
                     shape[0] = int(x.shape[0])
                 env[outs[0]] = x.reshape(shape)
             elif code == "SOFTMAX":
@@ -702,4 +709,44 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
 
     in_info = TensorsInfo.of(*(_spec(i, False) for i in in_idx))
     out_info = TensorsInfo.of(*(_spec(i, float_output) for i in out_idx))
+
+    # options['batch'] = N: relabel the recorded batch-1 leading dims to N
+    # (the emitted graph is batch-polymorphic — convs/pools/matmuls carry
+    # the leading dim through, RESHAPE rewrites recorded batch-1 dims) and
+    # re-derive out_info via eval_shape so the filter's stream validation
+    # accepts aggregated batches. The MXU wants batches; a recorded-shape
+    # batch=1 contract would force per-frame dispatch (reference tflite
+    # interpreter behavior, tensor_filter_tensorflow_lite.cc resize path).
+    batch_opt = options.get("batch")
+    if batch_opt:
+        try:
+            b = int(batch_opt)
+        except ValueError:
+            raise ValueError(f"tflite option batch:{batch_opt!r} is not an "
+                             "integer")
+        if b < 1:
+            raise ValueError(f"tflite option batch:{b} must be >= 1")
+
+        def _rebatch(info):
+            return TensorsInfo.of(*(
+                TensorSpec((b,) + tuple(s.shape[1:]), s.dtype)
+                for s in info.specs))
+
+        in_info = _rebatch(in_info)
+        shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype.np_dtype)
+                  for s in in_info.specs]
+        out_shapes = jax.eval_shape(fn, *shapes)
+        # a graph that is NOT batch-polymorphic (e.g. a reshape that
+        # hard-flattens everything) must fail AT LOAD with the cause, not
+        # stream interleaved frames downstream
+        for o in out_shapes:
+            if not o.shape or o.shape[0] != b:
+                raise ValueError(
+                    f"tflite option batch:{b}: {os.path.basename(path)} is "
+                    f"not batch-polymorphic (an output has shape {o.shape}, "
+                    f"leading dim != {b}); remove the batch option and run "
+                    "per-frame")
+        out_info = TensorsInfo.of(*(
+            TensorSpec(tuple(o.shape), DataType.from_any(o.dtype))
+            for o in out_shapes))
     return fn, in_info, out_info

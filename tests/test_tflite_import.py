@@ -354,3 +354,38 @@ class TestReferenceZooSweep:
                 if g.ndim == 2:  # classification head: same winner
                     np.testing.assert_array_equal(
                         g.argmax(-1), w.argmax(-1))
+
+
+class TestBatchOption:
+    """options['batch']=N relabels the recorded batch-1 contract so
+    aggregated batches flow (the MXU wants batches; the reference
+    interpreter resizes per-frame instead). Batched output must equal the
+    per-frame outputs stacked."""
+
+    def test_batched_equals_stacked_per_frame(self):
+        import jax
+
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = f"{REF_MODELS}/mobilenet_v2_1.0_224_quant.tflite"
+        fn1, in1, out1 = load_tflite(path)
+        fn4, in4, out4 = load_tflite(path, {"batch": "4"})
+        assert in4.specs[0].shape == (4, 224, 224, 3)
+        assert out4.specs[0].shape == (4, 1001)
+        rng = np.random.default_rng(5)
+        imgs = rng.integers(0, 256, (4, 224, 224, 3)).astype(np.uint8)
+        batched = np.asarray(jax.jit(fn4)(imgs)[0])
+        singles = np.concatenate(
+            [np.asarray(jax.jit(fn1)(imgs[i:i + 1])[0]) for i in range(4)])
+        # same graph, same math — only the leading dim differs; quantized
+        # rounding at a half-ulp boundary may flip one byte
+        assert np.abs(batched.astype(int) - singles.astype(int)).max() <= 1
+
+    def test_bad_batch_option(self):
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = f"{REF_MODELS}/mobilenet_v2_1.0_224_quant.tflite"
+        with pytest.raises(ValueError, match="batch"):
+            load_tflite(path, {"batch": "x"})
+        with pytest.raises(ValueError, match="batch"):
+            load_tflite(path, {"batch": "0"})

@@ -137,8 +137,8 @@ def _model_perf(model_entry, frame_shape, example_dtype, fps: float,
 
     from nnstreamer_tpu.utils.flops import compiled_flops, perf_record
 
-    flops = compiled_flops(model_entry.make(),
-                           np.zeros(frame_shape, example_dtype))
+    fn = model_entry.make() if hasattr(model_entry, "make") else model_entry
+    flops = compiled_flops(fn, np.zeros(frame_shape, example_dtype))
     return perf_record(flops, fps, n_chips=n_chips,
                        device=jax.devices()[0])
 
@@ -422,6 +422,46 @@ def main() -> None:
         except Exception as e:
             _log(f"{name} FAILED: {e}")
             record(name, 0.0, 0, pf_batch)
+
+    # -- 4b. the reference's REAL quantized zoo model on XLA ----------------
+    # mobilenet_v2_1.0_224_quant.tflite through the flatbuffer importer
+    # (models/tflite_import.py): uint8 in, fake-quant-simulated graph,
+    # uint8-requantized out — the reference's flagship edge config running
+    # as a jitted XLA program (interpreter match pinned by
+    # test_tflite_import). Skipped when the reference tree is absent.
+    ref_quant = ("/root/reference/tests/test_models/models/"
+                 "mobilenet_v2_1.0_224_quant.tflite")
+    if os.path.exists(ref_quant):
+        name = "mobilenet_v2_quant_tflite_on_xla"
+        _log(f"{name}: batch={batch} frames={frames}")
+        try:
+            q_custom = ",".join(
+                p for p in (f"batch:{batch}", mesh_custom) if p)
+            pipe = parse_launch(
+                f"tensor_src num-buffers={frames} dimensions=3:224:224:1 "
+                "types=uint8 pattern=random "
+                f"! tensor_aggregator frames-out={batch} frames-dim=0 "
+                "concat=true "
+                "! queue max-size-buffers=4 "
+                f"! tensor_filter framework=jax model={ref_quant} "
+                f"custom={q_custom} sync-invoke=false "
+                "! tensor_sink name=out max-stored=1")
+            fps_b, n = _run_fps(pipe, "out", frames // batch,
+                                warmup_batches, deadline)
+            extra = {}
+            try:
+                from nnstreamer_tpu.models.tflite_import import load_tflite
+
+                q_fn, _, _ = load_tflite(ref_quant, {})
+                extra = _model_perf(q_fn, (1, 224, 224, 3), "uint8",
+                                    fps_b * batch,
+                                    n_chips=n_dev if mesh_custom else 1)
+            except Exception as e:  # noqa: BLE001
+                _log(f"{name} aux (mfu) failed: {e}")
+            record(name, fps_b * batch, n * batch, batch, extra)
+        except Exception as e:
+            _log(f"{name} FAILED: {e}")
+            record(name, 0.0, 0, batch)
 
     # -- 5. among-device: sharded stream over 2 loopback query workers ------
     name = "tensor_query_sharded_x2"
