@@ -316,6 +316,9 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
 
     options = options or {}
     float_output = str(options.get("float_output", "")).lower() in ("1", "true", "yes")
+    # read early: gates the RESHAPE batch-1 rewrite widening below — a
+    # [1,-1] rewrite is only safe when the caller DECLARED a runtime batch
+    batch_mode = bool(options.get("batch"))
 
     with open(path, "rb") as fh:
         data = fh.read()
@@ -468,13 +471,16 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
                     shape = [int(v) for v in np.asarray(_const(ins[1])).reshape(-1)]
                 # batch-polymorphism: rewrite a recorded batch-1 leading
                 # dim to the runtime batch when (a) the recorded shape
-                # cannot hold the actual element count, or (b) the shape
-                # carries a -1 ([1, -1]-style flatten heads: folding the
-                # batch into the -1 axis would interleave frames — the -1
-                # must absorb per-frame elements only)
+                # cannot hold the actual element count, or (b) under a
+                # DECLARED batch option, the shape carries a -1
+                # ([1, -1]-style flatten heads: folding the batch into the
+                # -1 axis would interleave frames). Without the batch
+                # option a [1,-1] reshape of a leading-dim>1 tensor stays
+                # a genuine flatten-all, matching the interpreter.
                 if shape and shape[0] == 1 and x.shape[0] != 1 and (
-                        -1 in shape
-                        or int(np.prod(shape)) != int(np.prod(x.shape))):
+                        (batch_mode and -1 in shape)
+                        or (-1 not in shape
+                            and int(np.prod(shape)) != int(np.prod(x.shape)))):
                     shape[0] = int(x.shape[0])
                 env[outs[0]] = x.reshape(shape)
             elif code == "SOFTMAX":
@@ -735,7 +741,13 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
         in_info = _rebatch(in_info)
         shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype.np_dtype)
                   for s in in_info.specs]
-        out_shapes = jax.eval_shape(fn, *shapes)
+        try:
+            out_shapes = jax.eval_shape(fn, *shapes)
+        except Exception as e:
+            raise ValueError(
+                f"tflite option batch:{b}: {os.path.basename(path)} is not "
+                f"batch-polymorphic (shape tracing failed: {e}); remove "
+                "the batch option and run per-frame") from e
         # a graph that is NOT batch-polymorphic (e.g. a reshape that
         # hard-flattens everything) must fail AT LOAD with the cause, not
         # stream interleaved frames downstream

@@ -458,6 +458,9 @@ def main() -> None:
                                     n_chips=n_dev if mesh_custom else 1)
             except Exception as e:  # noqa: BLE001
                 _log(f"{name} aux (mfu) failed: {e}")
+            if mesh_custom:
+                extra["mesh"] = mesh_custom
+                extra["devices"] = n_dev
             record(name, fps_b * batch, n * batch, batch, extra)
         except Exception as e:
             _log(f"{name} FAILED: {e}")

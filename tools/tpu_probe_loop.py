@@ -183,19 +183,37 @@ def _evidence_missing() -> bool:
                for _, name, _ in EVIDENCE)
 
 
+TCP_POLL = float(os.environ.get("PROBE_TCP_POLL", "30"))
+
+
 def main() -> None:
+    relay0 = tcp_probe()
     _log_line({"event": "watcher_start", "round": ROUND,
                "interval_s": PROBE_INTERVAL, "probe_timeout_s": PROBE_TIMEOUT,
-               "relay": tcp_probe()})
+               "tcp_poll_s": TCP_POLL, "relay": relay0})
     first = True
+    last_state = relay0["state"]  # seeded: first poll logs only real change
     while True:
         plat = probe_once(first)
         first = False
         if plat and _evidence_missing():
             capture_evidence(plat)
-        # with all artifacts captured keep probing (cheap) so the log
-        # shows tunnel uptime, but don't re-burn bench time
-        time.sleep(PROBE_INTERVAL)
+        # between full probes, poll the relay endpoint cheaply (~1 ms
+        # every TCP_POLL s): a tunnel window SHORTER than PROBE_INTERVAL
+        # would otherwise be missed entirely. A refused→open transition
+        # breaks out to an immediate full probe; every transition is
+        # logged so the round's record shows relay uptime.
+        next_full = time.monotonic() + PROBE_INTERVAL
+        while time.monotonic() < next_full:
+            time.sleep(min(TCP_POLL, max(0.0, next_full - time.monotonic())))
+            rec = tcp_probe()
+            transitioned = rec["state"] != last_state
+            was, last_state = last_state, rec["state"]
+            if transitioned:
+                _log_line({"event": "relay_transition", "relay": rec,
+                           "was": was})
+                if rec["state"] == "open":
+                    break  # live window — full probe NOW
 
 
 if __name__ == "__main__":
