@@ -26,7 +26,6 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 
 import numpy as np  # noqa: E402
 
-from nnstreamer_tpu.elements.src import AppSrc  # noqa: E402,F401 registered
 from nnstreamer_tpu.runtime.parse import parse_launch  # noqa: E402
 
 

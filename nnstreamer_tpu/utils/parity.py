@@ -60,7 +60,6 @@ def labels_through(framework: str, model: str, frames: Sequence,
                    timeout: float = 120.0) -> List[int]:
     """Push ``frames`` through the canonical parity pipeline on
     ``framework`` and return the decoded label indices, in order."""
-    from nnstreamer_tpu.elements.src import AppSrc  # noqa: F401 registered
     from nnstreamer_tpu.runtime.parse import parse_launch
 
     pipe = parse_launch(

@@ -12,8 +12,6 @@ from nnstreamer_tpu.runtime.parse import parse_launch
 
 
 def _serve(custom: str, prompts):
-    from nnstreamer_tpu.elements.src import AppSrc  # noqa: F401 registered
-
     B, P = prompts[0].shape
     pipe = parse_launch(
         "appsrc name=in caps=other/tensors,format=static,"
@@ -22,8 +20,6 @@ def _serve(custom: str, prompts):
         f"model=nnstreamer_tpu.models.lm_serving:tiny custom={custom} "
         "name=f "
         f"! tensor_sink name=out max-stored={len(prompts)}")
-    got = []
-    pipe.get("out").connect(lambda b: got.append(np.asarray(b.tensors[0])))
     raw = []
     pipe.get("out").connect(lambda b: raw.append(b.tensors[0]))
     pipe.play()
@@ -34,7 +30,7 @@ def _serve(custom: str, prompts):
     pipe.wait(timeout=120)
     mesh = pipe.get("f").backend_mesh
     pipe.stop()
-    return got, raw, mesh
+    return [np.asarray(t) for t in raw], raw, mesh
 
 
 @pytest.fixture(scope="module")
@@ -81,7 +77,6 @@ def test_dp_only_mesh_serves_with_replicated_params(prompts):
 
 def test_heads_not_divisible_by_tp_posts_error():
     from nnstreamer_tpu.core import MessageType
-    from nnstreamer_tpu.elements.src import AppSrc  # noqa: F401
 
     pipe = parse_launch(
         "appsrc name=in caps=other/tensors,format=static,"

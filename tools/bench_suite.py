@@ -89,7 +89,6 @@ def _pipeline_p50(model: str, in_size: int, dec: str, dtype: str = "float32",
 
     import numpy as np
 
-    from nnstreamer_tpu.elements.src import AppSrc  # noqa: F401 registered
     from nnstreamer_tpu.runtime.parse import parse_launch
 
     pipe = parse_launch(
@@ -141,6 +140,11 @@ def _model_perf(model_entry, frame_shape, example_dtype, fps: float,
     flops = compiled_flops(fn, np.zeros(frame_shape, example_dtype))
     return perf_record(flops, fps, n_chips=n_chips,
                        device=jax.devices()[0])
+
+
+def _mesh_fields(mesh_custom: str, n_dev: int) -> dict:
+    """Row fields marking a dp-sharded measurement (empty when unmeshed)."""
+    return ({"mesh": mesh_custom, "devices": n_dev} if mesh_custom else {})
 
 
 def _bench_lm_decode(platform: str, on_cpu: bool,
@@ -331,9 +335,7 @@ def main() -> None:
             extra = _model_perf(_mnv2.filter_model_u8, (1, 224, 224, 3),
                                 "uint8", fps1,
                                 n_chips=n_dev if mesh_custom else 1)
-            if mesh_custom:
-                extra["mesh"] = mesh_custom
-                extra["devices"] = n_dev
+            extra.update(_mesh_fields(mesh_custom, n_dev))
             _log(f"{name}: p50 pipeline latency (batch=1) ...")
             extra["p50_pipeline_ms"] = round(_pipeline_p50(
                 "nnstreamer_tpu.models.mobilenet_v2:filter_model_u8", 224,
@@ -410,9 +412,7 @@ def main() -> None:
                 extra = _model_perf(entry, (1, in_size, in_size, 3),
                                     "float32", fps,
                                     n_chips=n_dev if pf_mesh else 1)
-                if pf_mesh:
-                    extra["mesh"] = pf_mesh
-                    extra["devices"] = n_dev
+                extra.update(_mesh_fields(pf_mesh, n_dev))
                 _log(f"{name}: p50 pipeline latency (batch=1) ...")
                 extra["p50_pipeline_ms"] = round(
                     _pipeline_p50(model, in_size, dec), 2)
@@ -458,9 +458,7 @@ def main() -> None:
                                     n_chips=n_dev if mesh_custom else 1)
             except Exception as e:  # noqa: BLE001
                 _log(f"{name} aux (mfu) failed: {e}")
-            if mesh_custom:
-                extra["mesh"] = mesh_custom
-                extra["devices"] = n_dev
+            extra.update(_mesh_fields(mesh_custom, n_dev))
             record(name, fps_b * batch, n * batch, batch, extra)
         except Exception as e:
             _log(f"{name} FAILED: {e}")
