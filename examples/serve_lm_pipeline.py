@@ -59,6 +59,26 @@ def main() -> None:
               f"(sharded over {len(t.sharding.device_set)} chips)")
     pipe.stop()
 
+    # the STREAMING form: tensor_generate emits one buffer per decoded
+    # token (same entry, same greedy math — token-exact with the above)
+    spipe = parse_launch(
+        "appsrc name=in caps=other/tensors,format=static,"
+        f"dimensions={P}:{B},types=int32 "
+        "! tensor_generate model=nnstreamer_tpu.models.lm_serving:tiny "
+        "steps=8 mesh=2x4 "
+        "! tensor_sink name=out max-stored=16")
+    spipe.get("out").connect(
+        lambda b: print(f"  token {b.meta['gen_step']}: "
+                        f"{np.asarray(b.tensors[0])[:, 0].tolist()}"
+                        + ("  <last>" if b.meta["gen_last"] else "")))
+    spipe.play()
+    print("streaming generation (one line per token as it decodes):")
+    spipe.get("in").push_buffer(
+        np.random.default_rng(0).integers(0, 64, (B, P)).astype(np.int32))
+    spipe.get("in").end_of_stream()
+    spipe.wait(timeout=120)
+    spipe.stop()
+
 
 if __name__ == "__main__":
     main()

@@ -90,6 +90,47 @@ def _as_tuple(out) -> tuple:
     return (out,)
 
 
+def parse_mesh_spec(spec: str, devices):
+    """Parse a ``mesh:`` spec string into a `jax.sharding.Mesh` over
+    ``devices`` — shared by the filter backend (``custom=mesh:...``) and
+    the streaming generator element (``tensor_generate mesh=...``).
+
+    Accepted: ``dp=<N>`` | ``auto``/``all`` (dp over every device) |
+    ``<D>x<T>`` (2-D dp×tp). Raises ValueError with an actionable message
+    on anything else or when the device count is insufficient.
+    """
+    from jax.sharding import Mesh
+
+    spec = spec.strip().lower()
+    n: Optional[int] = None
+    tp = 1
+    if spec in ("auto", "all", "dp=all", "dp=auto"):
+        n = len(devices)
+    elif spec.startswith("dp="):
+        try:
+            n = int(spec[3:])
+        except ValueError:
+            pass
+    elif "x" in spec:  # mesh:DxT — 2-D dp×tp for shard-aware entries
+        try:
+            d_s, t_s = spec.split("x", 1)
+            n, tp = int(d_s), int(t_s)
+        except ValueError:
+            n = None
+    if n is None or tp < 1:
+        raise ValueError(
+            f"mesh spec {spec!r} — expected 'dp=<N>', 'auto', or "
+            "'<D>x<T>' (dp×tp)")
+    total = n * tp
+    if not 1 <= total <= len(devices):
+        raise ValueError(
+            f"mesh spec {spec} needs {total} devices, out of range "
+            f"(1..{len(devices)} local devices)")
+    if tp == 1:
+        return Mesh(np.asarray(devices[:total]), ("dp",))
+    return Mesh(np.asarray(devices[:total]).reshape(n, tp), ("dp", "tp"))
+
+
 @register_backend
 class JaxBackend(FilterBackend):
     NAME = "jax"
@@ -218,7 +259,7 @@ class JaxBackend(FilterBackend):
         while the backend still batch-shards inputs over ``dp``.
         """
         import jax
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        from jax.sharding import NamedSharding, PartitionSpec
 
         devices = jax.devices()
         # honor an explicit accelerator/platform request the same way
@@ -236,36 +277,10 @@ class JaxBackend(FilterBackend):
                     f"devices present (have "
                     f"{sorted({d.platform for d in devices})})")
             devices = matching
-        spec = spec.strip().lower()
-        n: Optional[int] = None
-        tp = 1
-        if spec in ("auto", "all", "dp=all", "dp=auto"):
-            n = len(devices)
-        elif spec.startswith("dp="):
-            try:
-                n = int(spec[3:])
-            except ValueError:
-                pass
-        elif "x" in spec:  # mesh:DxT — 2-D dp×tp for shard-aware entries
-            try:
-                d_s, t_s = spec.split("x", 1)
-                n, tp = int(d_s), int(t_s)
-            except ValueError:
-                n = None
-        if n is None or tp < 1:
-            raise ValueError(
-                f"custom=mesh:{spec!r} — expected 'mesh:dp=<N>', "
-                "'mesh:auto', or 'mesh:<D>x<T>' (dp×tp)")
-        total = n * tp
-        if not 1 <= total <= len(devices):
-            raise ValueError(
-                f"custom=mesh:{spec} needs {total} devices, out of range "
-                f"(1..{len(devices)} local devices)")
-        if tp == 1:
-            self._mesh = Mesh(np.asarray(devices[:total]), ("dp",))
-        else:
-            self._mesh = Mesh(
-                np.asarray(devices[:total]).reshape(n, tp), ("dp", "tp"))
+        try:
+            self._mesh = parse_mesh_spec(spec, devices)
+        except ValueError as e:
+            raise ValueError(f"custom=mesh:{e}") from None
         # batch axis (dim 0, the one the aggregator builds) shards over
         # dp; trailing axes replicate. On a 2-D mesh the tp axis belongs
         # to the model's own param/cache shardings, never the batch.

@@ -1,0 +1,117 @@
+"""tensor_generate: streaming autoregressive generation as a pipeline
+stage (L3, beyond reference).
+
+The reference has no generative path (SURVEY.md §5.7); this element is
+the STREAMING face of the LM serving stack. ``tensor_filter`` +
+``models/lm_serving`` emits one buffer per prompt holding the whole
+generated sequence (one jitted ``lax.scan`` — maximum throughput);
+``tensor_generate`` instead prefases the prompt once, then emits ONE
+BUFFER PER DECODED TOKEN downstream — each token leaves the device as it
+is picked, so sinks/decoders/query-clients observe generation
+incrementally, the way a text UI or SSE endpoint consumes an LLM. That
+is the natural fit for this framework's dataflow model: tokens are just
+a tensor stream.
+
+    appsrc (B,P) int32 ! tensor_generate
+        model=nnstreamer_tpu.models.lm_serving:tiny steps=16 mesh=2x4
+    ! tensor_sink     # receives `steps` buffers of (B, 1) int32 per prompt
+
+Properties: ``model`` (module:attr of an entry exposing
+``make_streaming(mesh)``), ``steps`` (tokens per prompt), ``mesh``
+(same spec grammar as tensor_filter's ``custom=mesh:...`` —
+``dp=N``/``auto``/``DxT``; empty = single device). Output buffers carry
+``meta["gen_step"]`` (0-based) and ``meta["gen_last"]`` so downstream
+can frame sequence boundaries.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+import numpy as np
+
+from ..core import (
+    Buffer,
+    Caps,
+    TensorFormat,
+    TensorsInfo,
+    caps_from_tensors_info,
+)
+from ..registry.elements import register_element
+from ..runtime.element import Element, ElementError, Prop
+from ..runtime.pad import Pad, PadDirection, PadTemplate
+
+
+@register_element
+class TensorGenerate(Element):
+    ELEMENT_NAME = "tensor_generate"
+    SINK_TEMPLATES = (
+        PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),
+    )
+    SRC_TEMPLATES = (
+        PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),
+    )
+    PROPERTIES = dict(Element.PROPERTIES)
+    PROPERTIES.update({
+        "model": Prop("", str,
+                      "module:attr of an entry with make_streaming(mesh)"),
+        "steps": Prop(16, int, "tokens generated per prompt buffer"),
+        "mesh": Prop("", str,
+                     "device mesh spec (dp=N | auto | DxT); empty = single"),
+    })
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._stream = None
+
+    def _ensure_stream(self):
+        """Lazy build on the first buffer (tensor_filter's open pattern):
+        load failures surface as bus ERRORs from the streaming thread,
+        and a never-played element never pays params init."""
+        if self._stream is not None:
+            return self._stream
+        model = self.props["model"]
+        if not model or ":" not in model:
+            raise ElementError(
+                f"{self.name}: model must be a module:attr entry with "
+                f"make_streaming(mesh), got {model!r}")
+        mod_name, _, attr = model.partition(":")
+        entry = getattr(importlib.import_module(mod_name), attr)
+        maker = getattr(entry, "make_streaming", None)
+        if maker is None:
+            raise ElementError(
+                f"{self.name}: {model} has no make_streaming(mesh) — "
+                "use tensor_filter for whole-sequence entries")
+        mesh = None
+        spec = self.props["mesh"]
+        if spec:
+            import jax
+
+            from ..backends.jax_backend import parse_mesh_spec
+
+            mesh = parse_mesh_spec(spec, jax.devices())
+        self._mesh = mesh
+        self._stream = maker(mesh)
+        return self._stream
+
+    def stop(self) -> None:
+        self._stream = None
+
+    def transform_caps(self, src_pad: Pad) -> Caps:
+        # (B, 1) per token, B known only per-buffer: flexible stream
+        return caps_from_tensors_info(TensorsInfo((), TensorFormat.FLEXIBLE))
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        stream = self._ensure_stream()
+        prompt = np.asarray(buf.as_numpy().tensors[0])
+        if prompt.ndim != 2:
+            raise ElementError(
+                f"{self.name}: prompt must be (batch, prompt_len) int32, "
+                f"got shape {prompt.shape}")
+        steps = int(self.props["steps"])
+        for i, token in enumerate(stream(prompt.astype(np.int32), steps)):
+            out = Buffer([np.asarray(token).reshape(-1, 1)])
+            out.copy_metadata_from(buf)
+            out.meta["gen_step"] = i
+            out.meta["gen_last"] = i == steps - 1
+            self.push(out)

@@ -52,10 +52,14 @@ class _LMServingEntry:
     default_steps: int = 8
     seed: int = 0
 
-    def _build(self, mesh=None):
+    def _shard_params(self, mesh):
+        """Init params and, when ``mesh`` carries a real tp axis, place
+        them per the megatron PartitionSpecs. Returns ``(params,
+        use_tp)`` — the one definition both the whole-sequence and
+        streaming builds rely on (divergence here would break their
+        token-exactness)."""
         import jax
 
-        from .decoding import make_generate
         from .transformer import init_params, param_pspecs
 
         params = init_params(self.cfg, seed=self.seed)
@@ -73,11 +77,15 @@ class _LMServingEntry:
                 param_pspecs(self.cfg),
                 is_leaf=lambda x: isinstance(x, P))
             params = jax.device_put(params, shardings)
-            gen = make_generate(self.cfg, mesh=mesh)
-        else:
-            # dp-only / single-device: params replicate as jit constants;
-            # the backend's dp batch sharding alone parallelizes the batch
-            gen = make_generate(self.cfg)
+        return params, use_tp
+
+    def _build(self, mesh=None):
+        from .decoding import make_generate
+
+        params, use_tp = self._shard_params(mesh)
+        # dp-only / single-device: params replicate as jit constants; the
+        # backend's dp batch sharding alone parallelizes the batch
+        gen = make_generate(self.cfg, mesh=mesh if use_tp else None)
         steps = _steps(self.default_steps)
 
         def serve(tokens):
@@ -90,6 +98,84 @@ class _LMServingEntry:
 
     def make_sharded(self, mesh):
         return self._build(mesh=mesh)
+
+    def make_streaming(self, mesh=None):
+        """Per-token generation for the ``tensor_generate`` element:
+        returns ``stream(tokens (B, P), steps) -> yields (B,) int32`` —
+        prefill once, then one jitted ``decode_step`` per yielded token.
+        A host loop (not ``lax.scan``) is the point: each token leaves
+        the device as it is picked, so downstream elements render/forward
+        incrementally instead of waiting out the whole scan."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from .decoding import cache_pspecs, decode_step, init_cache, prefill
+
+        cfg = self.cfg
+        params, use_tp = self._shard_params(mesh)
+        step_mesh = mesh if use_tp else None
+
+        # the cache is the dominant HBM consumer: pin it to its specs
+        # restricted to the axes THIS mesh actually has (dp-only meshes
+        # batch-shard it; (dp, tp) meshes also head-shard it) — GSPMD
+        # propagation alone could leave it replicated
+        constrain = lambda c: c  # noqa: E731
+        batch_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            axes = set(mesh.axis_names)
+
+            def _restrict(spec):
+                return P(*(a if a in axes else None for a in spec))
+
+            cache_sh = [
+                {k: NamedSharding(mesh, _restrict(s)) for k, s in layer.items()}
+                for layer in cache_pspecs(cfg)]
+
+            def constrain(cache):  # noqa: F811
+                return jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, cache, cache_sh)
+
+            if "dp" in axes:
+                batch_sharding = NamedSharding(mesh, P("dp"))
+
+        @jax.jit
+        def _prefill(params, tokens):
+            cache = constrain(init_cache(cfg, tokens.shape[0]))
+            logits, cache, pos = prefill(cfg, params, tokens, cache,
+                                         step_mesh)
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32), pos,
+                    constrain(cache))
+
+        # donate the cache: each step writes one position in place —
+        # without donation every token holds two full caches in HBM
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def _step(params, token, pos, cache):
+            logits, cache = decode_step(cfg, params, token, pos, cache,
+                                        step_mesh)
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                    pos + 1, constrain(cache))
+
+        def stream(tokens, steps):
+            if steps < 1:
+                raise ValueError(f"steps={steps} must be >= 1")
+            if tokens.shape[1] + steps > cfg.max_seq:
+                raise ValueError(
+                    f"prompt ({tokens.shape[1]}) + steps ({steps}) "
+                    f"exceeds max_seq {cfg.max_seq}")
+            if batch_sharding is not None \
+                    and tokens.shape[0] % mesh.shape["dp"] == 0:
+                tokens = jax.device_put(tokens, batch_sharding)
+            token, pos, cache = _prefill(params, tokens)
+            yield token
+            for _ in range(steps - 1):
+                token, pos, cache = _step(params, token, pos, cache)
+                yield token
+
+        return stream
 
 
 # test-size entry: heads=4 supports tp in {1,2,4}; max_seq bounds P+steps

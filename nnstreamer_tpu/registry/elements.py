@@ -45,6 +45,7 @@ _STANDARD_MODULES = (
     "nnstreamer_tpu.elements.datarepo",
     "nnstreamer_tpu.elements.files",
     "nnstreamer_tpu.elements.fault",
+    "nnstreamer_tpu.elements.generate",
     "nnstreamer_tpu.elements.trainer",
     "nnstreamer_tpu.elements.tee",
     "nnstreamer_tpu.elements.shard",
