@@ -16,7 +16,8 @@ import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_MODES = ("gspmd", "ring", "gspmd+ep", "decode", "decode-cp", "pp", "pipeline")
+_MODES = ("gspmd", "ring", "gspmd+ep", "decode", "decode-cp", "pp",
+          "pipeline", "generate")
 
 
 @pytest.mark.slow

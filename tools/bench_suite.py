@@ -224,6 +224,26 @@ def _bench_lm_decode(platform: str, on_cpu: bool,
             total_mfu = mfu(fS / tS if fS else None)
             decode_mfu = mfu(decode_flops_step / step_s
                              if decode_flops_step and step_s else None)
+            # the STREAMING form (tensor_generate's per-token host loop):
+            # same math, one dispatch per token — the gap vs the scan's
+            # decode_tokens_per_s IS the streaming tax
+            stream_tps = None
+            if not os.environ.get("BENCHS_SKIP_STREAM") and S > 1:
+                try:
+                    from nnstreamer_tpu.models.lm_serving import (
+                        _LMServingEntry,
+                    )
+
+                    s_steps = min(S, 32)
+                    stream = _LMServingEntry(cfg).make_streaming()
+                    jax.block_until_ready(
+                        list(stream(prompt, s_steps))[-1])  # compile
+                    t0 = time.monotonic()
+                    jax.block_until_ready(list(stream(prompt, s_steps))[-1])
+                    stream_tps = round(
+                        B * s_steps / (time.monotonic() - t0), 1)
+                except Exception as e:  # noqa: BLE001
+                    _log(f"{name} stream form failed: {e}")
             row = {
                 "config": name, "platform": platform,
                 "n_params": n_params,
@@ -236,6 +256,7 @@ def _bench_lm_decode(platform: str, on_cpu: bool,
                 "decode_step_ms": (round(step_s * 1e3, 3)
                                    if step_s else None),
                 "prefill_s": round(t1, 4),
+                "stream_tokens_per_s": stream_tps,
                 "mfu": round(total_mfu, 4) if total_mfu else None,
                 "decode_mfu": round(decode_mfu, 4) if decode_mfu else None,
             }
