@@ -63,6 +63,14 @@ class TensorGenerate(Element):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._stream = None
+        self._mesh = None
+
+    @property
+    def mesh(self):
+        """The device mesh generation shards over (None until the first
+        buffer builds the stream, or when unmeshed) — mirrors
+        tensor_filter's ``backend_mesh`` for tests/introspection."""
+        return self._mesh
 
     def _ensure_stream(self):
         """Lazy build on the first buffer (tensor_filter's open pattern):

@@ -3,10 +3,11 @@
 The 8-chip mesh used everywhere else can hide factoring/divisibility
 assumptions (factor_devices axis sizing, head/dim divisibility, GPipe
 stage counts, aggregator batch vs mesh size). Running the FULL
-dryrun_multichip — all six math-layer modes plus the parse_launch
-pipeline mode — at 16 and 32 virtual CPU devices exercises every one of
-those seams at sizes the driver never uses. Subprocess-per-size because
-jax_num_cpu_devices is latched at first backend init.
+dryrun_multichip — all six math-layer modes plus the two parse_launch
+product-surface modes (mesh-sharded filter pipeline, streaming
+tensor_generate) — at 16 and 32 virtual CPU devices exercises every one
+of those seams at sizes the driver never uses. Subprocess-per-size
+because jax_num_cpu_devices is latched at first backend init.
 """
 import os
 import subprocess
