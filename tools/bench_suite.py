@@ -500,6 +500,31 @@ def main() -> None:
             _log(f"{name} FAILED: {e}")
             record(name, 0.0, 0, batch)
 
+    # -- 4c. the SAME quant model on the reference's flagship backend -------
+    # framework=tflite (interpreter, host CPU, per-frame — the reference's
+    # operating mode, tensor_filter_tensorflow_lite.cc): the self-measured
+    # baseline column BASELINE.md asks for. The ratio of 4b to this row is
+    # "our XLA path vs the reference's path on identical hardware+file".
+    # NOTE on CPU-fallback runs: 4b simulates the integer graph in float
+    # for byte-exactness, so the interpreter's native int8 kernels win on
+    # host CPU — the ratio is meaningful when 4b ran on the accelerator.
+    if os.path.exists(ref_quant):
+        name = "mobilenet_v2_quant_tflite_interpreter"
+        n_f = min(frames, 128)  # interpreter is host-CPU; keep bounded
+        _log(f"{name}: per-frame, frames={n_f}")
+        try:
+            pipe = parse_launch(
+                f"tensor_src num-buffers={n_f} dimensions=3:224:224:1 "
+                "types=uint8 pattern=random "
+                "! queue max-size-buffers=4 "
+                f"! tensor_filter framework=tflite model={ref_quant} "
+                "! tensor_sink name=out max-stored=1")
+            fps, n = _run_fps(pipe, "out", n_f, 4, deadline)
+            record(name, fps, n, 1)
+        except Exception as e:
+            _log(f"{name} FAILED: {e}")
+            record(name, 0.0, 0, 1)
+
     # -- 5. among-device: sharded stream over 2 loopback query workers ------
     name = "tensor_query_sharded_x2"
     _log(f"{name}: 2 loopback workers, frames={frames}")
