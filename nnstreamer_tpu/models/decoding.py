@@ -240,10 +240,30 @@ def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None,
             ck = jax.lax.dynamic_update_slice(cache[li]["k"], k, (0, 0, pos, 0))
             cv = jax.lax.dynamic_update_slice(cache[li]["v"], v, (0, 0, pos, 0))
             cache[li] = {"k": ck, "v": cv}
-            att = (q @ ck.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
-            att = jnp.where(visible, att, -1e30)      # (B,H,1,max_seq)
-            att = jax.nn.softmax(att, axis=-1)
-            o = (att @ cv).transpose(0, 2, 1, 3).reshape(B, 1, cfg.dim)
+            if cfg.decode_attn not in ("xla", "pallas"):
+                raise ValueError(
+                    f"unknown decode_attn {cfg.decode_attn!r} "
+                    "(expected 'xla' or 'pallas')")
+            if cfg.decode_attn == "pallas" and mesh is None:
+                # single-pass online-softmax kernel over the valid prefix
+                # (ops/pallas_decode.py); sharded decode keeps the dense
+                # path — GSPMD partitions it, a pallas_call would not
+                import math
+
+                from ..ops.pallas_decode import cached_decode_attention
+
+                # Mosaic lowering only on real TPU; interpret elsewhere
+                interp = jax.devices()[0].platform != "tpu"
+                o = cached_decode_attention(
+                    q, ck, cv, pos,
+                    block_k=math.gcd(cfg.max_seq, 128),
+                    interpret=interp)
+                o = o.transpose(0, 2, 1, 3).reshape(B, 1, cfg.dim)
+            else:
+                att = (q @ ck.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+                att = jnp.where(visible, att, -1e30)  # (B,H,1,max_seq)
+                att = jax.nn.softmax(att, axis=-1)
+                o = (att @ cv).transpose(0, 2, 1, 3).reshape(B, 1, cfg.dim)
         x = x + o @ blk["wo"]
         x = x + _ffn(blk, _rmsnorm(x, blk["ln2"]), mesh, cfg)
     x = _rmsnorm(x[:, 0], params["out_norm"])

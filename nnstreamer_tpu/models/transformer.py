@@ -30,6 +30,10 @@ class TransformerConfig:
     # collectives), "ring" (ppermute ring attention over sp), "ulysses"
     # (all_to_all head/seq reshard over sp) — see parallel/context.py
     attn_impl: str = "gspmd"
+    # cached-decode attention: "xla" (masked dense — default, the
+    # equivalence oracle) | "pallas" (ops/pallas_decode.py: single-pass
+    # online-softmax over the cache, valid prefix only)
+    decode_attn: str = "xla"
     # expert parallelism: >0 replaces the dense FFN with a switch-routed
     # MoE of this many experts, sharded over the tp axis (parallel/moe.py)
     moe_experts: int = 0

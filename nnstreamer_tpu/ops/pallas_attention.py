@@ -80,8 +80,8 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     if S % block_q or S % block_k:
-        raise ValueError(f"seq {S} must divide block sizes "
-                         f"({block_q}, {block_k})")
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide "
+                         f"seq {S}")
     scale = 1.0 / (D ** 0.5)
     grid = (B, H, S // block_q)
     kernel = functools.partial(_attn_kernel, block_k=block_k, causal=causal,
