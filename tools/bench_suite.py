@@ -218,19 +218,18 @@ def _bench_lm_decode(platform: str, on_cpu: bool,
             continue
         try:
             prompt = rng.integers(0, cfg.vocab, (B, P)).astype(np.int32)
-            jax.block_until_ready(gen(params, prompt, 1))    # compile S=1
-            jax.block_until_ready(gen(params, prompt, S))    # compile S
-            t1 = min(_timed(gen, params, prompt, 1, reps=reps))
-            tS = min(_timed(gen, params, prompt, S, reps=reps))
+            if S > 1:
+                step_s, t1, tS = _marginal_step(gen, params, prompt, S, reps)
+            else:  # prefill-only point (e.g. BENCHS_LM_POINTS=8:512:1)
+                jax.block_until_ready(gen(params, prompt, 1))
+                t1 = min(_timed(gen, params, prompt, 1, reps=reps))
+                tS = t1
+                step_s = None
             f1 = compiled_flops(gen, params, prompt, 1, static_argnums=(2,))
             fS = compiled_flops(gen, params, prompt, S, static_argnums=(2,))
-            if S > 1:  # marginal decode cost needs a second point
-                step_s = max(tS - t1, 1e-9) / (S - 1)
-                decode_flops_step = ((fS - f1) / (S - 1)
-                                     if fS and f1 and fS > f1 else None)
-            else:  # prefill-only point (e.g. BENCHS_LM_POINTS=8:512:1)
-                step_s = None
-                decode_flops_step = None
+            decode_flops_step = ((fS - f1) / (S - 1)
+                                 if step_s and fS and f1 and fS > f1
+                                 else None)
             total_mfu = mfu(fS / tS if fS else None)
             decode_mfu = mfu(decode_flops_step / step_s
                              if decode_flops_step and step_s else None)
@@ -283,6 +282,35 @@ def _bench_lm_decode(platform: str, on_cpu: bool,
             print(json.dumps({"config": name, "platform": platform,
                               "error": str(e)[:300]}), flush=True)
 
+    # the pallas cached-decode kernel vs the XLA oracle, first point only.
+    # Gate: real TPU hardware only ("axon" = this rig's tunneled TPU
+    # plugin) — anywhere else decoding falls to interpret mode and the
+    # row would measure the pallas interpreter, not the kernel. The delta
+    # in decode_step_ms vs the main row IS the kernel's win.
+    run_pallas = ((platform in ("tpu", "axon")
+                   or os.environ.get("BENCHS_FORCE_PALLAS"))
+                  and points and points[0][2] > 1
+                  and time.monotonic() - t_start <= deadline_s
+                  and not os.environ.get("BENCHS_SKIP_PALLAS"))
+    if run_pallas:
+        B, P, S = points[0]
+        name = f"transformer_lm_decode_pallas_b{B}_p{P}_s{S}"
+        try:
+            from dataclasses import replace
+
+            gen_p = make_generate(replace(cfg, decode_attn="pallas"))
+            prompt = rng.integers(0, cfg.vocab, (B, P)).astype(np.int32)
+            step_p, t1p, _ = _marginal_step(gen_p, params, prompt, S, reps)
+            row = {"config": name, "platform": platform,
+                   "decode_step_ms": round(step_p * 1e3, 3),
+                   "decode_tokens_per_s": round(B / step_p, 1)}
+            print(json.dumps(row), flush=True)
+            _log(f"{name}: step {row['decode_step_ms']} ms")
+        except Exception as e:  # noqa: BLE001
+            _log(f"{name} FAILED: {e}")
+            print(json.dumps({"config": name, "platform": platform,
+                              "error": str(e)[:300]}), flush=True)
+
 
 def _timed(fn, *args, reps: int = 3):
     """Wall time of reps calls of fn(*args), each blocked to completion."""
@@ -294,6 +322,20 @@ def _timed(fn, *args, reps: int = 3):
         jax.block_until_ready(fn(*args))
         out.append(time.monotonic() - t0)
     return out
+
+
+def _marginal_step(gen, params, prompt, S: int, reps: int):
+    """One timing recipe for every generate variant: warm-compile
+    steps=1 and steps=S, take min-of-reps wall for each, and derive the
+    marginal per-decode-step time ((tS - t1) / (S - 1)). Returns
+    ``(step_s, t1, tS)``."""
+    import jax
+
+    jax.block_until_ready(gen(params, prompt, 1))    # compile S=1
+    jax.block_until_ready(gen(params, prompt, S))    # compile S
+    t1 = min(_timed(gen, params, prompt, 1, reps=reps))
+    tS = min(_timed(gen, params, prompt, S, reps=reps))
+    return max(tS - t1, 1e-9) / (S - 1), t1, tS
 
 
 def main() -> None:
