@@ -252,11 +252,12 @@ def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None,
 
                 from ..ops.pallas_decode import cached_decode_attention
 
-                # Mosaic lowering only on real TPU hardware ("axon" is
-                # this rig's tunneled TPU PJRT plugin); interpret
+                # Mosaic lowering only on real TPU hardware; interpret
                 # elsewhere — a GPU backend must not get Triton-lowered
                 # TPU-kernel code
-                interp = jax.devices()[0].platform not in ("tpu", "axon")
+                from ..utils.hw_accel import is_tpu_platform
+
+                interp = not is_tpu_platform(jax.devices()[0].platform)
                 o = cached_decode_attention(
                     q, ck, cv, pos,
                     block_k=math.gcd(cfg.max_seq, 128),

@@ -32,6 +32,16 @@ _PROBE_SRC = (
 )
 
 
+# the platform strings that mean "real TPU hardware" on this build:
+# "tpu" = stock PJRT, "axon" = this rig's tunneled TPU plugin. Shared so
+# pallas-lowering gates and bench gates can never drift apart.
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu_platform(platform: str) -> bool:
+    return platform in TPU_PLATFORMS
+
+
 def accel_available(platform: str, timeout_s: float = 15.0,
                     refresh: bool = False) -> Optional[bool]:
     """Probe whether jax can bring up ``platform`` ('cpu', 'tpu', 'gpu',

@@ -106,6 +106,39 @@ class TestMultihost:
     this process, real 2-process jax.distributed bootstrap via loopback
     subprocesses (the reference's loopback distributed-test approach)."""
 
+    @staticmethod
+    def _spawn_two_procs(prog, timeout_s=120):
+        """Run `prog` in 2 loopback jax.distributed processes; returns
+        their stdout texts. Asserts both exited 0; kills orphans."""
+        import socket
+        import subprocess
+        import sys
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            NNS_COORD=f"127.0.0.1:{port}", NNS_NUM_PROCS="2")
+        procs = []
+        try:
+            for pid in range(2):
+                e = dict(env, NNS_PROC_ID=str(pid))
+                procs.append(subprocess.Popen(
+                    [sys.executable, str(prog)], env=e,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+            outs = []
+            for p in procs:
+                out, _ = p.communicate(timeout=timeout_s)
+                outs.append(out.decode())
+        finally:
+            for p in procs:  # a worker stuck at the barrier must not orphan
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+        assert all(p.returncode == 0 for p in procs), "\n".join(outs)
+        return outs
+
     def test_single_process_noop(self, monkeypatch):
         from nnstreamer_tpu.parallel import global_mesh, init_multihost, process_info
 
@@ -122,13 +155,6 @@ class TestMultihost:
     def test_two_process_loopback_bootstrap(self, tmp_path):
         """Two local processes form one jax.distributed runtime; each must
         see the GLOBAL device count (2) and run a psum over DCN."""
-        import socket
-        import subprocess
-        import sys
-
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         prog = tmp_path / "worker.py"
         prog.write_text(
@@ -151,24 +177,47 @@ class TestMultihost:
             "assert float(total) == 1.0, float(total)\n"
             "print('proc', info['process_index'], 'devices', info['global_devices'], 'psum ok')\n"
         )
-        env = dict(
-            os.environ, JAX_PLATFORMS="cpu",
-            NNS_COORD=f"127.0.0.1:{port}", NNS_NUM_PROCS="2")
-        procs = []
-        try:
-            for pid in range(2):
-                e = dict(env, NNS_PROC_ID=str(pid))
-                procs.append(subprocess.Popen(
-                    [sys.executable, str(prog)], env=e,
-                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-            outs = []
-            for p in procs:
-                out, _ = p.communicate(timeout=120)
-                outs.append(out.decode())
-        finally:
-            for p in procs:  # a worker stuck at the barrier must not orphan
-                if p.poll() is None:
-                    p.kill()
-                    p.wait(timeout=10)
-        assert all(p.returncode == 0 for p in procs), "\n".join(outs)
+        outs = self._spawn_two_procs(prog)
         assert "devices 2" in outs[0]
+
+    @pytest.mark.slow
+    def test_two_process_sharded_train_step(self, tmp_path):
+        """The FULL sharded train step over a 2-process global mesh (4
+        virtual devices per process -> 8 global, dp over DCN, tp/sp
+        inside each host per global_mesh's layout rule). Both processes
+        must compute the identical finite loss — the multi-host analog
+        of dryrun_multichip's gspmd mode, proving the training path runs
+        over jax.distributed, not just a single psum."""
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        prog = tmp_path / "train_worker.py"
+        prog.write_text(
+            "import os, sys\n"
+            f"sys.path.insert(0, {repo_root!r})\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.config.update('jax_num_cpu_devices', 4)\n"
+            "from nnstreamer_tpu.parallel import init_multihost, process_info\n"
+            "assert init_multihost(), 'expected multi-process init'\n"
+            "info = process_info()\n"
+            "assert info['global_devices'] == 8, info\n"
+            "import numpy as np\n"
+            "from nnstreamer_tpu.parallel.multihost import global_mesh\n"
+            "from nnstreamer_tpu.models.transformer import (\n"
+            "    TransformerConfig, init_params, make_train_step)\n"
+            "mesh = global_mesh({'dp': 2, 'tp': 2, 'sp': 2})\n"
+            "cfg = TransformerConfig(vocab=64, dim=32, heads=2, layers=2,\n"
+            "                        max_seq=17)\n"
+            "step, shard_params, data_sharding = make_train_step(cfg, mesh)\n"
+            "params = shard_params(init_params(cfg))\n"
+            "rng = np.random.default_rng(0)\n"
+            "tokens = rng.integers(0, 64, (4, 17)).astype(np.int32)\n"
+            "tokens = jax.device_put(tokens, data_sharding)\n"
+            "params, loss = step(params, tokens)\n"
+            "loss = float(loss)\n"
+            "assert np.isfinite(loss), loss\n"
+            "print('proc', info['process_index'], 'loss', round(loss, 6))\n"
+        )
+        outs = self._spawn_two_procs(prog, timeout_s=300)
+        losses = [ln.split("loss")[-1].strip()
+                  for out in outs for ln in out.splitlines() if "loss" in ln]
+        assert len(losses) == 2 and losses[0] == losses[1], outs

@@ -287,7 +287,9 @@ def _bench_lm_decode(platform: str, on_cpu: bool,
     # plugin) — anywhere else decoding falls to interpret mode and the
     # row would measure the pallas interpreter, not the kernel. The delta
     # in decode_step_ms vs the main row IS the kernel's win.
-    run_pallas = ((platform in ("tpu", "axon")
+    from nnstreamer_tpu.utils.hw_accel import is_tpu_platform
+
+    run_pallas = ((is_tpu_platform(platform)
                    or os.environ.get("BENCHS_FORCE_PALLAS"))
                   and points and points[0][2] > 1
                   and time.monotonic() - t_start <= deadline_s
@@ -300,7 +302,7 @@ def _bench_lm_decode(platform: str, on_cpu: bool,
 
             gen_p = make_generate(replace(cfg, decode_attn="pallas"))
             prompt = rng.integers(0, cfg.vocab, (B, P)).astype(np.int32)
-            step_p, t1p, _ = _marginal_step(gen_p, params, prompt, S, reps)
+            step_p, _, _ = _marginal_step(gen_p, params, prompt, S, reps)
             row = {"config": name, "platform": platform,
                    "decode_step_ms": round(step_p * 1e3, 3),
                    "decode_tokens_per_s": round(B / step_p, 1)}
