@@ -38,7 +38,7 @@ from ..core import (
     caps_from_tensors_info,
 )
 from ..registry.elements import register_element
-from ..runtime.element import Element, ElementError, Prop
+from ..runtime.element import Element, ElementError, Prop, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 
 
@@ -58,11 +58,16 @@ class TensorGenerate(Element):
         "steps": Prop(16, int, "tokens generated per prompt buffer"),
         "mesh": Prop("", str,
                      "device mesh spec (dp=N | auto | DxT); empty = single"),
+        "conversation": Prop(False, prop_bool,
+                             "persist the KV cache across prompt buffers "
+                             "(multi-turn; buffer meta reset=True starts "
+                             "a new conversation)"),
     })
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._stream = None
+        self._session = None
         self._mesh = None
 
     @property
@@ -85,10 +90,14 @@ class TensorGenerate(Element):
                 f"make_streaming(mesh), got {model!r}")
         mod_name, _, attr = model.partition(":")
         entry = getattr(importlib.import_module(mod_name), attr)
-        maker = getattr(entry, "make_streaming", None)
+        conversation = self.props["conversation"]
+        maker = getattr(
+            entry, "make_session" if conversation else "make_streaming",
+            None)
         if maker is None:
+            what = "make_session" if conversation else "make_streaming"
             raise ElementError(
-                f"{self.name}: {model} has no make_streaming(mesh) — "
+                f"{self.name}: {model} has no {what}(mesh) — "
                 "use tensor_filter for whole-sequence entries")
         mesh = None
         spec = self.props["mesh"]
@@ -99,11 +108,16 @@ class TensorGenerate(Element):
 
             mesh = parse_mesh_spec(spec, jax.devices())
         self._mesh = mesh
-        self._stream = maker(mesh)
+        if conversation:
+            self._session = maker(mesh)
+            self._stream = self._session.generate
+        else:
+            self._stream = maker(mesh)
         return self._stream
 
     def stop(self) -> None:
         self._stream = None
+        self._session = None
 
     def transform_caps(self, src_pad: Pad) -> Caps:
         # (B, 1) per token, B known only per-buffer: flexible stream
@@ -111,6 +125,8 @@ class TensorGenerate(Element):
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
         stream = self._ensure_stream()
+        if self._session is not None and buf.meta.get("reset"):
+            self._session.reset()
         prompt = np.asarray(buf.as_numpy().tensors[0])
         if prompt.ndim != 2:
             raise ElementError(

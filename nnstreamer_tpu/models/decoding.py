@@ -212,6 +212,46 @@ def prefill(cfg: TransformerConfig, params, tokens, cache, mesh=None,
     return x @ params["embed"].T, cache, jnp.asarray(S_real, jnp.int32)
 
 
+def prefill_continue(cfg: TransformerConfig, params, tokens, cache, start,
+                     mesh=None):
+    """Chunked prefill: ingest ``tokens`` (B, P) at positions
+    ``start..start+P-1``, attending causally over the EXISTING cache
+    prefix plus the chunk itself — the multi-turn ingestion primitive
+    (one compiled call per conversation turn where a decode_step loop
+    would pay P sequential dispatches). ``start`` is a traced scalar;
+    P is static. Returns (logits_last (B, V), cache, start + P).
+
+    Equivalence contract: after this call the cache holds exactly the
+    states a from-scratch :func:`prefill` over history+chunk would
+    produce (asserted via the conversation oracle in test_generate).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, P = tokens.shape
+    x = (params["embed"][tokens]
+         + jax.lax.dynamic_slice_in_dim(params["pos"], start, P, 0))
+    positions = jnp.arange(cfg.max_seq)
+    q_pos = start + jnp.arange(P)
+    visible = (positions[None, None, None, :]
+               <= q_pos[None, None, :, None])          # (1,1,P,max_seq)
+    for li, blk in enumerate(params["blocks"]):
+        h = _rmsnorm(x, blk["ln1"])
+        q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
+        q, k, v = (_split_heads(cfg, t) for t in (q, k, v))  # (B,H,P,Dh)
+        ck = jax.lax.dynamic_update_slice(cache[li]["k"], k, (0, 0, start, 0))
+        cv = jax.lax.dynamic_update_slice(cache[li]["v"], v, (0, 0, start, 0))
+        cache[li] = {"k": ck, "v": cv}
+        att = (q @ ck.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+        att = jnp.where(visible, att, -1e30)           # (B,H,P,max_seq)
+        att = jax.nn.softmax(att, axis=-1)
+        o = (att @ cv).transpose(0, 2, 1, 3).reshape(B, P, cfg.dim)
+        x = x + o @ blk["wo"]
+        x = x + _ffn(blk, _rmsnorm(x, blk["ln2"]), mesh, cfg)
+    x = _rmsnorm(x[:, -1], params["out_norm"])
+    return x @ params["embed"].T, cache, start + P
+
+
 def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None,
                 sp_attn=None):
     """One token (B,) at position ``pos`` (scalar int32) → (logits (B, V),

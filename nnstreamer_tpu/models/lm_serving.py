@@ -111,7 +111,13 @@ class _LMServingEntry:
         import jax
         import jax.numpy as jnp
 
-        from .decoding import cache_pspecs, decode_step, init_cache, prefill
+        from .decoding import (
+            cache_pspecs,
+            decode_step,
+            init_cache,
+            prefill,
+            prefill_continue,
+        )
 
         cfg = self.cfg
         params, use_tp = self._shard_params(mesh)
@@ -159,23 +165,96 @@ class _LMServingEntry:
             return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
                     pos + 1, constrain(cache))
 
-        def stream(tokens, steps):
-            if steps < 1:
-                raise ValueError(f"steps={steps} must be >= 1")
-            if tokens.shape[1] + steps > cfg.max_seq:
-                raise ValueError(
-                    f"prompt ({tokens.shape[1]}) + steps ({steps}) "
-                    f"exceeds max_seq {cfg.max_seq}")
+        # multi-turn ingestion: one compiled call per turn (a decode_step
+        # loop would pay P sequential dispatches); cache donated likewise
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def _ingest(params, feed, cache, start):
+            logits, cache, pos = prefill_continue(cfg, params, feed, cache,
+                                                  start, step_mesh)
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32), pos,
+                    constrain(cache))
+
+        def _shard_tokens(tokens):
             if batch_sharding is not None \
                     and tokens.shape[0] % mesh.shape["dp"] == 0:
-                tokens = jax.device_put(tokens, batch_sharding)
-            token, pos, cache = _prefill(params, tokens)
+                return jax.device_put(tokens, batch_sharding)
+            return tokens
+
+        def stream(tokens, steps, _session=None):
+            """Yield ``steps`` greedy tokens for ``tokens`` (B, P). With
+            ``_session`` (a _StreamSession), the KV cache CONTINUES from
+            the previous turn: the new prompt is ingested token-by-token
+            through the jitted step (teacher-forced), then generation
+            resumes — multi-turn serving without re-prefilling history."""
+            if steps < 1:
+                raise ValueError(f"steps={steps} must be >= 1")
+            state = _session.state if _session is not None else None
+            if state is None:
+                if tokens.shape[1] + steps > cfg.max_seq:
+                    raise ValueError(
+                        f"prompt ({tokens.shape[1]}) + steps ({steps}) "
+                        f"exceeds max_seq {cfg.max_seq}")
+                token, pos, cache = _prefill(params, _shard_tokens(tokens))
+            else:
+                pending, pos, cache = state
+                if tokens.shape[0] != pending.shape[0]:
+                    raise ValueError(
+                        f"conversation batch changed: session has "
+                        f"batch {pending.shape[0]}, new prompt has "
+                        f"{tokens.shape[0]} (reset() to start over)")
+                if int(pos) + tokens.shape[1] + steps > cfg.max_seq:
+                    raise ValueError(
+                        f"conversation at pos {int(pos)} + prompt "
+                        f"({tokens.shape[1]}) + steps ({steps}) exceeds "
+                        f"max_seq {cfg.max_seq}")
+                tokens = _shard_tokens(tokens)
+                # teacher-forced ingestion, ONE compiled call. The
+                # previous turn's FINAL sample is still pending (its K/V
+                # was never written — generation stopped at its
+                # prediction), so it leads the chunk; the chunk's last
+                # prediction opens generation. Cache states end up
+                # identical to a from-scratch prefill over
+                # history+prompt (asserted in test_generate).
+                feed = jnp.concatenate([pending[:, None], tokens], axis=1)
+                token, pos, cache = _ingest(params, feed, cache, pos)
+            # persist state after EVERY step, not just at exhaustion: the
+            # cache is donated into each _step, so an abandoned generator
+            # must leave the session holding the LIVE cache, never a
+            # donated-away one
+            if _session is not None:
+                _session.state = (token, pos, cache)
             yield token
             for _ in range(steps - 1):
                 token, pos, cache = _step(params, token, pos, cache)
+                if _session is not None:
+                    _session.state = (token, pos, cache)
                 yield token
 
         return stream
+
+    def make_session(self, mesh=None):
+        """Stateful multi-turn serving: ``session.generate(tokens, steps)``
+        yields like the stream form but the KV cache persists across
+        calls (turn 2's prompt is ingested at the current position, not
+        re-prefilled). ``session.reset()`` starts a new conversation."""
+        return _StreamSession(self.make_streaming(mesh))
+
+
+class _StreamSession:
+    def __init__(self, stream):
+        self._stream = stream
+        self.state = None  # (last_token, pos, cache) after each turn
+
+    def generate(self, tokens, steps: int):
+        return self._stream(tokens, steps, _session=self)
+
+    def reset(self) -> None:
+        self.state = None
+
+    @property
+    def position(self):
+        """Sequence position after the last turn (0 = fresh session)."""
+        return int(self.state[1]) if self.state is not None else 0
 
 
 # test-size entry: heads=4 supports tp in {1,2,4}; max_seq bounds P+steps
