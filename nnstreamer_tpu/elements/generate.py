@@ -62,6 +62,10 @@ class TensorGenerate(Element):
                              "persist the KV cache across prompt buffers "
                              "(multi-turn; buffer meta reset=True starts "
                              "a new conversation)"),
+        "temperature": Prop(0.0, float,
+                            "0 = greedy (deterministic); > 0 = categorical "
+                            "sampling"),
+        "seed": Prop(0, int, "sampling rng seed (temperature > 0)"),
     })
 
     def __init__(self, name=None, **props):
@@ -108,11 +112,12 @@ class TensorGenerate(Element):
 
             mesh = parse_mesh_spec(spec, jax.devices())
         self._mesh = mesh
+        temperature = float(self.props["temperature"])
         if conversation:
-            self._session = maker(mesh)
+            self._session = maker(mesh, temperature)
             self._stream = self._session.generate
         else:
-            self._stream = maker(mesh)
+            self._stream = maker(mesh, temperature)
         return self._stream
 
     def stop(self) -> None:
@@ -133,7 +138,8 @@ class TensorGenerate(Element):
                 f"{self.name}: prompt must be (batch, prompt_len) int32, "
                 f"got shape {prompt.shape}")
         steps = int(self.props["steps"])
-        for i, token in enumerate(stream(prompt.astype(np.int32), steps)):
+        for i, token in enumerate(stream(prompt.astype(np.int32), steps,
+                                         rng=int(self.props["seed"]))):
             out = Buffer([np.asarray(token).reshape(-1, 1)])
             out.copy_metadata_from(buf)
             out.meta["gen_step"] = i

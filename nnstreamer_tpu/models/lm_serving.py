@@ -99,13 +99,17 @@ class _LMServingEntry:
     def make_sharded(self, mesh):
         return self._build(mesh=mesh)
 
-    def make_streaming(self, mesh=None):
+    def make_streaming(self, mesh=None, temperature: float = 0.0):
         """Per-token generation for the ``tensor_generate`` element:
-        returns ``stream(tokens (B, P), steps) -> yields (B,) int32`` —
-        prefill once, then one jitted ``decode_step`` per yielded token.
-        A host loop (not ``lax.scan``) is the point: each token leaves
-        the device as it is picked, so downstream elements render/forward
-        incrementally instead of waiting out the whole scan."""
+        returns ``stream(tokens (B, P), steps, rng=None) -> yields (B,)
+        int32`` — prefill once, then one jitted ``decode_step`` per
+        yielded token. A host loop (not ``lax.scan``) is the point: each
+        token leaves the device as it is picked, so downstream elements
+        render/forward incrementally instead of waiting out the whole
+        scan. ``temperature`` 0 = greedy (deterministic); > 0 =
+        categorical sampling (``rng``: int seed or jax key; per-step keys
+        are folded from it, and continuation turns fold in the session
+        position so multi-turn sampling never reuses a key)."""
         import functools
 
         import jax
@@ -148,31 +152,36 @@ class _LMServingEntry:
             if "dp" in axes:
                 batch_sharding = NamedSharding(mesh, P("dp"))
 
+        _dummy_key = jax.random.PRNGKey(0)
+
+        def _pick(logits, key):
+            if temperature > 0.0:
+                return jax.random.categorical(
+                    key, logits / temperature, axis=-1).astype(jnp.int32)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
         @jax.jit
-        def _prefill(params, tokens):
+        def _prefill(params, tokens, key):
             cache = constrain(init_cache(cfg, tokens.shape[0]))
             logits, cache, pos = prefill(cfg, params, tokens, cache,
                                          step_mesh)
-            return (jnp.argmax(logits, axis=-1).astype(jnp.int32), pos,
-                    constrain(cache))
+            return _pick(logits, key), pos, constrain(cache)
 
         # donate the cache: each step writes one position in place —
         # without donation every token holds two full caches in HBM
         @functools.partial(jax.jit, donate_argnums=(3,))
-        def _step(params, token, pos, cache):
+        def _step(params, token, pos, cache, key):
             logits, cache = decode_step(cfg, params, token, pos, cache,
                                         step_mesh)
-            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                    pos + 1, constrain(cache))
+            return _pick(logits, key), pos + 1, constrain(cache)
 
         # multi-turn ingestion: one compiled call per turn (a decode_step
         # loop would pay P sequential dispatches); cache donated likewise
         @functools.partial(jax.jit, donate_argnums=(2,))
-        def _ingest(params, feed, cache, start):
+        def _ingest(params, feed, cache, start, key):
             logits, cache, pos = prefill_continue(cfg, params, feed, cache,
                                                   start, step_mesh)
-            return (jnp.argmax(logits, axis=-1).astype(jnp.int32), pos,
-                    constrain(cache))
+            return _pick(logits, key), pos, constrain(cache)
 
         def _shard_tokens(tokens):
             if batch_sharding is not None \
@@ -180,21 +189,39 @@ class _LMServingEntry:
                 return jax.device_put(tokens, batch_sharding)
             return tokens
 
-        def stream(tokens, steps, _session=None):
-            """Yield ``steps`` greedy tokens for ``tokens`` (B, P). With
+        def stream(tokens, steps, _session=None, rng=None):
+            """Yield ``steps`` tokens for ``tokens`` (B, P). With
             ``_session`` (a _StreamSession), the KV cache CONTINUES from
-            the previous turn: the new prompt is ingested token-by-token
-            through the jitted step (teacher-forced), then generation
-            resumes — multi-turn serving without re-prefilling history."""
+            the previous turn: the new prompt is ingested in one chunked
+            prefill, then generation resumes — multi-turn serving
+            without re-prefilling history."""
             if steps < 1:
                 raise ValueError(f"steps={steps} must be >= 1")
             state = _session.state if _session is not None else None
+            if temperature > 0.0:
+                import numpy as _np
+
+                # int-like seeds (incl. numpy scalars) become keys;
+                # anything else is assumed to BE a key already
+                base_key = (jax.random.PRNGKey(int(rng or 0))
+                            if isinstance(rng, (int, _np.integer,
+                                                type(None)))
+                            else rng)
+                if state is not None:
+                    # a continuation turn must never reuse turn-1's keys
+                    base_key = jax.random.fold_in(base_key, int(state[1]))
+                keys = jax.random.split(base_key, steps)
+            else:
+                # greedy ignores keys (_pick's temperature branch is
+                # static) — skip per-call key derivation on the hot path
+                keys = [_dummy_key] * steps
             if state is None:
                 if tokens.shape[1] + steps > cfg.max_seq:
                     raise ValueError(
                         f"prompt ({tokens.shape[1]}) + steps ({steps}) "
                         f"exceeds max_seq {cfg.max_seq}")
-                token, pos, cache = _prefill(params, _shard_tokens(tokens))
+                token, pos, cache = _prefill(params, _shard_tokens(tokens),
+                                             keys[0])
             else:
                 pending, pos, cache = state
                 if tokens.shape[0] != pending.shape[0]:
@@ -216,7 +243,8 @@ class _LMServingEntry:
                 # identical to a from-scratch prefill over
                 # history+prompt (asserted in test_generate).
                 feed = jnp.concatenate([pending[:, None], tokens], axis=1)
-                token, pos, cache = _ingest(params, feed, cache, pos)
+                token, pos, cache = _ingest(params, feed, cache, pos,
+                                            keys[0])
             # persist state after EVERY step, not just at exhaustion: the
             # cache is donated into each _step, so an abandoned generator
             # must leave the session holding the LIVE cache, never a
@@ -224,20 +252,21 @@ class _LMServingEntry:
             if _session is not None:
                 _session.state = (token, pos, cache)
             yield token
-            for _ in range(steps - 1):
-                token, pos, cache = _step(params, token, pos, cache)
+            for i in range(steps - 1):
+                token, pos, cache = _step(params, token, pos, cache,
+                                          keys[i + 1])
                 if _session is not None:
                     _session.state = (token, pos, cache)
                 yield token
 
         return stream
 
-    def make_session(self, mesh=None):
+    def make_session(self, mesh=None, temperature: float = 0.0):
         """Stateful multi-turn serving: ``session.generate(tokens, steps)``
         yields like the stream form but the KV cache persists across
         calls (turn 2's prompt is ingested at the current position, not
         re-prefilled). ``session.reset()`` starts a new conversation."""
-        return _StreamSession(self.make_streaming(mesh))
+        return _StreamSession(self.make_streaming(mesh, temperature))
 
 
 class _StreamSession:
@@ -245,8 +274,8 @@ class _StreamSession:
         self._stream = stream
         self.state = None  # (last_token, pos, cache) after each turn
 
-    def generate(self, tokens, steps: int):
-        return self._stream(tokens, steps, _session=self)
+    def generate(self, tokens, steps: int, rng=None):
+        return self._stream(tokens, steps, _session=self, rng=rng)
 
     def reset(self) -> None:
         self.state = None
