@@ -71,6 +71,9 @@ class FileSrc(_FileSourceBase):
     ELEMENT_NAME = "filesrc"
     PROPERTIES = {
         "blocksize": Prop(-1, int, "bytes per buffer (<0 = whole file)"),
+        # the reference's SSAT lines pass num_buffers on filesrc (its
+        # repo-source idiom); honor it as a read cap (0 = unbounded)
+        "num_buffers": Prop(0, int, "stop after N buffers (0 = all)"),
     }
 
     def __init__(self, name=None, **props):
@@ -99,6 +102,10 @@ class FileSrc(_FileSourceBase):
         self._close()
 
     def create(self) -> Optional[Buffer]:
+        n_max = self.props["num_buffers"]
+        if n_max > 0 and self._offset >= n_max:  # <=0 = unbounded (gst)
+            self._close()
+            return None
         path = self.props["location"]
         if self._fh is None:
             try:
@@ -135,6 +142,11 @@ class MultiFileSrc(_FileSourceBase):
         "start_index": Prop(0, int, "first index"),
         "index": Prop(None, int, "GStreamer spelling of start-index"),
         "stop_index": Prop(-1, int, "last index (-1 = until missing file)"),
+        # one file = one buffer here; GStreamer's chunked reads don't
+        # apply, but the reference's launch lines pass the property
+        "blocksize": Prop(-1, int, "accepted for compat (files are read "
+                                   "whole per buffer)"),
+        "num_buffers": Prop(0, int, "stop after N buffers (0 = all)"),
     }
 
     def __init__(self, name=None, **props):
@@ -157,11 +169,12 @@ class MultiFileSrc(_FileSourceBase):
             raise ElementError(
                 f"{self.describe()}: bad location pattern '{pattern}' ({e}); "
                 "escape literal percent signs as %%")
-        if self._literal and self.props["stop_index"] < 0:
+        if self._literal and self.props["stop_index"] < 0 \
+                and self.props["num_buffers"] <= 0:
             raise ElementError(
                 f"{self.describe()}: location '{pattern}' has no %d "
-                "conversion — set stop-index for a fixed-file stream, or "
-                "fix the pattern")
+                "conversion — set stop-index or num-buffers for a "
+                "fixed-file stream, or fix the pattern")
         self._index = self.props["start_index"]
 
     def reset_flow(self) -> None:
@@ -171,6 +184,9 @@ class MultiFileSrc(_FileSourceBase):
     def create(self) -> Optional[Buffer]:
         stop = self.props["stop_index"]
         if stop >= 0 and self._index > stop:
+            return None
+        n_max = self.props["num_buffers"]
+        if n_max > 0 and self._index - self.props["start_index"] >= n_max:
             return None
         pattern = self.props["location"]
         path = pattern if self._literal else pattern % self._index

@@ -123,7 +123,13 @@ class FileSink(SinkElement):
 
     ELEMENT_NAME = "filesink"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
-    PROPERTIES = {"location": Prop(None, str, "output path")}
+    PROPERTIES = {
+        "location": Prop(None, str, "output path"),
+        # GStreamer basesink clock sync; this runtime renders as fast as
+        # upstream delivers, so the property is accepted as a no-op for
+        # reference launch-line compatibility
+        "sync": Prop(False, prop_bool, "accepted for compat (no-op)"),
+    }
 
     def start(self) -> None:
         loc = self.props["location"]
@@ -150,7 +156,10 @@ class MultiFileSink(SinkElement):
 
     ELEMENT_NAME = "multifilesink"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
-    PROPERTIES = {"location": Prop("out_%03d.raw", str, "printf-style path pattern")}
+    PROPERTIES = {
+        "location": Prop("out_%03d.raw", str, "printf-style path pattern"),
+        "sync": Prop(False, prop_bool, "accepted for compat (no-op)"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

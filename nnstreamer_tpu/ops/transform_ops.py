@@ -152,7 +152,11 @@ def parse_transform_options(mode: str, option: str):
             if op == "typecast":
                 out_dtype = DataType.from_any(val.strip())
             else:
-                ops.append((op, parse_number(val)))
+                # reference grammar allows extra colon values per op
+                # ("add:A:B"); without per-channel mode it uses ONLY the
+                # first (gsttensor_transform.c:794-807, values[0]) —
+                # match that for drop-in compat
+                ops.append((op, parse_number(val.split(":")[0])))
         return make_arithmetic(ops, out_dtype)
     if mode == "transpose":
         return make_transpose([int(p) for p in option.split(":")])

@@ -87,6 +87,39 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
 
     pipe = pipeline or Pipeline()
     tokens = shlex.split(_pad_links(description))
+    # gst-launch tolerates spaces around '=' in properties and caps
+    # fields ("tee name =t", "format = RGB", "width= 100" — all appear in
+    # the reference's own runTest corpus): rejoin the fragments. Only
+    # unambiguous shapes merge — a bare '=', a token that IS a
+    # continuation ("=t"), or a bare "key=" with exactly one '=' (so a
+    # VALUE that merely ends with '=' , e.g. base64 padding, never grabs
+    # its neighbor).
+    fixed: List[str] = []
+    i = 0
+    while i < len(tokens):
+        tok = tokens[i]
+        nxt = tokens[i + 1] if i + 1 < len(tokens) else None
+        # a bare '=' (or '=value' continuation) can only be a split
+        # assignment — merge regardless of the previous token's content
+        # ("video/x-raw,width=100,height = 200" must rejoin even though
+        # the prior fragment already carries '=' signs)
+        if (tok == "=" and fixed and fixed[-1] != "!"
+                and nxt is not None and nxt != "!"):
+            fixed[-1] += "=" + nxt
+            i += 2
+            continue
+        if tok.startswith("=") and tok != "=" and fixed and fixed[-1] != "!":
+            fixed[-1] += tok
+            i += 1
+            continue
+        if (tok.endswith("=") and tok.count("=") == 1 and tok != "="
+                and nxt is not None and nxt != "!"):
+            fixed.append(tok + nxt)
+            i += 2
+            continue
+        fixed.append(tok)
+        i += 1
+    tokens = fixed
     # gst-launch allows spaces after commas inside caps strings
     # ("video/x-raw, width=160, height=120"): a comma-terminated token
     # continues in the next token — but ONLY for tokens that began as a

@@ -202,3 +202,74 @@ def test_caps_walk_stops_at_opaque_element(caplog):
         caps = downstream_filter_caps(pipe.get("src"))
     assert caps is None
     assert any("stopped at opaque element" in r.message for r in caplog.records)
+
+
+def test_spaces_around_equals_in_caps_and_props(tmp_path):
+    """runTest corpus idioms: 'format = RGB' inside caps, 'name =t' in a
+    property — gst-launch tolerates stray spaces around '='."""
+    pipe = parse_launch(
+        "videotestsrc num-buffers=1 ! videoconvert ! "
+        "video/x-raw, format = RGB, width=32, height=24, framerate=5/1 ! "
+        "tee name =t t. ! queue ! tensor_converter ! tensor_sink name=out")
+    got = []
+    pipe.get("out").connect(got.append)
+    pipe.play(); pipe.wait(timeout=30); pipe.stop()
+    assert len(got) == 1
+    assert got[0].tensors[0].shape[1:3] == (24, 32)
+
+
+def test_value_ending_in_equals_not_merged():
+    """The '=' rejoin must never grab a neighbor when the '=' belongs to
+    a VALUE (e.g. base64 padding in a custom string)."""
+    pipe = parse_launch(
+        "tensor_src num-buffers=1 dimensions=4 types=float32 "
+        "! tensor_filter framework=jax model=builtin://passthrough "
+        "custom=abc== name=f "
+        "! tensor_sink name=out")
+    assert pipe.get("f").props["custom"] == "abc=="
+
+
+def test_filesrc_num_buffers_and_sink_sync(tmp_path):
+    """filesrc num_buffers caps reads (SSAT repo idiom); filesink sync=
+    is accepted."""
+    data = tmp_path / "d.dat"
+    data.write_bytes(bytes(range(16)))
+    out = tmp_path / "o.dat"
+    pipe = parse_launch(
+        f"filesrc location={data} blocksize=4 num_buffers=2 ! "
+        "application/octet-stream ! "
+        "tensor_converter input-dim=4:1 input-type=uint8 ! "
+        f"filesink location={out} sync=true")
+    pipe.play(); pipe.wait(timeout=30); pipe.stop()
+    assert out.read_bytes() == bytes(range(8))  # 2 x 4-byte blocks
+
+
+def test_multifilesrc_literal_with_num_buffers(tmp_path):
+    """A literal (no %d) multifilesrc location bounded by num_buffers
+    re-reads the same file N times (reference repo-loop idiom)."""
+    data = tmp_path / "t.dat"
+    data.write_bytes(b"\x01\x02\x03\x04")
+    pipe = parse_launch(
+        f"multifilesrc location={data} blocksize=-1 num_buffers=2 ! "
+        "application/octet-stream ! "
+        "tensor_converter input-dim=4:1 input-type=uint8 ! "
+        "tensor_sink name=out max-stored=8")
+    got = []
+    pipe.get("out").connect(got.append)
+    pipe.play(); pipe.wait(timeout=30); pipe.stop()
+    assert len(got) == 2
+
+
+def test_arithmetic_extra_colon_value_uses_first():
+    """Reference grammar 'add:A:B' without per-channel uses only A
+    (gsttensor_transform.c values[0])."""
+    import numpy as np
+
+    pipe = parse_launch(
+        "tensor_src num-buffers=1 dimensions=4 types=float32 pattern=counter "
+        "! tensor_transform mode=arithmetic option=add:9.900000e-001:-80.256 "
+        "! tensor_sink name=out")
+    got = []
+    pipe.get("out").connect(got.append)
+    pipe.play(); pipe.wait(timeout=30); pipe.stop()
+    np.testing.assert_allclose(np.asarray(got[0].tensors[0]), 0.99, rtol=1e-6)

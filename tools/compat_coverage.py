@@ -1,0 +1,141 @@
+"""Launch-line drop-in compat coverage vs the reference's OWN test corpus.
+
+Scans every ``runTest.sh`` in the reference checkout for ``gstTest "..."``
+pipeline strings (the reference's SSAT harness) and tries to CONSTRUCT
+each one through our ``parse_launch`` — the measurable form of "reference
+launch lines run unchanged" (docs/migration.md). Construction only: no
+``play()``, because most lines reference fixture files their suites
+generate at run time; what parse-time coverage proves is the element
+names, caps grammar, property spellings, and pad-link syntax.
+
+Classification per line:
+  constructed       — parse_launch built the pipeline
+  fixture_missing   — grammar parsed but a referenced file is absent
+                      (the reference suites generate their fixtures at
+                      run time; the reference fails these the same way)
+  parse_failed      — parse/link/negotiation raised (the real gaps)
+  shell_var_skipped — line still contains unresolved ``$...`` after the
+                      harness substitutions (can't be evaluated fairly)
+
+Writes ``COMPAT_COVERAGE.json`` at the repo root and prints one summary
+JSON line. Run:  python tools/compat_coverage.py  [reference_root]
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from collections import Counter, defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+REF = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+
+_GSTTEST = re.compile(r'gstTest\s+"((?:[^"\\]|\\.)*)"\s*([^\n]*)')
+# the harness always passes the plugin path first; not part of the line
+_PLUGIN_PATH = re.compile(r"--gst-plugin-path=\S+\s*")
+_SHELL_VAR = re.compile(r"\$\{?[A-Za-z0-9_#@*]+\}?|\$\(")
+
+
+def _unescape(s: str) -> str:
+    # shell double-quote escapes: \" \( \) \$ \\ — drop the backslash
+    return re.sub(r'\\(.)', r'\1', s)
+
+
+def collect_lines():
+    out = []
+    for root, _dirs, files in os.walk(os.path.join(REF, "tests")):
+        if "runTest.sh" not in files:
+            continue
+        suite = os.path.basename(root)
+        text = open(os.path.join(root, "runTest.sh"),
+                    errors="replace").read()
+        for m in _GSTTEST.finditer(text):
+            line = _unescape(m.group(1))
+            line = _PLUGIN_PATH.sub("", line).strip()
+            # SSAT gstTest args: <case> <ignore> <expectFail> ... — the
+            # reference's NEGATIVE tests (expectFail=1) are lines that
+            # MUST fail; they are scored separately (error compat)
+            args = m.group(2).split()
+            expect_fail = len(args) >= 3 and args[2] == "1"
+            if line:
+                out.append((suite, line, expect_fail))
+    return out
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # never touch the TPU probe
+
+    from nnstreamer_tpu.runtime.parse import parse_launch
+
+    lines = collect_lines()
+    counts = Counter()
+    by_suite = defaultdict(Counter)
+    failures = Counter()
+    for suite, line, expect_fail in lines:
+        if _SHELL_VAR.search(line):
+            counts["shell_var_skipped"] += 1
+            by_suite[suite]["shell_var_skipped"] += 1
+            continue
+        try:
+            pipe = parse_launch(line)
+            pipe.stop()
+            ok = True
+        except Exception as e:  # noqa: BLE001 — classification, not flow
+            ok = False
+            err = e
+        if expect_fail:
+            # negative line: raising at parse is error-compat; building
+            # is also acceptable (many negatives only fail at play)
+            kind = ("negative_raised" if not ok
+                    else "negative_constructed")
+        elif ok:
+            kind = "constructed"
+        else:
+            msg = str(err)
+            if isinstance(err, FileNotFoundError) or (
+                    "No such file or directory" in msg
+                    or "cannot open" in msg):
+                kind = "fixture_missing"
+            else:
+                kind = "parse_failed"
+                failures[f"{type(err).__name__}: {msg[:90]}"] += 1
+        counts[kind] += 1
+        by_suite[suite][kind] += 1
+
+    # grammar-evaluable = lines whose outcome reflects OUR parser, not
+    # the environment: fixture_missing parsed its grammar successfully
+    evaluable = (counts["constructed"] + counts["parse_failed"]
+                 + counts["fixture_missing"])
+    grammar_ok = counts["constructed"] + counts["fixture_missing"]
+    result = {
+        "metric": "reference_launch_line_construct_coverage",
+        "total_lines": len(lines),
+        "constructed": counts["constructed"],
+        "fixture_missing": counts["fixture_missing"],
+        "parse_failed": counts["parse_failed"],
+        "negative_raised": counts["negative_raised"],
+        "negative_constructed": counts["negative_constructed"],
+        "shell_var_skipped": counts["shell_var_skipped"],
+        "grammar_rate_evaluable": (
+            round(grammar_ok / evaluable, 3) if evaluable else None),
+    }
+    detail = {
+        **result,
+        "by_suite": {s: dict(c) for s, c in sorted(by_suite.items())},
+        "top_failures": failures.most_common(25),
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "COMPAT_COVERAGE.json")
+    with open(out_path, "w") as fh:
+        json.dump(detail, fh, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
+    sys.stdout.flush()
+    os._exit(0)  # skip axon teardown aborts (same stance as bench.py)
