@@ -80,6 +80,22 @@ def _cmd_convert(args) -> int:
     from .runtime.describe import description_to_launch, launch_to_description
 
     text = args.input
+    if getattr(args, "pbtxt", False) or getattr(args, "from_pbtxt", False):
+        # reference tools/development/parser analog: topology <-> pbtxt
+        from .runtime.parse import parse_launch
+        from .runtime.pbtxt import from_pbtxt, to_pbtxt
+
+        if getattr(args, "from_pbtxt", False):
+            if text.endswith(".pbtxt"):
+                with open(text) as fh:
+                    text = fh.read()
+            print(from_pbtxt(text))
+        else:
+            if text.endswith(".launch"):
+                with open(text) as fh:
+                    text = fh.read().strip()
+            print(to_pbtxt(parse_launch(text)), end="")
+        return 0
     if text.endswith(".json"):
         with open(text) as fh:
             print(description_to_launch(json.load(fh)))
@@ -181,7 +197,13 @@ def main(argv=None) -> int:
     p.add_argument("element", nargs="?", default=None)
     p.set_defaults(fn=_cmd_inspect)
 
-    p = sub.add_parser("convert", help="launch text <-> JSON description")
+    p = sub.add_parser("convert", help="launch text <-> JSON description "
+                                       "(or <-> pbtxt with --pbtxt)")
+    p.add_argument("--pbtxt", action="store_true",
+                   help="emit MediaPipe-style pbtxt (reference "
+                        "tools/development/parser format)")
+    p.add_argument("--from-pbtxt", action="store_true", dest="from_pbtxt",
+                   help="rebuild a launch string from pbtxt topology")
     p.add_argument("input", help="launch string, JSON string, or file path")
     p.set_defaults(fn=_cmd_convert)
 

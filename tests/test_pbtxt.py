@@ -1,0 +1,97 @@
+"""Pipeline ↔ pbtxt conversion (runtime/pbtxt.py).
+
+Reference analog: tools/development/parser/convert.c — same emitted
+shape (calculator blocks, reference stream/node naming, sources and
+sinks as top-level streams). Properties don't round-trip (node_options
+is a TODO in the reference converter too); topology does.
+"""
+import re
+
+import pytest
+
+from nnstreamer_tpu.runtime.parse import parse_launch
+from nnstreamer_tpu.runtime.pbtxt import from_pbtxt, to_pbtxt
+
+LAUNCH = ("videotestsrc num-buffers=2 ! tensor_converter ! tee name=t "
+          "t. ! queue ! tensor_sink t. ! queue ! fakesink")
+
+
+def test_emission_matches_reference_shape():
+    pb = to_pbtxt(parse_launch(LAUNCH))
+    assert 'input_stream: "videotestsrc"' in pb
+    assert 'output_stream: "tensor_sink"' in pb
+    assert 'output_stream: "fakesink"' in pb
+    assert 'calculator: "tensor_converterCalculator"' in pb
+    # source streams carry the node name; interior pads the
+    # <element>_<node>_<pad> form (convert.c:45-63)
+    assert 'input_stream: "tensor_converter_0_0"' in pb
+    assert 'output_stream: "tee_0_0"' in pb and \
+           'output_stream: "tee_0_1"' in pb
+    # second queue instance numbers its node (convert.c:28-39)
+    assert 'output_stream: "queue_1_0"' in pb
+    # sinks do not get node blocks (reference: both-sided elements only)
+    assert "tensor_sinkCalculator" not in pb
+
+
+def test_roundtrip_topology_stable():
+    pb = to_pbtxt(parse_launch(LAUNCH))
+    back = from_pbtxt(pb)
+    p2 = parse_launch(back)  # reconstructed graph must construct
+
+    def kinds(text):
+        return sorted(re.findall(r'calculator: "(\w+)Calculator"', text))
+
+    assert kinds(to_pbtxt(p2)) == kinds(pb)
+    # fan-out survived: the tee still has two consumers
+    tee = [e for e in p2.elements.values() if e.ELEMENT_NAME == "tee"][0]
+    assert len([p for p in tee.src_pads if p.peer is not None]) == 2
+    # sinks reconstructed (heuristic attachment to dangling streams) and
+    # every producer pad is linked — no silently-discarding dead ends
+    sink_kinds = sorted(e.ELEMENT_NAME for e in p2.elements.values()
+                       if not e.src_pads)
+    assert sink_kinds == ["fakesink", "tensor_sink"]
+    for e in p2.elements.values():
+        for pad in e.src_pads:
+            assert pad.peer is not None, f"{e.name} has a dangling pad"
+
+
+def test_from_pbtxt_colon_free_node_and_nested_options():
+    """protobuf text format canonically writes 'node {' and may nest
+    option blocks — both must parse, not leak into top-level streams."""
+    pb = ('input_stream: "videotestsrc"\n'
+          'output_stream: "tensor_sink"\n'
+          'node {\n'
+          '  calculator: "tensor_converterCalculator"\n'
+          '  input_stream: "videotestsrc"\n'
+          '  output_stream: "tensor_converter_0_0"\n'
+          '  node_options: { extra: { depth: 2 } }\n'
+          '}\n')
+    back = from_pbtxt(pb)
+    p = parse_launch(back)
+    kinds = sorted(e.ELEMENT_NAME for e in p.elements.values())
+    assert kinds == ["tensor_converter", "tensor_sink", "videotestsrc"]
+
+
+def test_from_pbtxt_missing_producer_raises():
+    bad = ('input_stream: "videotestsrc"\n'
+           'node: {\n\tcalculator: "tensor_converterCalculator"\n'
+           '\tinput_stream: "ghost_0_0"\n'
+           '\toutput_stream: "tensor_converter_0_0"\n}\n')
+    with pytest.raises(ValueError, match="no producer"):
+        from_pbtxt(bad)
+
+
+def test_cli_convert_pbtxt(capsys):
+    import sys
+
+    from nnstreamer_tpu.__main__ import main
+
+    argv = sys.argv
+    sys.argv = ["nnstreamer_tpu", "convert", "--pbtxt",
+                "videotestsrc num-buffers=1 ! tensor_converter ! tensor_sink"]
+    try:
+        assert main() in (0, None)
+    finally:
+        sys.argv = argv
+    out = capsys.readouterr().out
+    assert 'calculator: "tensor_converterCalculator"' in out
