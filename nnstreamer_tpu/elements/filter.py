@@ -115,6 +115,19 @@ class TensorFilter(TransformElement):
         "input_types": Prop("", str, "force model input dtypes 'uint8,...'"),
         "output_dims": Prop("", str, "force model output dims (reference output)"),
         "output_types": Prop("", str, "force model output dtypes"),
+        # reference tensor-name props (tensorflow signature tensors);
+        # carried on the element for launch-line compat, consumed by
+        # backends that address tensors by name
+        "inputname": Prop("", str, "input tensor names 'a,b' (reference)"),
+        "outputname": Prop("", str, "output tensor names (reference)"),
+    }
+    # the reference's original property spellings (tensor_filter.c
+    # "input"/"inputtype"/"output"/"outputtype") — drop-in launch lines
+    PROP_ALIASES = {
+        "input": "input_dims",
+        "inputtype": "input_types",
+        "output": "output_dims",
+        "outputtype": "output_types",
     }
     # config-file: the generic key=value property file lives in Element
     # (reference gst_tensor_parse_config_file); _apply_config_file below

@@ -247,6 +247,9 @@ class VideoMixer(Element):
     Frames pair with tensor_mux's slowest sync; sizes must match."""
 
     ELEMENT_NAME = "videomixer"
+    # GStreamer child-proxy per-pad props ("sink_1::alpha=0.5") scale the
+    # layer's alpha in the blend below
+    ACCEPT_CHILD_PROPS = True
     SINK_TEMPLATES = (PadTemplate("sink_%u", PadDirection.SINK,
                                   Caps.new(VIDEO_MIME), PadPresence.REQUEST),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC,
@@ -269,14 +272,17 @@ class VideoMixer(Element):
             self._latest.clear()
 
     def _zordered(self):
-        """Linked sink pads in PAD-INDEX order (sink_0 = bottom layer),
+        """Linked sink pads bottom-to-top: child-proxy ``sink_N::zorder``
+        overrides when set, else PAD-INDEX order (sink_0 = bottom),
         regardless of the order the launch string linked them."""
 
-        def idx(pad):
+        def key(pad):
             _, _, n = pad.name.rpartition("_")
-            return int(n) if n.isdigit() else 0
+            idx = int(n) if n.isdigit() else 0
+            z = self.props.get(f"{pad.name}::zorder")
+            return (float(z) if z is not None else idx, idx)
 
-        return sorted((p for p in self.sink_pads if p.is_linked), key=idx)
+        return sorted((p for p in self.sink_pads if p.is_linked), key=key)
 
     def transform_caps(self, src_pad: Pad) -> Caps:
         # output geometry/format follow the bottom layer (sink_0)
@@ -311,23 +317,35 @@ class VideoMixer(Element):
             # re-pair into pad-index z-order (sink_0 bottom)
             linked = [p for p in self.sink_pads if p.is_linked]
         by_pad = dict(zip((p.name for p in linked), parts))
-        parts = [by_pad[p.name] for p in self._zordered()]
+        zpads = self._zordered()  # ONE snapshot pairs pads and frames
+        parts = [by_pad[p.name] for p in zpads]
         frames = [np.asarray(p.as_numpy().tensors[0]) for p in parts]
         base_raw = frames[0]
         if base_raw.ndim == 2:
             base_raw = base_raw[..., None]
         base_channels = base_raw.shape[-1]
         out, _base_alpha = self._rgb_alpha(base_raw)
-        for layer in frames[1:]:
+        # base-layer child alpha blends against the (black) background,
+        # like GStreamer's videomixer bottom layer
+        base_factor = float(self.props.get(f"{zpads[0].name}::alpha", 1.0))
+        if base_factor < 1.0:
+            out = out * base_factor
+        for lpad, layer in zip(zpads[1:], frames[1:]):
             if layer.shape[:2] != base_raw.shape[:2]:
                 raise ElementError(
                     f"{self.describe()}: layer size {layer.shape[:2]} != "
                     f"base {base_raw.shape[:2]} (scale upstream)")
             rgb, alpha = self._rgb_alpha(layer)
-            if alpha is None:  # opaque layer replaces
-                out = rgb
-            else:
-                out = out * (1.0 - alpha) + rgb * alpha
+            # child-proxy per-pad alpha ("sink_1::alpha=0.5") scales the
+            # layer's own alpha (opaque layers become uniformly factored)
+            factor = float(self.props.get(f"{lpad.name}::alpha", 1.0))
+            if alpha is None:
+                if factor >= 1.0:  # opaque layer replaces
+                    out = rgb
+                    continue
+                alpha = np.full(layer.shape[:2] + (1,), 1.0, np.float32)
+            alpha = alpha * factor
+            out = out * (1.0 - alpha) + rgb * alpha
         blended = np.clip(out, 0, 255).astype(np.uint8)
         if base_channels == 1:  # keep the negotiated grayscale format
             blended = np.clip(

@@ -125,10 +125,12 @@ class FileSink(SinkElement):
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
     PROPERTIES = {
         "location": Prop(None, str, "output path"),
-        # GStreamer basesink clock sync; this runtime renders as fast as
-        # upstream delivers, so the property is accepted as a no-op for
-        # reference launch-line compatibility
+        # GStreamer basesink clock sync / buffering knobs; this runtime
+        # renders as fast as upstream delivers and flushes per buffer, so
+        # both are accepted as no-ops for reference launch-line compat
         "sync": Prop(False, prop_bool, "accepted for compat (no-op)"),
+        "async": Prop(True, prop_bool, "accepted for compat (no-op)"),
+        "buffer_mode": Prop("default", str, "accepted for compat (no-op)"),
     }
 
     def start(self) -> None:

@@ -57,6 +57,13 @@ class Element:
     # media shims' downstream capsfilter search (elements/media.py
     # downstream_filter_caps) can look through them
     CAPS_TRANSPARENT: bool = False
+    # alternate property spellings (reference/GStreamer names) mapped to
+    # the canonical key, applied after dash→underscore normalization
+    PROP_ALIASES: Dict[str, str] = {}
+    # GStreamer child-proxy syntax ("sink_0::alpha=0.4"): classes that
+    # consume per-pad child properties set True; the raw value is stored
+    # under the full key for the element to interpret
+    ACCEPT_CHILD_PROPS: bool = False
     PROPERTIES: Dict[str, Prop] = {
         # reference: every tensor element carries `silent` (verbose
         # per-buffer logging when false, e.g. gsttensor_converter.c:263)
@@ -98,6 +105,10 @@ class Element:
     # -- properties ---------------------------------------------------------
     def set_property(self, key: str, value: Any) -> None:
         key = key.replace("-", "_")
+        key = self.PROP_ALIASES.get(key, key)
+        if "::" in key and self.ACCEPT_CHILD_PROPS:
+            self.props[key] = value  # per-pad child property, raw
+            return
         if key == "name":
             self.name = str(value)
             return
@@ -139,6 +150,7 @@ class Element:
                 if not ln or ln.startswith("#"):
                     continue
                 key = ln.split("=", 1)[0].strip().replace("-", "_")
+                key = self.PROP_ALIASES.get(key, key)
                 if "=" in ln and (key in self._prop_defs
                                   or key in ("name", "config_file")):
                     k, v = ln.split("=", 1)

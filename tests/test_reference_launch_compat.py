@@ -273,3 +273,77 @@ def test_arithmetic_extra_colon_value_uses_first():
     pipe.get("out").connect(got.append)
     pipe.play(); pipe.wait(timeout=30); pipe.stop()
     np.testing.assert_allclose(np.asarray(got[0].tensors[0]), 0.99, rtol=1e-6)
+
+
+def test_filter_reference_property_spellings():
+    """The reference's original tensor_filter property names (input/
+    inputtype/output/outputtype) alias to the forced-dims props."""
+    pipe = parse_launch(
+        "appsrc name=in caps=other/tensors,format=static,"
+        "dimensions=4,types=float32 "
+        "! tensor_filter framework=jax model=builtin://passthrough "
+        "input=4 inputtype=float32 output=4 outputtype=float32 name=f "
+        "! tensor_sink name=out")
+    f = pipe.get("f")
+    assert f.props["input_dims"] == "4"
+    assert f.props["input_types"] == "float32"
+    assert f.props["output_dims"] == "4"
+    assert f.props["output_types"] == "float32"
+
+
+def test_videomixer_child_proxy_alpha():
+    """GStreamer child-proxy syntax sink_1::alpha scales the layer."""
+    import numpy as np
+
+    from nnstreamer_tpu.elements.src import AppSrc  # noqa: F401
+
+    pipe = parse_launch(
+        "videomixer name=mix sink_0::zorder=0 sink_1::alpha=0.5 "
+        "! tensor_converter ! tensor_sink name=out "
+        "appsrc name=a caps=video/x-raw,format=RGB,width=2,height=2,"
+        "framerate=0/1 ! mix.sink_0 "
+        "appsrc name=b caps=video/x-raw,format=RGB,width=2,height=2,"
+        "framerate=0/1 ! mix.sink_1")
+    got = []
+    pipe.get("out").connect(got.append)
+    pipe.play()
+    base = np.zeros((2, 2, 3), np.uint8)
+    layer = np.full((2, 2, 3), 200, np.uint8)
+    pipe.get("a").push_buffer(base)
+    pipe.get("b").push_buffer(layer)
+    deadline = __import__("time").monotonic() + 10
+    while not got and __import__("time").monotonic() < deadline:
+        __import__("time").sleep(0.02)
+    pipe.stop()
+    assert got, "no mixed frame"
+    mixed = np.asarray(got[0].tensors[0]).reshape(2, 2, 3)
+    # 0*(1-0.5) + 200*0.5 = 100
+    assert np.all(mixed == 100)
+
+
+def test_videomixer_child_proxy_zorder_reorders_stack():
+    """sink_N::zorder overrides pad-index stacking (reference launch
+    lines set it explicitly)."""
+    import time
+
+    import numpy as np
+
+    pipe = parse_launch(  # zorder swaps the stack: sink_0 on TOP
+        "videomixer name=mix sink_0::zorder=1 sink_1::zorder=0 "
+        "! tensor_sink name=out max-stored=2 "
+        "appsrc name=a caps=video/raw,format=RGB,width=2,height=2 "
+        "! mix.sink_0 "
+        "appsrc name=b caps=video/raw,format=RGB,width=2,height=2 "
+        "! mix.sink_1")
+    got = []
+    pipe.get("out").connect(got.append)
+    pipe.play()
+    pipe.get("a").push_buffer(np.full((2, 2, 3), 10, np.uint8))
+    pipe.get("b").push_buffer(np.full((2, 2, 3), 200, np.uint8))
+    deadline = time.monotonic() + 10
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.02)
+    pipe.stop()
+    assert got
+    # sink_0 (value 10) is the TOP opaque layer now — it wins
+    assert np.all(np.asarray(got[0].tensors[0]) == 10)

@@ -43,17 +43,101 @@ def _unescape(s: str) -> str:
     return re.sub(r'\\(.)', r'\1', s)
 
 
+_FUNC_HEAD_RE = re.compile(r"(?:function\s+)?(\w+)\s*\(\)\s*\{")
+_ASSIGN_RE = re.compile(r'^(\w+)=("[^"$`]*"|[^\s$`;&|()<>]+)\s*$', re.M)
+
+
+def _subst_env(line: str, env: dict) -> str:
+    for k, v in env.items():
+        if v is None:
+            continue
+        line = line.replace("${%s}" % k, v)
+        line = re.sub(rf"\${k}(?![A-Za-z0-9_])",
+                      v.replace("\\", r"\\"), line)
+    return line
+
+
+def _expand_shell(text: str) -> str:
+    """Best-effort shell expansion so more corpus lines are evaluable:
+    parameterized SSAT helpers (``function do_test() { gstTest "...${1}..."
+    }``) are inlined IN PLACE at each call site with positional
+    substitution (zero-arg calls included), and scalar assignments apply
+    POSITIONALLY — a ``PATH_TO_MODEL=`` reassigned mid-file substitutes
+    the value in force at each line, not last-assignment-wins. Anything
+    still carrying ``$`` afterwards is classified shell_var_skipped as
+    before — expansion only ADDS evaluable lines, never guesses."""
+    import shlex
+
+    # 1. function bodies (balanced braces), cut from the scan text so
+    # their unexpanded gstTest lines aren't double counted
+    funcs = {}
+    spans = []
+    for m in _FUNC_HEAD_RE.finditer(text):
+        depth, i = 1, m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        funcs[m.group(1)] = text[m.end():i - 1]
+        spans.append((m.start(), i))
+    remainder_parts = []
+    pos = 0
+    for a, b in spans:
+        remainder_parts.append(text[pos:a])
+        pos = b
+    remainder_parts.append(text[pos:])
+    remainder = "".join(remainder_parts)
+
+    # 2. inline calls IN PLACE (preserves assignment ordering relative to
+    # the instantiated gstTest lines); zero-arg invocations included
+    for name, body in funcs.items():
+        if "gstTest" not in body:
+            continue
+
+        def _inline(call, _body=body):
+            try:
+                args = shlex.split(call.group(1) or "")
+            except ValueError:
+                return call.group(0)
+            inst = _body
+            for idx, val in enumerate(args[:9], start=1):
+                inst = inst.replace("${%d}" % idx, val)
+                inst = re.sub(rf"\${idx}(?![0-9])", val, inst)
+            return inst
+
+        remainder = re.sub(rf"^[ \t]*{name}(?:[ \t]+([^\n]*))?$", _inline,
+                           remainder, flags=re.M)
+
+    # 3. positional scalar substitution: walk lines, env updates as
+    # assignments appear (var-in-var resolved against the env so far)
+    env: dict = {}
+    out_lines = []
+    for line in remainder.splitlines():
+        am = _ASSIGN_RE.match(line)
+        if am:
+            val = _subst_env(am.group(2).strip('"'), env)
+            env[am.group(1)] = None if "$" in val else val
+            out_lines.append(line)
+            continue
+        out_lines.append(_subst_env(line, env))
+    return "\n".join(out_lines)
+
+
 def collect_lines():
     out = []
     for root, _dirs, files in os.walk(os.path.join(REF, "tests")):
         if "runTest.sh" not in files:
             continue
         suite = os.path.basename(root)
-        text = open(os.path.join(root, "runTest.sh"),
-                    errors="replace").read()
+        text = _expand_shell(open(os.path.join(root, "runTest.sh"),
+                                  errors="replace").read())
         for m in _GSTTEST.finditer(text):
             line = _unescape(m.group(1))
             line = _PLUGIN_PATH.sub("", line).strip()
+            # launcher flags, not pipeline grammar
+            line = re.sub(r"^(-v|--verbose)\s+", "", line)
             # SSAT gstTest args: <case> <ignore> <expectFail> ... — the
             # reference's NEGATIVE tests (expectFail=1) are lines that
             # MUST fail; they are scored separately (error compat)
