@@ -149,6 +149,10 @@ class VideoTestSrc(_PacedSource):
         "height": Prop(240, int),
         "format": Prop("RGB", str, "RGB | BGR | GRAY8 | RGBA | BGRx"),
         "pattern": Prop("gradient", str, "gradient | solid | checkers | counter"),
+        # GStreamer live-source pacing: this runtime is backpressure-
+        # driven (no pipeline clock), so accepted as a no-op for the
+        # reference's launch lines
+        "is_live": Prop(False, prop_bool, "accepted for compat (no-op)"),
     }
 
     _CHANNELS = {"RGB": 3, "BGR": 3, "GRAY8": 1, "RGBA": 4, "BGRx": 4}

@@ -122,6 +122,17 @@ class TensorQueryClient(Element):
                                  "seconds without a successful reconnect"),
         "max_reconnect_delay": Prop(2.0, float,
                                     "backoff cap between reconnect attempts"),
+        # the reference's four-property split (tensor_query_client.c):
+        # host/port there are the CLIENT's bind address, dest-host/
+        # dest-port the server. Here host/port already mean the server
+        # (kept for back-compat); dest-* take precedence when set, so
+        # reference lines work in ANY property order.
+        "dest_host": Prop("", str,
+                          "server host (reference dest-host; overrides "
+                          "host when set)"),
+        "dest_port": Prop(0, int,
+                          "server port (reference dest-port; overrides "
+                          "port when set)"),
     }
 
     def __init__(self, name=None, **props):
@@ -134,8 +145,14 @@ class TensorQueryClient(Element):
         self._got_input_eos = False
         self._reconnect_error: Optional[str] = None
 
+    def _server_addr(self):
+        """dest-host/dest-port (reference spellings) override host/port
+        when set — order-independent, matching the reference's split."""
+        return (self.props["dest_host"] or self.props["host"],
+                self.props["dest_port"] or self.props["port"])
+
     def _new_client(self) -> QueryClient:
-        host, port = self.props["host"], self.props["port"]
+        host, port = self._server_addr()
         if self.props["connect_type"] == "HYBRID":
             # re-discovered on EVERY connect (incl. reconnects): a server
             # that came back on a different address is found via the broker
@@ -206,7 +223,7 @@ class TensorQueryClient(Element):
                 if old is not None:
                     old.close()  # release the dead link's fd + reader
                 logger.info("%s: reconnected to %s:%s", self.name,
-                            self.props["host"], self.props["port"])
+                            *self._server_addr())
                 if self._got_input_eos:
                     # upstream EOS fired while the link was down; the dead
                     # socket swallowed it — re-send so the new server drains

@@ -347,3 +347,19 @@ def test_videomixer_child_proxy_zorder_reorders_stack():
     assert got
     # sink_0 (value 10) is the TOP opaque layer now — it wins
     assert np.all(np.asarray(got[0].tensors[0]) == 10)
+
+
+def test_query_client_reference_property_spellings():
+    """dest-host/dest-port (tensor_query_client.c spellings) alias to
+    host/port; videotestsrc accepts is-live."""
+    pipe = parse_launch(
+        "videotestsrc is-live=true num-buffers=1 ! tensor_converter ! "
+        "tensor_query_client name=q dest-host=127.0.0.1 dest-port=39999 "
+        "reconnect=false ! tensor_sink")
+    q = pipe.get("q")
+    # dest-* are their own props (the reference's four-property split)
+    # and take precedence over host/port at connect time regardless of
+    # property order
+    assert q.props["dest_host"] == "127.0.0.1"
+    assert q.props["dest_port"] == 39999
+    assert q._server_addr() == ("127.0.0.1", 39999)

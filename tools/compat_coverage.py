@@ -68,6 +68,11 @@ def _expand_shell(text: str) -> str:
     before — expansion only ADDS evaluable lines, never guesses."""
     import shlex
 
+    # 0. normalize $VAR to ${VAR} so later textual substitutions can't
+    # merge a variable with adjacent substituted text ("$A$B" with B→x
+    # must become "${A}x", never the new variable "$Ax")
+    text = re.sub(r"\$([A-Za-z_]\w*)", r"${\1}", text)
+
     # 1. function bodies (balanced braces), cut from the scan text so
     # their unexpanded gstTest lines aren't double counted
     funcs = {}
@@ -110,9 +115,38 @@ def _expand_shell(text: str) -> str:
         remainder = re.sub(rf"^[ \t]*{name}(?:[ \t]+([^\n]*))?$", _inline,
                            remainder, flags=re.M)
 
-    # 3. positional scalar substitution: walk lines, env updates as
-    # assignments appear (var-in-var resolved against the env so far)
-    env: dict = {}
+    # 3. simple for-loops over literal word lists instantiate per value,
+    # matching BOTH the same-line "for X in a b; do" form (the corpus's
+    # style) and newline-do. The body is tempered to contain no nested
+    # `for`, so the INNERMOST loop unrolls first and repeated passes
+    # expand outward — never across half-instantiated fragments.
+    loop_re = re.compile(
+        r"^[ \t]*for[ \t]+(\w+)[ \t]+in[ \t]+([^\n;$`]+?)[ \t]*;?"
+        r"(?:[ \t]*\n[ \t]*|[ \t]+)do\b"
+        r"((?:(?!^[ \t]*for[ \t]).)*?)^[ \t]*done[ \t]*$",
+        re.M | re.S)
+
+    def _unroll(m):
+        var, words, body = m.group(1), m.group(2).split(), m.group(3)
+        insts = []
+        for w in words:
+            inst = body.replace("${%s}" % var, w)
+            inst = re.sub(rf"\${var}(?![A-Za-z0-9_])", w, inst)
+            insts.append(inst)
+        return "\n".join(insts)
+
+    for _ in range(3):  # nesting depth
+        new = loop_re.sub(_unroll, remainder)
+        if new == remainder:
+            break
+        remainder = new
+
+    # 4. positional scalar substitution: walk lines, env updates as
+    # assignments appear (var-in-var resolved against the env so far).
+    # Harness-only vars whose VALUE is grammar-irrelevant get synthetic
+    # defaults (ports from get_available_port, platform .so extension).
+    env: dict = {"PORT": "5000", "PORT1": "5001", "PORT2": "5002",
+                 "SO_EXT": "so"}
     out_lines = []
     for line in remainder.splitlines():
         am = _ASSIGN_RE.match(line)
