@@ -184,3 +184,20 @@ class TestFilterAliases:
         from nnstreamer_tpu.registry.config import get_config
 
         assert get_config().filter_alias("jax") == "jax"
+
+
+def test_prop_aliases_apply_in_config_files(tmp_path):
+    """Element.PROP_ALIASES (reference property spellings) must work in
+    config-file lines exactly like on the launch line."""
+    from nnstreamer_tpu.runtime.parse import parse_launch
+
+    cfg = tmp_path / "f.conf"
+    cfg.write_text("input=4\ninputtype=float32\n")
+    pipe = parse_launch(
+        "appsrc name=in caps=other/tensors,format=static,"
+        "dimensions=4,types=float32 "
+        "! tensor_filter framework=jax model=builtin://passthrough "
+        f"config-file={cfg} name=f ! tensor_sink")
+    f = pipe.get("f")
+    assert f.props["input_dims"] == "4"
+    assert f.props["input_types"] == "float32"
