@@ -56,11 +56,30 @@ def make_transpose(axes: Sequence[int]) -> Callable:
     return fn
 
 
-def make_arithmetic(ops: Sequence[Tuple[str, float]],
-                    out_dtype: DataType | None = None) -> Callable:
-    """Chained scalar arithmetic: [("add", 1), ("mul", 0.5), ...] — the
-    reference's operator-chain syntax ``add:1,mul:0.5`` incl. per-channel
-    variants handled by broadcasting."""
+def _arith_apply(op: str, y, val: float):
+    if op == "add":
+        return y + val
+    if op == "sub":
+        return y - val
+    if op == "mul":
+        return y * val
+    if op == "div":
+        return y / val
+    if op == "pow":
+        return y ** val
+    raise ValueError(f"unknown arithmetic op '{op}'")
+
+
+def make_arithmetic(ops: Sequence[Tuple],
+                    out_dtype: DataType | None = None,
+                    per_channel_dim: int | None = None) -> Callable:
+    """Chained scalar arithmetic: entries ``(op, value[, channel])`` — the
+    reference's operator-chain syntax ``add:1,mul:0.5`` plus per-channel
+    ops (``per-channel:true@DIM,add:V@CH``): with ``per_channel_dim`` set,
+    an entry carrying a channel index applies only to that slice of the
+    channel axis. The reference counts dims lowest-first (dim 0 = the
+    fastest-varying axis, e.g. RGB channels of ``3:W:H:1``) — python axis
+    ``ndim - 1 - DIM``."""
     import jax.numpy as jnp
 
     def fn(x):
@@ -69,19 +88,20 @@ def make_arithmetic(ops: Sequence[Tuple[str, float]],
             y = y.astype(jnp.dtype(out_dtype.np_dtype))
         elif not np.issubdtype(np.dtype(str(x.dtype)), np.floating):
             y = y.astype(jnp.float32)  # reference promotes int arith to float
-        for op, val in ops:
-            if op == "add":
-                y = y + val
-            elif op == "sub":
-                y = y - val
-            elif op == "mul":
-                y = y * val
-            elif op == "div":
-                y = y / val
-            elif op == "pow":
-                y = y ** val
+        for entry in ops:
+            op, val, ch = entry if len(entry) == 3 else (*entry, None)
+            if ch is None or per_channel_dim is None:
+                y = _arith_apply(op, y, val)
             else:
-                raise ValueError(f"unknown arithmetic op '{op}'")
+                axis = y.ndim - 1 - per_channel_dim
+                if not 0 <= axis < y.ndim:
+                    raise ValueError(
+                        f"per-channel dim {per_channel_dim} out of range "
+                        f"for rank-{y.ndim} tensor")
+                idx = [slice(None)] * y.ndim
+                idx[axis] = ch
+                idx = tuple(idx)
+                y = y.at[idx].set(_arith_apply(op, y[idx], val))
         return y
 
     return fn
@@ -141,8 +161,9 @@ def parse_transform_options(mode: str, option: str):
     if mode == "typecast":
         return make_typecast(DataType.from_any(option.strip()))
     if mode == "arithmetic":
-        ops: List[Tuple[str, float]] = []
+        ops: List[Tuple] = []
         out_dtype = None
+        pc_dim = None
         for part in option.split(","):
             part = part.strip()
             if not part:
@@ -151,13 +172,22 @@ def parse_transform_options(mode: str, option: str):
             op = op.strip().lower()
             if op == "typecast":
                 out_dtype = DataType.from_any(val.strip())
+            elif op == "per-channel":
+                # reference grammar: per-channel:(false|true@DIM) — only
+                # enabled when the @DIM is present (gsttensor_transform.c
+                # :760-768 requires num_values > 1)
+                flag, _, dim = val.partition("@")
+                if flag.strip().lower() == "true" and dim:
+                    pc_dim = int(dim)
             else:
-                # reference grammar allows extra colon values per op
-                # ("add:A:B"); without per-channel mode it uses ONLY the
-                # first (gsttensor_transform.c:794-807, values[0]) —
-                # match that for drop-in compat
-                ops.append((op, parse_number(val.split(":")[0])))
-        return make_arithmetic(ops, out_dtype)
+                # reference grammar: op:NUMBER[@CH_IDX][:NUMBER...] — the
+                # value is values[0]; @CH binds the op to one channel in
+                # per-channel mode (gsttensor_transform.c:790-812)
+                first = val.split(":")[0]
+                num, _, ch = first.partition("@")
+                ops.append((op, parse_number(num),
+                            int(ch) if ch else None))
+        return make_arithmetic(ops, out_dtype, per_channel_dim=pc_dim)
     if mode == "transpose":
         return make_transpose([int(p) for p in option.split(":")])
     if mode == "dimchg":

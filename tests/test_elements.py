@@ -892,3 +892,44 @@ class TestAudioConverter:
         msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
         pipe.stop()
         assert msg is not None
+
+
+class TestPerChannelArithmetic:
+    """Reference per-channel arithmetic grammar
+    (per-channel:true@DIM,op:V@CH — gsttensor_transform.c:756-812):
+    ops with @CH apply only to that channel of nns-dim DIM (dim 0 =
+    fastest axis = our last)."""
+
+    def test_per_channel_add_one_channel(self):
+        import numpy as np
+
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=3:2:2:1,types=float32 "
+            "! tensor_transform mode=arithmetic "
+            "option=per-channel:true@0,add:255@0,mul:2@2 "
+            "! tensor_sink name=out")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        x = np.ones((1, 2, 2, 3), np.float32)
+        pipe.get("in").push_buffer(x)
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=20)
+        pipe.stop()
+        y = np.asarray(got[0].tensors[0])
+        np.testing.assert_allclose(y[..., 0], 256.0)  # add:255@0
+        np.testing.assert_allclose(y[..., 1], 1.0)    # untouched
+        np.testing.assert_allclose(y[..., 2], 2.0)    # mul:2@2
+
+    def test_without_per_channel_ch_suffix_applies_globally(self):
+        import numpy as np
+
+        from nnstreamer_tpu.ops.transform_ops import parse_transform_options
+
+        # matches the reference: @CH without per-channel mode is ignored
+        fn = parse_transform_options("arithmetic", "add:5@1")
+        y = np.asarray(fn(np.zeros((2, 3), np.float32)))
+        np.testing.assert_allclose(y, 5.0)

@@ -32,14 +32,18 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 REF = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
 
-_GSTTEST = re.compile(r'gstTest\s+"((?:[^"\\]|\\.)*)"\s*([^\n]*)')
+# re.S: the corpus writes multi-line pipelines with backslash-newline
+# continuations inside the quoted string — '\\.' must match them
+_GSTTEST = re.compile(r'gstTest\s+"((?:[^"\\]|\\.)*)"\s*([^\n]*)', re.S)
 # the harness always passes the plugin path first; not part of the line
 _PLUGIN_PATH = re.compile(r"--gst-plugin-path=\S+\s*")
 _SHELL_VAR = re.compile(r"\$\{?[A-Za-z0-9_#@*]+\}?|\$\(")
 
 
 def _unescape(s: str) -> str:
-    # shell double-quote escapes: \" \( \) \$ \\ — drop the backslash
+    # shell line continuations (backslash-newline) join with a space,
+    # then double-quote escapes \" \( \) \$ \\ drop the backslash
+    s = re.sub(r"\\\n[ \t]*", " ", s)
     return re.sub(r'\\(.)', r'\1', s)
 
 
