@@ -1,0 +1,42 @@
+"""``nnstreamer_python`` drop-in: the helper module reference user scripts
+import (ext/nnstreamer/extra/nnstreamer_python3_helper.cc TensorShape —
+init(dims, type), getDims, getType, setDims, setType).
+
+Scripts use it as::
+
+    import nnstreamer_python as nns
+    shape = nns.TensorShape([3, 224, 224, 1], np.float32)
+    shape.getDims()          # -> [3, 224, 224, 1]
+    shape.getType().type     # -> numpy scalar type (getType returns np.dtype)
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class TensorShape:
+    """Reference TensorShape: a (dims, numpy dtype) pair."""
+
+    def __init__(self, dims: Optional[Sequence[int]] = None, type=np.uint8):
+        self._dims: List[int] = []
+        self._type = np.dtype(type)
+        if dims is not None:
+            self.setDims(dims)
+
+    def setDims(self, dims: Sequence[int]) -> None:
+        # reference caps dims at NNS_TENSOR_RANK_LIMIT and int-casts
+        self._dims = [int(d) for d in dims][:16]
+
+    def getDims(self) -> List[int]:
+        return self._dims
+
+    def setType(self, type) -> None:
+        self._type = np.dtype(type)
+
+    def getType(self) -> np.dtype:
+        return self._type
+
+    def __repr__(self) -> str:  # debugging nicety, not reference API
+        return f"TensorShape({self._dims}, {self._type})"

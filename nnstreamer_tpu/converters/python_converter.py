@@ -3,16 +3,55 @@
 Reference analog: the python3 custom converter in
 ``ext/nnstreamer/tensor_converter/`` (embedded CPython user converter,
 SURVEY.md §2.6). The ``tensor_converter`` element selects it via
-``subplugin=python3 subplugin-option=<file.py>``; the file defines class
-``Converter`` with ``get_out_info(in_caps)`` and ``convert(buf)``
-(the base.Converter API).
+``subplugin=python3 subplugin-option=<file.py>`` or the reference spelling
+``mode=custom-script:<file.py>``; the file defines EITHER
+
+* class ``Converter`` with ``get_out_info(in_caps)`` / ``convert(buf)``
+  (this framework's base.Converter API), or
+* class ``CustomConverter`` with ``convert(input_array)`` returning
+  ``(tensors_info, raw_data, rate_n, rate_d)`` — the REFERENCE's user API
+  (tensor_converter_python3: list of numpy arrays in, a list of
+  ``nnstreamer_python.TensorShape`` + raw byte buffers out). Reference
+  scripts run unmodified via the compat shim.
 """
 from __future__ import annotations
 
 from typing import Optional
 
-from ..core import Buffer, Caps, TensorsInfo
+import numpy as np
+
+from ..core import Buffer, Caps, TensorFormat, TensorsInfo
 from .base import Converter, register_converter
+
+
+class _ReferenceScriptConverter:
+    """Adapter: reference CustomConverter → base.Converter surface."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def get_out_info(self, in_caps: Caps) -> TensorsInfo:
+        return TensorsInfo((), TensorFormat.FLEXIBLE)  # shapes ride per frame
+
+    def convert(self, buf: Buffer) -> Optional[Buffer]:
+        arrays_in = [np.ascontiguousarray(np.asarray(t)) for t in buf.tensors]
+        result = self._inner.convert(arrays_in)
+        if result is None:
+            return None
+        shapes, raw_data, rate_n, rate_d = result
+        arrays = []
+        for shape, raw in zip(shapes, raw_data):
+            dtype = np.dtype(shape.getType())
+            # nnstreamer dim order is fastest-axis-first → reverse for numpy
+            dims = [int(d) for d in reversed(shape.getDims())]
+            arrays.append(np.frombuffer(
+                np.ascontiguousarray(np.asarray(raw)).tobytes(), dtype
+            ).reshape(dims))
+        out = Buffer(arrays)
+        out.pts = buf.pts
+        if (rate_n, rate_d) != (0, 0):
+            out.meta["framerate"] = (int(rate_n), int(rate_d))
+        return out
 
 
 @register_converter
@@ -23,13 +62,22 @@ class PythonConverter(Converter):
         path = option
         if not path:
             raise ValueError("python3 converter: needs subplugin-option=<file.py>")
+        from ..compat import install_nnstreamer_python
+
+        install_nnstreamer_python()
         ns: dict = {"__file__": path}
         with open(path) as fh:
             exec(compile(fh.read(), path, "exec"), ns)  # noqa: S102 - user code
         cls = ns.get("Converter")
-        if cls is None:
-            raise ValueError(f"{path}: must define class 'Converter'")
-        self._inner = cls()
+        if cls is not None:
+            self._inner = cls()
+            return
+        ref_cls = ns.get("CustomConverter")
+        if ref_cls is None:
+            raise ValueError(
+                f"{path}: must define class 'Converter' (native API) or "
+                "'CustomConverter' (reference converter-python3 API)")
+        self._inner = _ReferenceScriptConverter(ref_cls())
 
     def get_out_info(self, in_caps: Caps) -> TensorsInfo:
         return self._inner.get_out_info(in_caps)

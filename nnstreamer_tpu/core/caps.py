@@ -269,12 +269,15 @@ def tensors_any_caps() -> Caps:
 
 
 # IDL byte-stream MIMEs (reference: other/protobuf-tensor caps of
-# ext/nnstreamer/extra/nnstreamer_protobuf.h, flatbuf analog)
+# ext/nnstreamer/extra/nnstreamer_protobuf.h, flatbuf analog; other/flexbuf
+# is the tensordec-flexbuf.cc output MIME the corpus pipes through
+# capsfilters: ``tensor_decoder mode=flexbuf ! other/flexbuf ! ...``)
 PROTOBUF_MIME = "other/protobuf-tensor"
 FLATBUF_MIME = "other/flatbuf-tensor"
+FLEXBUF_MIME = "other/flexbuf"
 
 ALL_MIMES = (TENSORS_MIME, VIDEO_MIME, AUDIO_MIME, TEXT_MIME, OCTET_MIME,
-             PROTOBUF_MIME, FLATBUF_MIME,
+             PROTOBUF_MIME, FLATBUF_MIME, FLEXBUF_MIME,
              # compressed-image streams (filesrc ! image/png,... ! pngdec —
              # the reference test idiom; imagedec sniffs the actual codec)
              "image/png", "image/jpeg", "image/bmp",

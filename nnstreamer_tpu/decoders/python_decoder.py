@@ -1,15 +1,53 @@
 """User-python decoder (L4).
 
 Reference analog: ``tensordec-python3.cc`` (393 LoC — embedded CPython user
-decoder class). option1 = path to a .py file defining class ``Decoder`` with
-``get_out_caps(in_info)`` and ``decode(buf, in_info)`` (base.Decoder API).
+decoder class). option1 = path to a .py file defining EITHER
+
+* class ``Decoder`` with ``get_out_caps(in_info)`` / ``decode(buf, in_info)``
+  (this framework's base.Decoder API), or
+* class ``CustomDecoder`` with ``getOutCaps()`` / ``decode(raw_data,
+  in_info, rate_n, rate_d)`` — the REFERENCE's user API
+  (tensordec-python3.cc decode_: raw bytes per tensor, a list of
+  ``nnstreamer_python.TensorShape`` in nnstreamer dim order, the frame
+  rate; returns the encoded byte payload). Reference-written scripts run
+  unmodified: ``import nnstreamer_python`` resolves to our shim
+  (compat/nnstreamer_python.py).
 """
 from __future__ import annotations
 
 from typing import Optional
 
-from ..core import Buffer, Caps, TensorsInfo
+import numpy as np
+
+from ..core import Buffer, Caps, TensorsInfo, parse_caps_string
 from .base import Decoder, register_decoder
+
+
+class _ReferenceScriptDecoder:
+    """Adapter: reference CustomDecoder → base.Decoder surface."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
+        raw = self._inner.getOutCaps()
+        if isinstance(raw, bytes):
+            raw = raw.decode()
+        return parse_caps_string(str(raw))
+
+    def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
+        from ..compat.nnstreamer_python import TensorShape
+
+        arrays = [np.ascontiguousarray(np.asarray(t)) for t in buf.tensors]
+        raw_data = [a.tobytes() for a in arrays]
+        # nnstreamer dim order is fastest-axis-first — the reverse of the
+        # numpy shapes this runtime carries
+        shapes = [TensorShape(list(reversed(a.shape)), a.dtype) for a in arrays]
+        rate_n, rate_d = buf.meta.get("framerate", (0, 1))
+        payload = self._inner.decode(raw_data, shapes, int(rate_n), int(rate_d))
+        if payload is None:
+            return None
+        return Buffer([np.frombuffer(bytes(payload), np.uint8)])
 
 
 @register_decoder
@@ -21,15 +59,24 @@ class PythonDecoder(Decoder):
         path = self.option(1)
         if not path:
             raise ValueError("python3 decoder: option1 must be a .py file")
+        from ..compat import install_nnstreamer_python
+
+        install_nnstreamer_python()
         ns: dict = {"__file__": path}
         with open(path) as fh:
             exec(compile(fh.read(), path, "exec"), ns)  # noqa: S102 - user decoder
         cls = ns.get("Decoder")
-        if cls is None:
-            raise ValueError(f"{path}: must define class 'Decoder'")
-        self._inner = cls()
-        if hasattr(self._inner, "init"):
-            self._inner.init(options[1:])
+        if cls is not None:
+            self._inner = cls()
+            if hasattr(self._inner, "init"):
+                self._inner.init(options[1:])
+            return
+        ref_cls = ns.get("CustomDecoder")
+        if ref_cls is None:
+            raise ValueError(
+                f"{path}: must define class 'Decoder' (native API) or "
+                "'CustomDecoder' (reference tensordec-python3 API)")
+        self._inner = _ReferenceScriptDecoder(ref_cls())
 
     def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
         return self._inner.get_out_caps(in_info)

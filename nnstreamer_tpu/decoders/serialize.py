@@ -15,7 +15,7 @@ from typing import Optional
 import numpy as np
 
 from ..core import Buffer, Caps, TensorFormat, TensorsInfo
-from ..core.caps import FLATBUF_MIME, OCTET_MIME, PROTOBUF_MIME
+from ..core.caps import FLATBUF_MIME, FLEXBUF_MIME, PROTOBUF_MIME
 from ..core.serialize import pack_tensors
 from .base import Decoder, register_decoder
 
@@ -25,7 +25,9 @@ class FlexBuf(Decoder):
     MODE = "flexbuf"
 
     def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
-        return Caps.new(OCTET_MIME, framed="tensors")
+        # reference MIME (tensordec-flexbuf.cc): the corpus constrains the
+        # stream with ``! other/flexbuf !`` capsfilters downstream
+        return Caps.new(FLEXBUF_MIME)
 
     def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
         return Buffer([np.frombuffer(pack_tensors(buf), np.uint8)])

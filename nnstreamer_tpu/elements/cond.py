@@ -20,7 +20,7 @@ from ..core import Buffer, Caps
 from ..core.data import parse_number
 from ..registry.elements import register_element
 from ..runtime.element import ElementError, Prop, TransformElement
-from ..runtime.pad import Pad, PadDirection, PadTemplate
+from ..runtime.pad import Pad, PadDirection, PadPresence, PadTemplate
 
 _custom_conditions: Dict[str, Callable] = {}
 
@@ -53,7 +53,16 @@ _OPERATORS = {
 class TensorIf(TransformElement):
     ELEMENT_NAME = "tensor_if"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
-    SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    # static "src" merges both branches onto one stream; the reference
+    # instead creates src_%d pads on demand with THEN routed to src_0 and
+    # ELSE to src_1 (gsttensor_if.c TIFSP_THEN_PAD/TIFSP_ELSE_PAD,
+    # gst_tensor_if_get_tensor_pad) — the corpus's ``tif.src_0 !`` /
+    # ``tif.src_1 !`` spelling requests exactly those
+    SRC_TEMPLATES = (
+        PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),
+        PadTemplate("src_%u", PadDirection.SRC, Caps.new("other/tensors"),
+                    PadPresence.REQUEST),
+    )
     PROPERTIES = {
         "compared_value": Prop("a-value", str,
                                "a-value | tensor-total-value | tensor-average-value | custom"),
@@ -73,33 +82,46 @@ class TensorIf(TransformElement):
     }
 
     # -- negotiation --------------------------------------------------------
+    _BRANCHES = (("then", "then_option"), ("else", "else_option"))
+
+    def _branch_selection(self, action_key: str, option_key: str):
+        """Tensor indices a branch emits: list = tensorpick subset, None =
+        full set, 'inherit' = no shape of its own (skip/repeat-previous)."""
+        action = self.props[action_key]
+        if action in ("skip", "repeat-previous"):
+            return "inherit"
+        if action == "tensorpick":
+            return [int(p) for p in str(self.props[option_key] or "0").split(",")]
+        return None
+
     def transform_caps(self, src_pad):
         """tensorpick changes the stream's tensor count — src caps must
-        reflect it (reference adjusts caps for TENSORPICK). Branches that
-        emit data must agree on the selection; skip branches don't count."""
+        reflect it (reference adjusts caps for TENSORPICK). On the merged
+        static ``src`` all emitting branches must agree; the reference's
+        dynamic pads (``src_0`` = then, ``src_1`` = else,
+        gsttensor_if.c TIFSP_*_PAD) each carry their own branch's shape."""
         from ..core import TensorsInfo, caps_from_tensors_info, tensors_info_from_caps
 
         in_caps = self.sink_pads[0].caps
-        # collect each emitting branch's selection (None = full tensor set);
-        # all emitting branches must agree, regardless of then/else order
-        selections = []
-        for action_key, option_key in (("then", "then_option"), ("else", "else_option")):
-            action = self.props[action_key]
-            if action in ("skip", "repeat-previous"):
-                # no selection of their own: skip emits nothing and
-                # repeat-previous re-emits whatever the other branch shaped
-                continue
-            selections.append(
-                [int(p) for p in str(self.props[option_key] or "0").split(",")]
-                if action == "tensorpick"
-                else None  # full tensor set
-            )
-        if len(set(map(repr, selections))) > 1:
-            raise ElementError(
-                f"{self.describe()}: then/else branches emit different "
-                "tensor selections; caps would be inconsistent"
-            )
-        picks = selections[0] if selections else None
+        then_sel = self._branch_selection(*self._BRANCHES[0])
+        else_sel = self._branch_selection(*self._BRANCHES[1])
+        if src_pad.name == "src_0":
+            # skip emits nothing (caps moot); repeat-previous re-emits
+            # whatever the other branch shaped
+            picks = then_sel if then_sel != "inherit" else else_sel
+            picks = None if picks == "inherit" else picks
+        elif src_pad.name == "src_1":
+            picks = else_sel if else_sel != "inherit" else then_sel
+            picks = None if picks == "inherit" else picks
+        else:
+            # merged single-src: emitting branches must agree
+            selections = [s for s in (then_sel, else_sel) if s != "inherit"]
+            if len(set(map(repr, selections))) > 1:
+                raise ElementError(
+                    f"{self.describe()}: then/else branches emit different "
+                    "tensor selections; caps would be inconsistent"
+                )
+            picks = selections[0] if selections else None
         if picks is None:
             return in_caps
         info = tensors_info_from_caps(in_caps)
@@ -199,11 +221,27 @@ class TensorIf(TransformElement):
         super().reset_flow()
         self._prev_out = None
 
-    def transform(self, buf: Buffer) -> Optional[Buffer]:
-        if self._evaluate(buf):
-            out = self._apply(self.props["then"], self.props["then_option"], buf)
-        else:
-            out = self._apply(self.props["else"], self.props["else_option"], buf)
+    def _branch_pad(self, nth: int) -> Optional[Pad]:
+        for p in self.src_pads:
+            if p.name == f"src_{nth}":
+                return p
+        return None
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        """Route per branch when dedicated pads were requested (reference
+        chain: THEN → src_0, ELSE → src_1); merged static src otherwise."""
+        cond = self._evaluate(buf)
+        action_key, option_key = self._BRANCHES[0 if cond else 1]
+        out = self._apply(self.props[action_key], self.props[option_key], buf)
         if out is not None:
             self._prev_out = out
-        return out
+        if out is None:
+            return
+        branch = self._branch_pad(0 if cond else 1)
+        if branch is not None:
+            if branch.is_linked:
+                branch.push(out)
+            return
+        if self._branch_pad(1 if cond else 0) is not None:
+            return  # split mode, this branch's pad never requested: drop
+        self.push(out)

@@ -33,14 +33,16 @@ from ..core.caps import (
 from ..core.tensors import TensorSpec
 from ..registry.elements import register_element
 from ..registry.subplugin import SubpluginKind, get as get_subplugin
+from ..utils.log import logger
 from ..runtime.element import ElementError, Prop, TransformElement, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 
-from ..core.caps import FLATBUF_MIME, PROTOBUF_MIME
+from ..core.caps import FLATBUF_MIME, FLEXBUF_MIME, PROTOBUF_MIME
 
 # IDL byte-stream MIMEs → the converter subplugin that parses them
 # (reference: caps-driven subplugin dispatch of ext/nnstreamer/tensor_converter/)
-_IDL_MIMES = {PROTOBUF_MIME: "protobuf", FLATBUF_MIME: "flatbuf"}
+_IDL_MIMES = {PROTOBUF_MIME: "protobuf", FLATBUF_MIME: "flatbuf",
+              FLEXBUF_MIME: "flexbuf"}
 
 _IN_CAPS = Caps(
     tuple(
@@ -78,7 +80,16 @@ class TensorConverter(TransformElement):
         "subplugin_option": Prop(None, str,
                                  "option string handed to the subplugin "
                                  "(e.g. python3 converter .py file)"),
+        # reference mode property (gsttensor_converter.c): the corpus
+        # spells python converters ``mode=custom-script:<path>[:opt]``
+        "mode": Prop(None, str,
+                     "converter mode: custom-script:<py file>[:option] "
+                     "(reference custom-converter idiom) or "
+                     "custom-code:<registered name>"),
     }
+
+    READONLY_PROPS = ("sub-plugins",)
+    SUBPLUGIN_KIND = SubpluginKind.CONVERTER  # read-only sub-plugins prop
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -95,11 +106,47 @@ class TensorConverter(TransformElement):
         media = s.media_type
         n = self.props["frames_per_tensor"]
         # IDL streams self-select their converter from the caps MIME, like
-        # the reference's query_caps dispatch; an explicit subplugin= wins
-        subplugin = self.props["subplugin"] or _IDL_MIMES.get(media)
+        # the reference's query_caps dispatch; an explicit subplugin= or
+        # mode= (the reference's custom-converter spelling,
+        # gsttensor_converter.c mode property) wins
+        subplugin = self.props["subplugin"]
+        opt = self.props["subplugin_option"]
+        mode = self.props["mode"]
+        if mode and not subplugin:
+            kind, _, arg = mode.partition(":")
+            if kind == "custom-script":
+                if not arg:
+                    raise ElementError(
+                        f"{self.describe()}: mode=custom-script needs a "
+                        "script path (custom-script:<file.py>)")
+                # custom-script:<path>[:option] — a further ':' separates
+                # a trailing option unless the whole arg IS the path.
+                # Neither user API (native Converter / reference
+                # CustomConverter) takes per-instance options, so a
+                # trailing option is accepted-and-logged, not consumed.
+                import os as _os
+
+                if ":" in arg and not _os.path.exists(arg):
+                    arg, _, script_opt = arg.partition(":")
+                    if script_opt:
+                        logger.info(
+                            "%s: custom-script option '%s' accepted "
+                            "(python converters take no option)",
+                            self.describe(), script_opt)
+                subplugin, opt = "python3", arg
+            elif kind == "custom-code":
+                if not arg:
+                    raise ElementError(
+                        f"{self.describe()}: mode=custom-code needs a "
+                        "registered converter name (custom-code:<name>)")
+                subplugin = arg
+            else:
+                raise ElementError(
+                    f"{self.describe()}: unknown converter mode '{mode}' "
+                    "(custom-script:<file.py> | custom-code:<name>)")
+        subplugin = subplugin or _IDL_MIMES.get(media)
         if subplugin:
             cls = get_subplugin(SubpluginKind.CONVERTER, subplugin)
-            opt = self.props["subplugin_option"]
             if not isinstance(cls, type):
                 self._ext = cls
             elif opt is not None:

@@ -48,6 +48,17 @@ from ..utils.log import logger
 from ..utils.stats import InvokeStats
 
 
+def _layout_list(v) -> str:
+    """Validate a ','-separated layout declaration (reference accepts
+    any|NHWC|NCHW|none per tensor, tensor_filter_common.c:923-926)."""
+    s = str(v).strip()
+    for part in filter(None, (p.strip() for p in s.split(","))):
+        if part.lower() not in ("any", "nhwc", "nchw", "none"):
+            raise ValueError(
+                f"layout '{part}' not one of any|NHWC|NCHW|none")
+    return s
+
+
 def _parse_combination(v) -> Optional[List[int]]:
     """Parse "0,2,1" style tensor index lists (input-combination)."""
     if v is None or v == "":
@@ -120,6 +131,24 @@ class TensorFilter(TransformElement):
         # backends that address tensors by name
         "inputname": Prop("", str, "input tensor names 'a,b' (reference)"),
         "outputname": Prop("", str, "output tensor names (reference)"),
+        # reference data-layout declaration (tensor_filter_common.c:923-947:
+        # any|NHWC|NCHW|none per tensor, ','-separated). Declarative here
+        # as there: subplugins that can reorder consult it; the jax/XLA
+        # path is NHWC-native and XLA owns physical layout assignment
+        "inputlayout": Prop("", _layout_list,
+                            "declared input data layout per tensor: "
+                            "any|NHWC|NCHW|none, ','-separated"),
+        "outputlayout": Prop("", _layout_list,
+                             "declared output data layout per tensor"),
+        # reference tensor_filter.c:366-510: ``latency``/``throughput`` are
+        # SETTABLE mode flags (0 off, 1 on) that enable profiling; reading
+        # them back returns the measured value (get_property below)
+        "latency": Prop(0, int,
+                        "1 = profile device latency every invoke "
+                        "(reference latency prop); read back as ms"),
+        "throughput": Prop(0, int,
+                           "1 = enable throughput accounting (reference "
+                           "throughput prop); read back as fps"),
     }
     # the reference's original property spellings (tensor_filter.c
     # "input"/"inputtype"/"output"/"outputtype") — drop-in launch lines
@@ -156,6 +185,9 @@ class TensorFilter(TransformElement):
         self._suspend_thread: Optional[threading.Thread] = None
         self._suspend_stop = threading.Event()
 
+    READONLY_PROPS = ("sub-plugins", "inputranks", "outputranks")
+    SUBPLUGIN_KIND = SubpluginKind.FILTER  # read-only sub-plugins prop
+
     # read-only observability props (reference latency/throughput props)
     def get_property(self, key: str):
         key_n = key.replace("-", "_")
@@ -163,6 +195,12 @@ class TensorFilter(TransformElement):
             return self.stats.recent_device_latency_s * 1e3
         if key_n == "throughput":
             return self.stats.throughput_fps
+        if key_n in ("inputranks", "outputranks"):
+            # reference read-only rank lists (tensor_filter_common.c:928,949)
+            info = self._in_info if key_n == "inputranks" else self._out_info
+            if info is None or not info.specs:
+                return ""
+            return ",".join(str(len(s.shape)) for s in info.specs)
         return super().get_property(key)
 
     # -- lifecycle ----------------------------------------------------------
@@ -377,6 +415,8 @@ class TensorFilter(TransformElement):
         # tensor_filter.c:366-510) is sampled every Nth frame by blocking,
         # so latency_report stays honest without serializing the stream.
         sampling = self.props["latency_sampling"]
+        if self.props["latency"]:  # reference latency=1: profile every invoke
+            sampling = 1
         # skip the very first invoke (includes XLA compile) so one giant
         # outlier doesn't own the 10-sample window
         sample_device = self.props["sync_invoke"] or (

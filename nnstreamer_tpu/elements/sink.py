@@ -160,7 +160,11 @@ class MultiFileSink(SinkElement):
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
     PROPERTIES = {
         "location": Prop("out_%03d.raw", str, "printf-style path pattern"),
+        # GStreamer basesink clock/preroll knobs; rendering here is
+        # upstream-paced and per-buffer flushed, so these are no-ops
         "sync": Prop(False, prop_bool, "accepted for compat (no-op)"),
+        "async": Prop(True, prop_bool, "accepted for compat (no-op)"),
+        "buffer_mode": Prop("default", str, "accepted for compat (no-op)"),
     }
 
     def __init__(self, name=None, **props):

@@ -42,6 +42,10 @@ class PubSubBroker:
         with self._lock:
             self._topic_caps[topic] = caps
 
+    def has_subscriber(self, topic: str) -> bool:
+        with self._lock:
+            return bool(self._subs.get(topic))
+
     def publish(self, topic: str, buf: Buffer) -> None:
         payload = pack_tensors(buf.as_numpy())
         with self._lock:
@@ -171,3 +175,81 @@ def release_broker(broker: PubSubBroker) -> None:
         if broker.refcount <= 0:
             _brokers.pop((broker.host, broker.port), None)
             broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# connect-type=MQTT transport: data rides an external MQTT broker instead of
+# the embedded TCP broker (reference nnstreamer-edge NNS_EDGE_CONNECT_TYPE_
+# MQTT — caps as a retained message, frames as QoS0 publishes)
+# ---------------------------------------------------------------------------
+
+
+def _mqtt_data_topic(topic: str) -> str:
+    return f"edge/{topic}"
+
+
+class MqttPublisher:
+    """``PubSubBroker``-shaped facade publishing via an external MQTT broker
+    (edgesink connect-type=MQTT; dest-host/dest-port name the broker)."""
+
+    def __init__(self, host: str, port: int):
+        from .mqtt import MqttClient
+
+        self._client = MqttClient(host, port)
+        self.host, self.port = host, port
+
+    def set_topic_caps(self, topic: str, caps: Caps) -> None:
+        # retained: late subscribers still learn the stream caps
+        self._client.publish(f"{_mqtt_data_topic(topic)}/caps",
+                             str(caps).encode(), retain=True)
+
+    def has_subscriber(self, topic: str) -> bool:
+        # an external MQTT broker does not expose its subscriber list;
+        # wait-connection degrades to publish-immediately
+        return True
+
+    def publish(self, topic: str, buf: Buffer) -> None:
+        self._client.publish(_mqtt_data_topic(topic), pack_tensors(buf))
+
+    def stop(self) -> None:
+        self._client.close()
+
+
+class MqttSubscriber:
+    """``Subscriber``-shaped facade over MQTT: caps from the retained
+    ``edge/<topic>/caps`` message, frames from ``edge/<topic>``."""
+
+    def __init__(self, host: str, port: int, topic: str, timeout: float = 10.0):
+        from .mqtt import MqttClient
+
+        self._q: _queue.Queue = _queue.Queue()
+        self._caps_evt = threading.Event()
+        self.caps: Optional[Caps] = None
+        self._client = MqttClient(host, port)
+        data_topic = _mqtt_data_topic(topic)
+
+        def on_message(t: str, body: bytes) -> None:
+            if t == f"{data_topic}/caps":
+                self.caps = parse_caps_string(bytes(body).decode())
+                self._caps_evt.set()
+            elif t == data_topic:
+                self._q.put(unpack_tensors(bytes(body)))
+
+        self._client.subscribe(f"{data_topic}/caps", on_message,
+                               timeout=timeout)
+        self._client.subscribe(data_topic, on_message, timeout=timeout)
+        if not self._caps_evt.wait(timeout):
+            self._client.close()
+            raise ConnectionError(
+                f"edge mqtt subscribe: no retained caps on "
+                f"'{data_topic}/caps' within {timeout}s (is the edgesink "
+                "publishing on this broker?)")
+
+    def next(self, timeout: float = 0.1):
+        try:
+            return self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._client.close()

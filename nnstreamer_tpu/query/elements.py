@@ -34,17 +34,39 @@ _TENSOR_CAPS = Caps.new("other/tensors")
 
 
 def _connect_type(v) -> str:
-    """reference connect-type values TCP|HYBRID|AITT. TCP = direct
-    address; HYBRID = MQTT broker carries the topic→address advertisement,
-    data still flows direct TCP (query/hybrid.py). AITT is a Samsung
-    transport with no analog here. Validated at property-set so a
-    launch-line typo fails immediately."""
+    """reference connect-type values TCP|HYBRID|MQTT|AITT
+    (nnstreamer-edge NNS_EDGE_CONNECT_TYPE_*). TCP = direct address;
+    HYBRID = MQTT broker carries the topic→address advertisement, data
+    still flows direct TCP (query/hybrid.py); MQTT = data itself rides the
+    broker (edge.MqttPublisher/MqttSubscriber). AITT is a Samsung
+    transport with no analog here — the enum value is accepted (the
+    reference validates it at parse too) and the element fails at start,
+    exactly like the reference without the AITT daemon."""
     s = str(v).upper()
-    if s not in ("TCP", "HYBRID"):
+    if s not in ("TCP", "HYBRID", "MQTT", "AITT"):
         raise ValueError(
-            f"connect-type {v!r} not supported: TCP | HYBRID (AITT is a "
-            "Samsung-stack transport with no TPU-rig analog)")
+            f"connect-type {v!r} not supported: TCP | HYBRID | MQTT | AITT")
     return s
+
+
+def _require_transport(el, supported: tuple) -> None:
+    """Fail at START (the reference validates the enum at parse and fails
+    at connect) when the element does not implement the selected
+    connect-type. MQTT data transport exists for edgesrc/edgesink only;
+    AITT is a Samsung stack this framework does not ship."""
+    ct = el.props["connect_type"]
+    if ct in supported:
+        return
+    why = ("needs the Samsung AITT stack, which this framework does not "
+           "ship" if ct == "AITT"
+           else f"is not implemented for {el.ELEMENT_NAME}")
+    raise ElementError(
+        f"{el.describe()}: connect-type={ct} {why}; supported here: "
+        f"{' | '.join(supported)}")
+
+
+def _reject_aitt(el) -> None:  # edge elements: everything but AITT works
+    _require_transport(el, ("TCP", "HYBRID", "MQTT"))
 
 _CONNECT_TYPE_PROP = Prop(
     "TCP", _connect_type,
@@ -152,6 +174,7 @@ class TensorQueryClient(Element):
                 self.props["dest_port"] or self.props["port"])
 
     def _new_client(self) -> QueryClient:
+        _require_transport(self, ("TCP", "HYBRID"))
         host, port = self._server_addr()
         if self.props["connect_type"] == "HYBRID":
             # re-discovered on EVERY connect (incl. reconnects): a server
@@ -299,6 +322,13 @@ class TensorQueryServerSrc(SourceElement):
         "advertise_host": Prop("", str,
                                "HYBRID: address to advertise instead of the "
                                "bind host (required when binding 0.0.0.0)"),
+        # reference tensor_query_serversrc.c:111-127
+        "timeout": Prop(10.0, float,
+                        "seconds a new connection gets to complete the "
+                        "caps handshake (reference timeout)"),
+        "is_live": Prop(True, prop_bool,
+                        "accepted for compat: this source is always a "
+                        "live push source"),
     }
 
     def __init__(self, name=None, **props):
@@ -310,9 +340,11 @@ class TensorQueryServerSrc(SourceElement):
         return self.server.port if self.server else 0
 
     def start(self) -> None:
+        _require_transport(self, ("TCP", "HYBRID"))
         self.server = get_shared_server(
             self.props["id"], self.props["host"], self.props["port"]
         )
+        self.server.handshake_timeout = self.props["timeout"]
         if self.props["caps"]:
             accepted = parse_caps_string(self.props["caps"])
             # remote caps negotiation: reject clients whose stream cannot
@@ -354,11 +386,21 @@ class TensorQueryServerSink(SinkElement):
     PROPERTIES = {
         "id": Prop(0, int, "shared server id (pairs src and sink)"),
         "connect_type": _CONNECT_TYPE_PROP,
+        # reference tensor_query_serversink.c:82-95
+        "timeout": Prop(10.0, float,
+                        "handshake window applied to the shared server "
+                        "(reference timeout)"),
+        "limit": Prop(0, int,
+                      "max pending request buffers stored server-side "
+                      "before shedding (reference limit; 0 = unbounded)"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.server: Optional[QueryServer] = None
+
+    def start(self) -> None:
+        _require_transport(self, ("TCP", "HYBRID"))
 
     def _server(self) -> QueryServer:
         # lazy lookup of the server the paired serversrc created — never
@@ -366,6 +408,12 @@ class TensorQueryServerSink(SinkElement):
         # would pin an ephemeral port and void the src's port= property)
         if self.server is None:
             self.server = lookup_shared_server(self.props["id"])
+            if self.props["limit"] > 0:
+                self.server.inbox_limit = self.props["limit"]
+            if self.props["timeout"] != type(self).PROPERTIES[
+                    "timeout"].default:
+                # explicit sink-side timeout wins over the src's default
+                self.server.handshake_timeout = self.props["timeout"]
         return self.server
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
@@ -407,6 +455,16 @@ class EdgeSink(SinkElement):
         "advertise_host": Prop("", str,
                                "HYBRID: address to advertise instead of the "
                                "bind host (required when binding 0.0.0.0)"),
+        # reference edge_sink.c: optionally hold the stream until a
+        # subscriber is attached (frames published before any subscriber
+        # connects are lost on a pub/sub transport)
+        "wait_connection": Prop(False, prop_bool,
+                                "block the first frames until a subscriber "
+                                "connects (reference wait-connection)"),
+        "connection_timeout": Prop(0.0, float,
+                                   "seconds wait-connection waits before "
+                                   "erroring (0 = forever; reference "
+                                   "connection-timeout, ms there)"),
     }
 
     def __init__(self, name=None, **props):
@@ -417,7 +475,32 @@ class EdgeSink(SinkElement):
     def bound_port(self) -> int:
         return self.broker.port if self.broker else 0
 
+    def _wait_for_subscriber(self) -> None:
+        import time as _time
+
+        timeout = self.props["connection_timeout"]
+        deadline = (_time.monotonic() + timeout) if timeout > 0 else None
+        topic = self.props["topic"]
+        while True:
+            broker = self.broker
+            if broker is None:
+                return  # element stopped while waiting: drop, don't error
+            if broker.has_subscriber(topic):
+                return
+            if deadline is not None and _time.monotonic() > deadline:
+                raise ElementError(
+                    f"{self.describe()}: no subscriber on '{topic}' within "
+                    f"{timeout}s (wait-connection)")
+            _time.sleep(0.01)
+
     def start(self) -> None:
+        _reject_aitt(self)
+        if self.props["connect_type"] == "MQTT":
+            from .edge import MqttPublisher
+
+            self.broker = MqttPublisher(self.props["dest_host"],
+                                        self.props["dest_port"])
+            return
         self.broker = get_broker(self.props["host"], self.props["port"])
         if self.props["connect_type"] == "HYBRID":
             _hybrid_advertise(self, self.broker.port)
@@ -426,13 +509,23 @@ class EdgeSink(SinkElement):
         self.broker.set_topic_caps(self.props["topic"], caps)
 
     def render(self, buf: Buffer) -> None:
-        self.broker.publish(self.props["topic"], buf)
+        if self.props["wait_connection"] and not getattr(
+                self, "_subscriber_seen", False):
+            self._wait_for_subscriber()
+            self._subscriber_seen = True
+        broker = self.broker
+        if broker is None:
+            return  # stopped mid-wait: frame dropped, not an error
+        broker.publish(self.props["topic"], buf)
 
     def stop(self) -> None:
         if self.broker is not None:
-            if self.props["connect_type"] == "HYBRID":
-                _hybrid_withdraw(self)
-            release_broker(self.broker)
+            if self.props["connect_type"] == "MQTT":
+                self.broker.stop()
+            else:
+                if self.props["connect_type"] == "HYBRID":
+                    _hybrid_withdraw(self)
+                release_broker(self.broker)
             self.broker = None
 
 
@@ -448,16 +541,38 @@ class EdgeSrc(SourceElement):
         "topic": Prop("", str),
         "timeout": Prop(10.0, float),
         "connect_type": _CONNECT_TYPE_PROP,
+        # reference gstedgesrc.c: ``host``/``port`` are the src's own bind
+        # address (0 = ephemeral); our subscriber dials out over one TCP
+        # stream, so any requested local address is satisfiable — accepted
+        # for compat
+        "host": Prop("localhost", str,
+                     "local bind host (accepted for compat — transport "
+                     "dials outward)"),
+        "port": Prop(0, int, "local bind port (0 = ephemeral; accepted "
+                             "for compat — transport dials outward)"),
+        # basesrc num-buffers semantics (the corpus caps every edgesrc
+        # line with it): -1 = unlimited (GStreamer default), 0 = emit
+        # nothing and EOS
+        "num_buffers": Prop(-1, int,
+                            "stop after N buffers (-1 = unlimited, "
+                            "0 = emit none)"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._sub = None
+        self._emitted = 0
 
     def get_src_caps(self) -> Caps:
-        from .edge import Subscriber
+        from .edge import MqttSubscriber, Subscriber
 
+        _reject_aitt(self)
         host, port = self.props["dest_host"], self.props["dest_port"]
+        if self.props["connect_type"] == "MQTT":
+            # frames ride the broker itself (no direct TCP data path)
+            self._sub = MqttSubscriber(host, port, self.props["topic"],
+                                       self.props["timeout"])
+            return self._sub.caps
         if self.props["connect_type"] == "HYBRID":
             # dest-host/dest-port name the MQTT broker; the data broker's
             # address comes from its retained advertisement
@@ -470,10 +585,16 @@ class EdgeSrc(SourceElement):
         return self._sub.caps
 
     def create(self) -> Optional[Buffer]:
+        n_max = self.props["num_buffers"]
+        if n_max >= 0 and self._emitted >= n_max:
+            return None
         while self.running:
             buf = self._sub.next(timeout=0.1)
             if buf is not None:
-                return buf if buf != "eos" else None
+                if buf == "eos":
+                    return None
+                self._emitted += 1
+                return buf
         return None
 
     def stop(self) -> None:

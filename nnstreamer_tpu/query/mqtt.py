@@ -106,7 +106,8 @@ class MqttClient:
     """Blocking-connect, background-read MQTT 3.1.1 client (QoS0)."""
 
     def __init__(self, host: str, port: int, client_id: str = "",
-                 keep_alive: int = 60, timeout: float = 10.0):
+                 keep_alive: int = 60, timeout: float = 10.0,
+                 clean_session: bool = True):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
         self._write_lock = threading.Lock()
@@ -115,7 +116,7 @@ class MqttClient:
         self._suback = threading.Event()
         cid = (client_id or f"nns-{id(self) & 0xFFFF:x}-{int(time.time()) & 0xFFFF:x}")
         var = (_mqtt_str(b"MQTT") + bytes([4])        # protocol level 3.1.1
-               + bytes([0x02])                        # clean session
+               + bytes([0x02 if clean_session else 0x00])
                + struct.pack(">H", keep_alive))
         _send_packet(self._sock, CONNECT, var + _mqtt_str(cid.encode()))
         pkt = _read_packet(self._sock)

@@ -40,7 +40,14 @@ class QueryServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  caps: Optional[Caps] = None,
-                 accept_caps: Optional[Callable[[Caps], bool]] = None):
+                 accept_caps: Optional[Callable[[Caps], bool]] = None,
+                 handshake_timeout: float = 10.0):
+        # reference serversrc/-sink ``timeout``: window a new connection
+        # gets to complete the capability handshake; ``limit`` (serversink)
+        # bounds pending stored buffers — both adjustable on the shared
+        # server after creation
+        self.handshake_timeout = handshake_timeout
+        self.inbox_limit = 0  # 0 = unbounded
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -97,6 +104,9 @@ class QueryServer:
 
     def _client_loop(self, client_id: int, conn: socket.socket) -> None:
         try:
+            if self.handshake_timeout > 0:
+                # un-handshaken connections must not linger forever
+                conn.settimeout(self.handshake_timeout)
             while self._running.is_set():
                 msg = recv_msg(conn)
                 if msg is None:
@@ -109,10 +119,20 @@ class QueryServer:
                         self._client_caps[client_id] = caps
                         reply = str(self.caps) if self.caps else str(caps)
                         send_msg(conn, MsgType.CAPABILITY, reply.encode())
+                        conn.settimeout(None)  # handshake done: stream freely
                     else:
                         send_msg(conn, MsgType.ERROR,
                                  f"caps rejected: {caps}".encode())
                 elif msg_type is MsgType.DATA:
+                    limit = self.inbox_limit
+                    if limit > 0 and self.inbox.qsize() >= limit:
+                        # reference serversink limit: shed instead of
+                        # queueing unboundedly under a slow pipeline
+                        logger.warning(
+                            "query server %d: inbox over limit %d, "
+                            "dropping a frame from client %d",
+                            self.port, limit, client_id)
+                        continue
                     buf = unpack_tensors(payload)
                     buf.meta["client_id"] = client_id
                     self.inbox.put(buf)

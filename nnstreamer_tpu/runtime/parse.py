@@ -113,7 +113,10 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
             i += 1
             continue
         if (tok.endswith("=") and tok.count("=") == 1 and tok != "="
-                and nxt is not None and nxt != "!"):
+                and nxt is not None and nxt != "!" and "=" not in nxt):
+            # 'key= value' rejoins, but 'option= silent=true' is a
+            # deliberately EMPTY value followed by a new assignment — a
+            # token carrying its own '=' is never a bare value
             fixed.append(tok + nxt)
             i += 2
             continue

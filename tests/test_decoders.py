@@ -289,6 +289,46 @@ class TestSerializeRoundtrip:
         assert np.asarray(out[1].tensors[0]).shape == (2, 3)
         assert np.allclose(np.asarray(out[1].tensors[0]), 1.0)
 
+    def test_reference_capsfilter_mime_roundtrip(self):
+        """The corpus spelling: decoder emits ``other/flexbuf`` and the
+        capsfilter + bare tensor_converter (MIME-dispatched subplugin)
+        negotiate it (reference tests/nnstreamer_flexbuf/runTest.sh)."""
+        out = run_collect(
+            "tensor_src num-buffers=2 dimensions=3:2 types=float32 pattern=counter "
+            "! tensor_decoder mode=flexbuf ! other/flexbuf "
+            "! tensor_converter ! tensor_sink name=out"
+        )
+        assert len(out) == 2
+        assert np.asarray(out[1].tensors[0]).shape == (2, 3)
+        assert np.allclose(np.asarray(out[1].tensors[0]), 1.0)
+
+    def test_converter_mode_custom_script(self, tmp_path):
+        """``tensor_converter mode=custom-script:<file.py>`` (reference
+        gsttensor_converter.c mode property; the converter_python3 corpus
+        spelling) loads the python converter subplugin."""
+        script = tmp_path / "conv.py"
+        script.write_text(
+            "import numpy as np\n"
+            "from nnstreamer_tpu.core import Buffer\n"
+            "from nnstreamer_tpu.core.serialize import unpack_tensors\n"
+            "class Converter:\n"
+            "    def get_out_info(self, in_caps):\n"
+            "        from nnstreamer_tpu.core import TensorsInfo, TensorFormat\n"
+            "        return TensorsInfo((), TensorFormat.FLEXIBLE)\n"
+            "    def convert(self, buf):\n"
+            "        out = unpack_tensors("
+            "np.ascontiguousarray(np.asarray(buf.tensors[0])).tobytes())\n"
+            "        out.pts = buf.pts\n"
+            "        return out\n")
+        out = run_collect(
+            "tensor_src num-buffers=2 dimensions=3:2 types=float32 pattern=counter "
+            "! tensor_decoder mode=flexbuf ! other/flexbuf "
+            f"! tensor_converter mode=custom-script:{script} "
+            "! tensor_sink name=out"
+        )
+        assert len(out) == 2
+        assert np.asarray(out[1].tensors[0]).shape == (2, 3)
+
 
 class TestTensorRegionCropLoop:
     def test_region_into_crop(self):

@@ -224,6 +224,46 @@ class TestIf:
         assert np.allclose(np.asarray(bufs[0].tensors[0]), 0.0)
         assert np.allclose(np.asarray(bufs[2].tensors[0]), 2.0)
 
+    def test_branch_src_pads(self):
+        """Reference dynamic pad scheme (gsttensor_if.c TIFSP_THEN_PAD /
+        TIFSP_ELSE_PAD): THEN frames route to ``src_0``, ELSE to ``src_1``
+        — the gstreamer_join corpus spelling ``tif.src_0 ! ...``."""
+        pipe = parse_launch(
+            "tensor_src num-buffers=4 dimensions=2 types=float32 pattern=counter "
+            "! tensor_if name=tif compared-value=a-value compared-value-option=0:0 "
+            "operator=lt supplied-value=2 then=passthrough else=passthrough "
+            "tif.src_0 ! queue ! tensor_sink name=then_out "
+            "tif.src_1 ! queue ! tensor_sink name=else_out"
+        )
+        then_bufs, else_bufs = [], []
+        pipe.get("then_out").connect(then_bufs.append)
+        pipe.get("else_out").connect(else_bufs.append)
+        pipe.run(timeout=20.0)
+        # counter frames 0..3: 0,1 < 2 → then pad; 2,3 → else pad
+        assert [float(np.asarray(b.tensors[0])[0]) for b in then_bufs] == [0.0, 1.0]
+        assert [float(np.asarray(b.tensors[0])[0]) for b in else_bufs] == [2.0, 3.0]
+
+    def test_branch_pads_tensorpick_caps_differ(self):
+        """Each branch pad carries its own TENSORPICK selection — the
+        merged-src agreement rule doesn't apply to dedicated pads."""
+        pipe = parse_launch(
+            "tensor_src num-buffers=4 dimensions=2 types=float32 pattern=counter ! m.sink_0 "
+            "tensor_src num-buffers=4 dimensions=4 types=float32 pattern=counter ! m.sink_1 "
+            "tensor_mux name=m sync-mode=nosync ! tensor_if name=tif "
+            "compared-value=a-value compared-value-option=0:0 "
+            "operator=lt supplied-value=2 "
+            "then=tensorpick then-option=0 else=tensorpick else-option=1 "
+            "tif.src_0 ! queue ! tensor_sink name=then_out "
+            "tif.src_1 ! queue ! tensor_sink name=else_out"
+        )
+        then_bufs, else_bufs = [], []
+        pipe.get("then_out").connect(then_bufs.append)
+        pipe.get("else_out").connect(else_bufs.append)
+        pipe.run(timeout=20.0)
+        assert all(np.asarray(b.tensors[0]).size == 2 for b in then_bufs)
+        assert all(np.asarray(b.tensors[0]).size == 4 for b in else_bufs)
+        assert len(then_bufs) == 2 and len(else_bufs) == 2
+
     def test_custom_condition(self):
         from nnstreamer_tpu.elements.cond import (
             register_if_condition,
@@ -740,10 +780,13 @@ class TestReferencePropParity:
         assert np.asarray(got[0].tensors[1]).shape == (2,)
 
     def test_query_connect_type_validated(self):
+        # a typo'd enum value fails at parse; AITT is a VALID enum value
+        # (reference nnstreamer-edge) that fails at connect time because
+        # the Samsung AITT stack isn't shipped — see test_hybrid
         with pytest.raises(Exception, match="connect-type"):
             parse_launch(
                 "appsrc name=in caps=other/tensors,format=static,dimensions=2,types=float32 "
-                "! tensor_query_client connect-type=AITT ! tensor_sink name=out")
+                "! tensor_query_client connect-type=BOGUS ! tensor_sink name=out")
 
     def test_if_fill_with_file_and_rpt(self, tmp_path):
         raw = np.arange(4, dtype=np.float32)

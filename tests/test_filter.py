@@ -235,6 +235,23 @@ class TestPropertyBreadth:
         assert f.get_property("latency") > 0
         assert f.get_property("throughput") > 0
 
+    def test_settable_latency_mode_flag(self):
+        """``latency=1`` is a SETTABLE mode flag (reference
+        tensor_filter.c:366-510) forcing per-invoke device profiling; the
+        getter still reads back the measured value."""
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        pipe = parse_launch(
+            "tensor_src num-buffers=8 dimensions=4 types=float32 "
+            "! tensor_filter framework=jax model=builtin://scaler?factor=2 "
+            "latency=1 throughput=1 name=f ! tensor_sink name=out")
+        pipe.run(timeout=30)
+        f = pipe.get("f")
+        assert f.props["latency"] == 1
+        assert f.get_property("latency") > 0  # measured ms, not the flag
+        # every invoke after the first was device-sampled
+        assert f.stats.snapshot()["recent_device_latency_ms"] > 0
+
 
 class TestInvokeStats:
     def test_device_latency_sampled_separately(self):

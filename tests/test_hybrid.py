@@ -186,7 +186,23 @@ class TestHybridEdge:
             pub.stop()
 
     def test_bad_connect_type_rejected(self):
-        with pytest.raises(ValueError, match="AITT"):
+        # unknown enum values fail at parse (property validation)
+        with pytest.raises(ValueError, match="connect-type"):
             parse_launch(f"appsrc caps={CAPS} "
-                         "! tensor_query_client connect-type=AITT "
+                         "! tensor_query_client connect-type=ZIGBEE "
                          "! tensor_sink")
+
+    def test_aitt_constructs_but_fails_at_connect(self):
+        # AITT is a valid reference enum (nnstreamer-edge); without the
+        # Samsung AITT stack the element must fail at CONNECT time with a
+        # clear message — construction succeeds, matching the reference
+        from nnstreamer_tpu.query.elements import TensorQueryClient
+
+        pipe = parse_launch(f"appsrc caps={CAPS} "
+                            "! tensor_query_client name=c connect-type=AITT "
+                            "! tensor_sink")
+        client = pipe.get("c")
+        assert isinstance(client, TensorQueryClient)
+        with pytest.raises(Exception, match="AITT"):
+            client._new_client()
+        pipe.stop()

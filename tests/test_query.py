@@ -129,6 +129,64 @@ class TestEdgePubSub:
         finally:
             pub.stop()
 
+    def test_edgesrc_num_buffers(self):
+        """basesrc num-buffers semantics on edgesrc (the edge corpus caps
+        every line with it: reference tests/nnstreamer_edge/runTest.sh)."""
+        pub = parse_launch(
+            "tensor_src num-buffers=200 dimensions=2 types=float32 pattern=counter "
+            "framerate=100 ! edgesink name=pub topic=capped port=0"
+        )
+        pub.play()
+        deadline = time.monotonic() + 5
+        while pub.get("pub").bound_port == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        port = pub.get("pub").bound_port
+        try:
+            sub = parse_launch(
+                f"edgesrc dest-host=127.0.0.1 dest-port={port} topic=capped "
+                "num-buffers=3 ! tensor_sink name=out"
+            )
+            out = []
+            sub.get("out").connect(out.append)
+            sub.run(timeout=10)  # EOS after exactly num-buffers frames
+            sub.stop()
+            assert len(out) == 3
+        finally:
+            pub.stop()
+
+    def test_edge_mqtt_connect_type(self):
+        """connect-type=MQTT: frames ride the broker itself (reference
+        nnstreamer-edge NNS_EDGE_CONNECT_TYPE_MQTT) — caps retained, data
+        as publishes, through our own MQTT 3.1.1 mini-broker."""
+        from nnstreamer_tpu.query import mqtt as mqtt_mod
+
+        broker = mqtt_mod.get_embedded_broker(0)
+        try:
+            pub = parse_launch(
+                "tensor_src num-buffers=300 dimensions=2 types=float32 "
+                "pattern=counter framerate=100 "
+                f"! edgesink topic=mq connect-type=MQTT "
+                f"dest-host={broker.host} dest-port={broker.port}"
+            )
+            pub.play()
+            sub = parse_launch(
+                f"edgesrc connect-type=MQTT dest-host={broker.host} "
+                f"dest-port={broker.port} topic=mq ! tensor_sink name=out"
+            )
+            out = []
+            sub.get("out").connect(out.append)
+            sub.play()
+            deadline = time.monotonic() + 10
+            while len(out) < 5 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            sub.stop()
+            pub.stop()
+            assert len(out) >= 5
+            vals = [float(np.asarray(b.tensors[0])[0]) for b in out]
+            assert vals == sorted(vals)
+        finally:
+            mqtt_mod.release_embedded_broker(broker)
+
     def test_unknown_topic(self):
         pub = parse_launch(
             "tensor_src num-buffers=50 dimensions=1 framerate=50 "

@@ -163,6 +163,28 @@ def _expand_shell(text: str) -> str:
     return "\n".join(out_lines)
 
 
+# fixtures the reference suite GENERATES at run time with an echo
+# redirect (e.g. nnstreamer_decoder_pose writes pose_label.txt) — the
+# construction pass materializes them in a per-suite overlay
+_ECHO_WRITE = re.compile(r'echo\s+"((?:[^"\\]|\\.)*)"\s*>\s*([\w.\-]+)', re.S)
+
+
+def _suite_overlay(suite_dir: str, generated: dict) -> str:
+    """Tempdir mirroring the read-only suite dir (symlinks) plus the
+    suite's runtime-generated text fixtures."""
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="nns_compat_")
+    for name in os.listdir(suite_dir):
+        os.symlink(os.path.join(suite_dir, name), os.path.join(d, name))
+    for name, content in generated.items():
+        path = os.path.join(d, name)
+        if not os.path.lexists(path):
+            with open(path, "w") as fh:
+                fh.write(content)
+    return d
+
+
 def collect_lines():
     out = []
     for root, _dirs, files in os.walk(os.path.join(REF, "tests")):
@@ -171,6 +193,8 @@ def collect_lines():
         suite = os.path.basename(root)
         text = _expand_shell(open(os.path.join(root, "runTest.sh"),
                                   errors="replace").read())
+        generated = {m.group(2): _unescape(m.group(1)) + "\n"
+                     for m in _ECHO_WRITE.finditer(text)}
         for m in _GSTTEST.finditer(text):
             line = _unescape(m.group(1))
             line = _PLUGIN_PATH.sub("", line).strip()
@@ -182,7 +206,7 @@ def collect_lines():
             args = m.group(2).split()
             expect_fail = len(args) >= 3 and args[2] == "1"
             if line:
-                out.append((suite, line, expect_fail))
+                out.append((suite, line, expect_fail, root, generated))
     return out
 
 
@@ -197,18 +221,35 @@ def main() -> None:
     counts = Counter()
     by_suite = defaultdict(Counter)
     failures = Counter()
-    for suite, line, expect_fail in lines:
+    import shutil
+
+    launch_cwd = os.getcwd()
+    overlays = {}
+    for suite, line, expect_fail, suite_dir, generated in lines:
         if _SHELL_VAR.search(line):
             counts["shell_var_skipped"] += 1
             by_suite[suite]["shell_var_skipped"] += 1
             continue
         try:
+            # the reference's SSAT runs each runTest.sh from its own suite
+            # directory — relative fixture paths (labels, box_priors,
+            # config_file.N, user .py scripts) resolve there. Construction
+            # never play()s, so nothing is written into the read-only tree;
+            # suites that generate fixtures at run time get an overlay dir.
+            if generated:
+                if suite_dir not in overlays:
+                    overlays[suite_dir] = _suite_overlay(suite_dir, generated)
+                os.chdir(overlays[suite_dir])
+            else:
+                os.chdir(suite_dir)
             pipe = parse_launch(line)
             pipe.stop()
             ok = True
         except Exception as e:  # noqa: BLE001 — classification, not flow
             ok = False
             err = e
+        finally:
+            os.chdir(launch_cwd)
         if expect_fail:
             # negative line: raising at parse is error-compat; building
             # is also acceptable (many negatives only fail at play)
@@ -227,6 +268,9 @@ def main() -> None:
                 failures[f"{type(err).__name__}: {msg[:90]}"] += 1
         counts[kind] += 1
         by_suite[suite][kind] += 1
+
+    for overlay in overlays.values():
+        shutil.rmtree(overlay, ignore_errors=True)
 
     # grammar-evaluable = lines whose outcome reflects OUR parser, not
     # the environment: fixture_missing parsed its grammar successfully
