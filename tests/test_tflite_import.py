@@ -314,6 +314,74 @@ class TestPrecisionOption:
             load_tflite(path, {"precision": "turbo"})
 
 
+class TestQuantizedExecModes:
+    """quantized_exec: int8 (true integer arithmetic — int8 GEMMs, int32
+    accumulators, requantize; tflite_int8.py) and float (dequantized
+    weights + quant-RANGE clamps, no grid rounding) against the fake-quant
+    oracle and the interpreter. The int8 path is the performance answer to
+    the reference's native int8 kernels
+    (tensor_filter_tensorflow_lite.cc); fake-quant stays the byte oracle."""
+
+    def _imgs(self, n):
+        rng = np.random.default_rng(7)
+        out = []
+        for _ in range(n):
+            u = rng.random((224, 224, 1)) * rng.random((1, 1, 3))
+            out.append(np.clip(u * 255 + rng.normal(0, 30, (224, 224, 3)),
+                               0, 255).astype(np.uint8)[None])
+        return out
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("mode,byte_tol", [("int8", 4), ("float", 6)])
+    def test_mode_tracks_interpreter(self, mode, byte_tol):
+        import jax
+
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = f"{REF_MODELS}/mobilenet_v2_1.0_224_quant.tflite"
+        fn, in_info, out_info = load_tflite(path, {"quantized_exec": mode})
+        assert out_info.specs[0].shape == (1, 1001)
+        it = _interp(path)
+        jfn = jax.jit(fn)
+        agree = 0
+        imgs = self._imgs(6)
+        for img in imgs:
+            ref = _run_interp(it, img)[0][0]
+            ours = np.asarray(jfn(img)[0])[0]
+            assert ours.dtype == ref.dtype
+            assert np.abs(ref.astype(int) - ours.astype(int)).max() <= byte_tol
+            agree += int(ref.argmax() == ours.argmax())
+        assert agree >= 4, f"{mode}: top-1 parity too low: {agree}/6"
+
+    @pytest.mark.slow
+    def test_int8_batched_equals_per_frame(self):
+        import jax
+
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = f"{REF_MODELS}/mobilenet_v2_1.0_224_quant.tflite"
+        fn1, _, _ = load_tflite(path, {"quantized_exec": "int8"})
+        fnb, in_info, _ = load_tflite(
+            path, {"quantized_exec": "int8", "batch": "3"})
+        assert in_info.specs[0].shape[0] == 3
+        imgs = self._imgs(3)
+        batch = np.concatenate(imgs, axis=0)
+        got = np.asarray(jax.jit(fnb)(batch)[0])
+        f1 = jax.jit(fn1)
+        want = np.concatenate([np.asarray(f1(i)[0]) for i in imgs], axis=0)
+        np.testing.assert_array_equal(got, want)
+
+    def test_int8_rejects_float_graph_and_bad_mode(self):
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        with pytest.raises(ValueError, match="quantized"):
+            load_tflite(f"{REF_MODELS}/add.tflite",
+                        {"quantized_exec": "int8"})
+        with pytest.raises(ValueError, match="quantized_exec"):
+            load_tflite(f"{REF_MODELS}/add.tflite",
+                        {"quantized_exec": "fp4"})
+
+
 class TestReferenceZooSweep:
     """EVERY .tflite in the reference model zoo must import, run, and match
     the tflite interpreter (the broadcast-test model exercises the static

@@ -505,53 +505,65 @@ def main() -> None:
 
     # -- 4b. the reference's REAL quantized zoo model on XLA ----------------
     # mobilenet_v2_1.0_224_quant.tflite through the flatbuffer importer
-    # (models/tflite_import.py): uint8 in, fake-quant-simulated graph,
-    # uint8-requantized out — the reference's flagship edge config running
-    # as a jitted XLA program (interpreter match pinned by
-    # test_tflite_import). Skipped when the reference tree is absent.
+    # (models/tflite_import.py). The headline row runs the int8 execution
+    # path (tflite_int8.py: int8 GEMMs, int32 accumulators, requantize —
+    # the answer to the reference interpreter's native int8 kernels); the
+    # fake-quant byte-parity oracle is recorded as its own row. On the
+    # single-core CPU fallback batching past 1 only thrashes cache
+    # (measured), so the batch is per-platform. Interpreter match pinned
+    # by test_tflite_import. Skipped when the reference tree is absent.
     ref_quant = ("/root/reference/tests/test_models/models/"
                  "mobilenet_v2_1.0_224_quant.tflite")
-    if os.path.exists(ref_quant):
-        name = "mobilenet_v2_quant_tflite_on_xla"
-        _log(f"{name}: batch={batch} frames={frames}")
+    q_exec = os.environ.get("BENCHS_QUANT_EXEC", "int8")
+    q_batch = int(os.environ.get("BENCHS_QUANT_BATCH",
+                                 "1" if on_cpu else str(batch)))
+    quant_rows = [("mobilenet_v2_quant_tflite_on_xla", q_exec, q_batch),
+                  ("mobilenet_v2_quant_tflite_on_xla_oracle",
+                   "fake-quant", q_batch)]
+    for name, exec_mode, qb in quant_rows if os.path.exists(ref_quant) else []:
+        _log(f"{name}: exec={exec_mode} batch={qb} frames={frames}")
         try:
             q_custom = ",".join(
-                p for p in (f"batch:{batch}", mesh_custom) if p)
+                p for p in (f"quantized_exec:{exec_mode}",
+                            f"batch:{qb}" if qb > 1 else "",
+                            mesh_custom) if p)
+            agg = (f"! tensor_aggregator frames-out={qb} frames-dim=0 "
+                   "concat=true " if qb > 1 else "")
             pipe = parse_launch(
                 f"tensor_src num-buffers={frames} dimensions=3:224:224:1 "
                 "types=uint8 pattern=random "
-                f"! tensor_aggregator frames-out={batch} frames-dim=0 "
-                "concat=true "
+                f"{agg}"
                 "! queue max-size-buffers=4 "
                 f"! tensor_filter framework=jax model={ref_quant} "
                 f"custom={q_custom} sync-invoke=false "
                 "! tensor_sink name=out max-stored=1")
-            fps_b, n = _run_fps(pipe, "out", frames // batch,
+            fps_b, n = _run_fps(pipe, "out", frames // qb,
                                 warmup_batches, deadline)
-            extra = {}
+            extra = {"quantized_exec": exec_mode}
             try:
                 from nnstreamer_tpu.models.tflite_import import load_tflite
 
-                q_fn, _, _ = load_tflite(ref_quant, {})
-                extra = _model_perf(q_fn, (1, 224, 224, 3), "uint8",
-                                    fps_b * batch,
-                                    n_chips=n_dev if mesh_custom else 1)
+                q_fn, _, _ = load_tflite(
+                    ref_quant, {"quantized_exec": exec_mode})
+                extra.update(_model_perf(
+                    q_fn, (1, 224, 224, 3), "uint8", fps_b * qb,
+                    n_chips=n_dev if mesh_custom else 1))
             except Exception as e:  # noqa: BLE001
                 _log(f"{name} aux (mfu) failed: {e}")
             extra.update(_mesh_fields(mesh_custom, n_dev))
-            record(name, fps_b * batch, n * batch, batch, extra)
+            record(name, fps_b * qb, n * qb, qb, extra)
         except Exception as e:
             _log(f"{name} FAILED: {e}")
-            record(name, 0.0, 0, batch)
+            record(name, 0.0, 0, qb)
 
     # -- 4c. the SAME quant model on the reference's flagship backend -------
     # framework=tflite (interpreter, host CPU, per-frame — the reference's
     # operating mode, tensor_filter_tensorflow_lite.cc): the self-measured
     # baseline column BASELINE.md asks for. The ratio of 4b to this row is
-    # "our XLA path vs the reference's path on identical hardware+file".
-    # NOTE on CPU-fallback runs: 4b simulates the integer graph in float
-    # for byte-exactness, so the interpreter's native int8 kernels win on
-    # host CPU — the ratio is meaningful when 4b ran on the accelerator.
+    # "our XLA path vs the reference's path on identical hardware+file";
+    # since r5's int8 execution path + depthwise shift-add it is ~1.0 even
+    # on the single-core CPU fallback (r4 was 0.05 with the fake-quant
+    # float simulation) and the accelerator adds the MXU on top.
     if os.path.exists(ref_quant):
         name = "mobilenet_v2_quant_tflite_interpreter"
         n_f = min(frames, 128)  # interpreter is host-CPU; keep bounded
