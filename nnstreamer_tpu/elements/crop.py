@@ -36,6 +36,13 @@ class TensorCrop(Element):
         PadTemplate("info", PadDirection.SINK, Caps.new("other/tensors")),
     )
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    PROPERTIES = {
+        # reference gsttensor_crop.c lateness (ms): tolerated pts distance
+        # between the raw frame and its crop-info frame; -1 = pair blindly
+        "lateness": Prop(-1, int,
+                         "max |raw.pts - info.pts| in ms to accept a pair "
+                         "(-1 = no check; late info drops the raw frame)"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -53,6 +60,10 @@ class TensorCrop(Element):
                 return
             raw = self._raw_q.pop(0)
             info = self._info_q.pop(0)
+        lateness = self.props["lateness"]
+        if (lateness >= 0 and raw.pts is not None and info.pts is not None
+                and abs(raw.pts - info.pts) * 1000.0 > lateness):
+            return  # info too far from this frame: drop the pair
         frame = np.asarray(raw.as_numpy().tensors[0])
         regions = np.asarray(info.as_numpy().tensors[0]).reshape(-1, 4).astype(np.int64)
         # crop H/W: frame is (..., H, W, C); leading axes preserved

@@ -91,6 +91,11 @@ class DataRepoSrc(SourceElement):
         "tensors_sequence": Prop(None, str,
                                  "read only these tensor indices of each "
                                  "sample, in order (reference prop)"),
+        # reference gstdatareposrc.c:191-196: optional caps override
+        # describing the sample format (wins over the JSON's gst_caps)
+        "caps": Prop(None, str,
+                     "caps string describing the stored samples "
+                     "(optional; overrides the metadata JSON)"),
     }
 
     def __init__(self, name=None, **props):
@@ -106,9 +111,12 @@ class DataRepoSrc(SourceElement):
         self._native_reader = None
 
     def get_src_caps(self) -> Caps:
-        with open(self.props["json"]) as fh:
-            meta = json.load(fh)
-        caps = parse_caps_string(meta["gst_caps"])
+        if self.props["caps"]:
+            caps = parse_caps_string(self.props["caps"])
+        else:
+            with open(self.props["json"]) as fh:
+                meta = json.load(fh)
+            caps = parse_caps_string(meta["gst_caps"])
         self._info = tensors_info_from_caps(caps)
         self._sample_size = self._info.nbytes
         # reference tensors-sequence: read only the chosen tensors of each

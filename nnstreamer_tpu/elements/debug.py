@@ -18,6 +18,19 @@ from ..runtime.pad import Pad, PadDirection, PadTemplate
 from ..utils.log import logger
 
 
+def _flagish(v) -> bool:
+    """Reference debug properties are GFlags/GEnum: numeric flag values
+    and words like 'all'/'enabled' mean on, 0/'none'/'disabled' off."""
+    s = str(v).strip().lower()
+    if s.lstrip("-").isdigit():
+        return int(s) != 0
+    if s in ("all", "enabled", "enable"):
+        return True
+    if s in ("none", "disabled", "disable"):
+        return False
+    return prop_bool(v)
+
+
 @register_element
 class TensorDebug(TransformElement):
     ELEMENT_NAME = "tensor_debug"
@@ -25,8 +38,16 @@ class TensorDebug(TransformElement):
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, any_media_caps()),)
     PROPERTIES = {
         "output_mode": Prop("log", str, "log | console | none"),
-        "capsinfo": Prop(True, prop_bool, "print caps on negotiation"),
-        "metainfo": Prop(True, prop_bool, "print per-buffer shapes/timestamps"),
+        "capsinfo": Prop(True, _flagish, "print caps on negotiation"),
+        "metainfo": Prop(True, _flagish, "print per-buffer shapes/timestamps"),
+    }
+    # the reference's property spellings (gsttensor_debug.c:249-271:
+    # output-method flags, capability enum, metadata flags — numeric flag
+    # words accepted via _flagish)
+    PROP_ALIASES = {
+        "output_method": "output_mode",
+        "capability": "capsinfo",
+        "metadata": "metainfo",
     }
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:

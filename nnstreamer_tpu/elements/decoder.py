@@ -52,6 +52,9 @@ class TensorDecoder(TransformElement):
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, any_media_caps()),)
     PROPERTIES = _option_props()
 
+    READONLY_PROPS = ("sub-plugins",)
+    SUBPLUGIN_KIND = SubpluginKind.DECODER  # read-only sub-plugins prop
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         mode = self.props["mode"]

@@ -84,7 +84,33 @@ class TensorSrcIIO(SourceElement):
                                       "as the device delivers / 100Hz poll)"),
         "raw": Prop(False, prop_bool, "emit raw ints instead of scaled float32"),
         "num_buffers": Prop(-1, int, "stop after N scans (-1 = endless)"),
+        # reference gsttensor_srciio.c:315-379 property breadth
+        "mode": Prop("continuous", str,
+                     "one-shot = emit a single scan then EOS; continuous = "
+                     "stream (reference operating modes)"),
+        "dev_dir": Prop("/dev", str,
+                        "device-node directory for buffered reads "
+                        "(reference dev-dir; tests point it at a fake)"),
+        "trigger": Prop("", str,
+                        "trigger name written to trigger/current_trigger "
+                        "at start (buffered mode; best-effort like the "
+                        "reference's sysfs write)"),
+        "trigger_number": Prop(-1, int,
+                               "or: trigger index -> trigger name "
+                               "'trigger<N>'"),
+        "channels": Prop("auto", str,
+                         "'auto' = all enabled scan channels; or explicit "
+                         "indices '1,3,5' to enable exactly those"),
+        "buffer_capacity": Prop(1, int,
+                                "kernel ring capacity request (accepted; "
+                                "reads here are scan-at-a-time so depth "
+                                "does not change delivery)"),
+        "merge_channels_data": Prop(True, prop_bool,
+                                    "true = one tensor with all channels "
+                                    "(reference default); false = one "
+                                    "tensor per channel"),
     }
+    PROP_ALIASES = {"iio_base_dir": "base_dir"}
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -146,6 +172,19 @@ class TensorSrcIIO(SourceElement):
                     chans.append(c)
         if not chans:
             raise ElementError(f"{self.describe()}: no enabled channels")
+        want = str(self.props["channels"]).strip().lower()
+        if want and want != "auto":
+            try:
+                keep = {int(p) for p in want.split(",")}
+            except ValueError:
+                raise ElementError(
+                    f"{self.describe()}: channels must be 'auto' or a "
+                    f"','-separated index list, not '{want}'")
+            chans = [c for c in chans if c.index in keep]
+            if not chans:
+                raise ElementError(
+                    f"{self.describe()}: no enabled channel has an index "
+                    f"in {sorted(keep)}")
         self._channels = sorted(chans, key=lambda c: c.index)
 
     def _read_scalar(self, fname: str, default: float) -> float:
@@ -168,7 +207,20 @@ class TensorSrcIIO(SourceElement):
                     fh.write(str(freq))
             except OSError:
                 pass  # fixed-rate devices reject writes; poll pacing still applies
-        dev_node = os.path.join("/dev", os.path.basename(self._dir))
+        trig = self.props["trigger"]
+        if not trig and self.props["trigger_number"] >= 0:
+            trig = f"trigger{self.props['trigger_number']}"
+        if trig:
+            # reference: select the capture trigger via sysfs (best effort
+            # — polled/fake trees have no trigger directory)
+            try:
+                with open(os.path.join(self._dir, "trigger",
+                                       "current_trigger"), "w") as fh:
+                    fh.write(trig)
+            except OSError:
+                pass
+        dev_node = os.path.join(self.props["dev_dir"],
+                                os.path.basename(self._dir))
         if os.path.exists(dev_node) and os.path.isdir(
                 os.path.join(self._dir, "scan_elements")):
             try:
@@ -176,11 +228,16 @@ class TensorSrcIIO(SourceElement):
             except OSError:
                 self._dev_fh = None
         dtype = "int32" if self.props["raw"] else "float32"
-        spec = TensorSpec((len(self._channels),), dtype)
-        return caps_from_tensors_info(TensorsInfo.of(spec))
+        if self.props["merge_channels_data"]:
+            specs = [TensorSpec((len(self._channels),), dtype)]
+        else:
+            specs = [TensorSpec((1,), dtype) for _ in self._channels]
+        return caps_from_tensors_info(TensorsInfo.of(*specs))
 
     def create(self) -> Optional[Buffer]:
         limit = self.props["num_buffers"]
+        if str(self.props["mode"]).lower() in ("one-shot", "oneshot"):
+            limit = 1 if limit < 0 else min(limit, 1)
         if 0 <= limit <= self._count:
             return None
         freq = self.props["frequency"]
@@ -195,9 +252,13 @@ class TensorSrcIIO(SourceElement):
             return None
         self._count += 1
         if self.props["raw"]:
-            return Buffer([np.asarray(values, np.int32)])
-        scaled = (np.asarray(values, np.float64) + self._offset) * self._scale
-        return Buffer([scaled.astype(np.float32)])
+            arr = np.asarray(values, np.int32)
+        else:
+            arr = ((np.asarray(values, np.float64) + self._offset)
+                   * self._scale).astype(np.float32)
+        if self.props["merge_channels_data"]:
+            return Buffer([arr])
+        return Buffer([arr[i:i + 1] for i in range(len(self._channels))])
 
     def _scan_layout(self) -> Tuple[List[int], int]:
         """Kernel IIO scan layout: each element is aligned to its own storage

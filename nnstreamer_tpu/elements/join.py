@@ -21,6 +21,7 @@ class Join(Element):
                     PadPresence.REQUEST),
     )
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, any_media_caps()),)
+    READONLY_PROPS = ("active-pad", "n-pads")
 
     def maybe_negotiate(self) -> None:
         # any single negotiated sink pad is enough (branches are exclusive);
@@ -30,5 +31,16 @@ class Join(Element):
             return
         self.srcpad.push_event(Event.caps(linked[0].caps))
 
+    # reference gstjoin.c read-only props: which sink pad forwarded last,
+    # and how many sink pads exist
+    def get_property(self, key: str):
+        key_n = key.replace("-", "_")
+        if key_n == "active_pad":
+            return getattr(self, "_active_pad", "")
+        if key_n == "n_pads":
+            return len(self.sink_pads)
+        return super().get_property(key)
+
     def chain(self, pad: Pad, buf: Buffer) -> None:
+        self._active_pad = pad.name
         self.push(buf)

@@ -49,6 +49,29 @@ BASE_EPOCH_KEY = "mqtt_base_time_epoch_us"
 SENT_EPOCH_KEY = "mqtt_sent_time_epoch_us"
 
 
+# connection knobs both elements share (reference mqttsink.c/mqttsrc.c)
+_MQTT_CLIENT_PROPS = {
+    "cleansession": Prop(True, prop_bool,
+                         "MQTT CONNECT clean-session flag (reference "
+                         "cleansession)"),
+    "keep_alive_interval": Prop(60, int,
+                                "MQTT keep-alive seconds (PINGREQ cadence; "
+                                "reference keep-alive-interval)"),
+    "mqtt_qos": Prop(0, int,
+                     "delivery QoS; this transport implements QoS0 — "
+                     "higher values degrade to 0 with a logged warning"),
+    "debug": Prop(False, prop_bool,
+                  "log every MQTT publish/receive (reference debug)"),
+}
+
+
+def _mqtt_qos0(element) -> None:
+    if element.props["mqtt_qos"] > 0:
+        logger.warning("%s: mqtt-qos=%d requested but this transport is "
+                       "QoS0; delivering at most once",
+                       element.name, element.props["mqtt_qos"])
+
+
 def _epoch_clock(element) -> EpochClock:
     """Build the element's epoch clock; ntp-sync failures post a warning
     and fall back to the raw wall clock (the reference logs and keeps
@@ -85,6 +108,14 @@ class MqttSink(SinkElement):
                          "correct the wall clock via SNTP (reference ntp-sync)"),
         "ntp_srvs": Prop(DEFAULT_SERVERS, str,
                          "HOST:PORT,... NTP servers (reference ntp-srvs)"),
+        **_MQTT_CLIENT_PROPS,
+        "pub_wait_timeout": Prop(1.0, float,
+                                 "accepted for compat: QoS0 publishes do "
+                                 "not wait for broker acknowledgement"),
+        "max_buffer_size": Prop(0, int,
+                                "accepted for compat: frames are framed "
+                                "exactly (core/serialize), no send buffer "
+                                "to size"),
     }
 
     def __init__(self, name=None, **props):
@@ -108,8 +139,11 @@ class MqttSink(SinkElement):
         if self.props["broker"] == "embedded":
             self._broker = mqtt.get_embedded_broker(port)
             host, port = self._broker.host, self._broker.port
-        self._client = mqtt.MqttClient(host, port,
-                                       client_id=self.props["client_id"])
+        _mqtt_qos0(self)
+        self._client = mqtt.MqttClient(
+            host, port, client_id=self.props["client_id"],
+            keep_alive=self.props["keep_alive_interval"],
+            clean_session=self.props["cleansession"])
         self._clock = _epoch_clock(self)
         self._base_epoch_us = _base_epoch_us(self, self._clock)
 
@@ -120,6 +154,9 @@ class MqttSink(SinkElement):
     def render(self, buf: Buffer) -> None:
         hdr = {BASE_EPOCH_KEY: self._base_epoch_us,
                SENT_EPOCH_KEY: self._clock.epoch_us()}
+        if self.props["debug"]:
+            logger.info("%s: publish pts=%s to '%s'", self.name, buf.pts,
+                        self.props["pub_topic"])
         self._client.publish(self.props["pub_topic"],
                              pack_tensors(buf, extra_meta=hdr))
 
@@ -149,6 +186,11 @@ class MqttSrc(SourceElement):
                          "correct the wall clock via SNTP (reference ntp-sync)"),
         "ntp_srvs": Prop(DEFAULT_SERVERS, str,
                          "HOST:PORT,... NTP servers (reference ntp-srvs)"),
+        **_MQTT_CLIENT_PROPS,
+        "sub_timeout": Prop(0, int,
+                            "subscribe/caps-wait timeout in MICROSECONDS "
+                            "(reference sub-timeout; >0 overrides "
+                            "timeout)"),
     }
 
     def __init__(self, name=None, **props):
@@ -166,12 +208,21 @@ class MqttSrc(SourceElement):
         topic = self.props["sub_topic"]
         if not topic:
             raise ElementError(f"{self.describe()}: sub-topic required")
-        self._client = mqtt.MqttClient(self.props["host"], self.props["port"],
-                                       client_id=self.props["client_id"],
-                                       timeout=self.props["timeout"])
+        timeout = self.props["timeout"]
+        if self.props["sub_timeout"] > 0:  # reference unit: microseconds
+            timeout = self.props["sub_timeout"] / 1e6
+        _mqtt_qos0(self)
+        self._client = mqtt.MqttClient(
+            self.props["host"], self.props["port"],
+            client_id=self.props["client_id"], timeout=timeout,
+            keep_alive=self.props["keep_alive_interval"],
+            clean_session=self.props["cleansession"])
         caps_topic = f"{topic}/caps"
 
         def on_message(t: str, body: bytes) -> None:
+            if self.props["debug"]:
+                logger.info("%s: message on '%s' (%d bytes)",
+                            self.name, t, len(body))
             if t == caps_topic:
                 self._caps_q.put(body.decode())
             elif t == topic:
@@ -182,14 +233,13 @@ class MqttSrc(SourceElement):
 
         # '<topic>/#' also matches '<topic>' itself (MQTT wildcard rules),
         # so one subscription covers the caps topic and the data stream
-        self._client.subscribe(f"{topic}/#", on_message,
-                               timeout=self.props["timeout"])
+        self._client.subscribe(f"{topic}/#", on_message, timeout=timeout)
         try:
-            caps_str = self._caps_q.get(timeout=self.props["timeout"])
+            caps_str = self._caps_q.get(timeout=timeout)
         except _queue.Empty:
             raise ElementError(
                 f"{self.describe()}: no retained caps on '{caps_topic}' "
-                f"within {self.props['timeout']}s — is the publisher up?")
+                f"within {timeout}s — is the publisher up?")
         return parse_caps_string(caps_str)
 
     def start(self) -> None:

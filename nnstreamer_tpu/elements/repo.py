@@ -73,9 +73,28 @@ REPO = TensorRepo()
 class TensorRepoSink(SinkElement):
     ELEMENT_NAME = "tensor_repo_sink"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
-    PROPERTIES = {"slot_index": Prop(0, int, "repository slot id")}
+    PROPERTIES = {
+        "slot_index": Prop(0, int, "repository slot id"),
+        # reference gsttensor_reposink.c signal-rate: cap repo updates per
+        # second of stream time (0 = every buffer)
+        "signal_rate": Prop(0, int,
+                            "max repo updates per second of pts "
+                            "(0 = every buffer)"),
+    }
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        # replayed pipelines restart pts at 0: a stale throttle epoch
+        # would mute the repo slot until pts passed the old run's
+        self._last_push_pts = None
 
     def render(self, buf: Buffer) -> None:
+        rate = self.props["signal_rate"]
+        if rate > 0 and buf.pts is not None:
+            last = getattr(self, "_last_push_pts", None)
+            if last is not None and (buf.pts - last) < 1.0 / rate:
+                return
+            self._last_push_pts = buf.pts
         REPO.slot(self.props["slot_index"]).push(buf)
 
     def handle_eos(self) -> None:

@@ -12,7 +12,7 @@ from typing import Optional
 from ..core import Buffer, Caps, MessageType
 from ..registry.elements import register_element
 from ..registry.subplugin import SubpluginKind, get as get_subplugin
-from ..runtime.element import ElementError, Prop, SinkElement
+from ..runtime.element import ElementError, Prop, SinkElement, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 from ..trainer.base import TrainerBackend, TrainerProperties
 
@@ -29,9 +29,27 @@ class TensorTrainer(SinkElement):
         "num_inputs": Prop(1, int, "leading tensors per frame used as inputs"),
         "num_labels": Prop(1, int, "trailing tensors per frame used as labels"),
         "num_training_samples": Prop(0, int, "samples per epoch (0 = one epoch of all data)"),
+        "num_validation_samples": Prop(0, int,
+                                       "samples held out for validation "
+                                       "(reference gsttensor_trainer.c:229)"),
         "epochs": Prop(1, int),
         "custom": Prop("", str, "backend options 'batch:32,lr:0.001'"),
+        # reference :248: write-only one-way switch — complete (stop+save)
+        # after the current epoch
+        "ready_to_complete": Prop(False, prop_bool,
+                                  "set mid-run to finish training after "
+                                  "the current epoch (cannot be reverted)"),
     }
+
+    def set_property(self, key: str, value) -> None:
+        super().set_property(key, value)
+        # construct-time sets run before __init__ defines self.backend;
+        # the switch only acts on a live backend (mid-run toggle)
+        backend = getattr(self, "backend", None)
+        if (key.replace("-", "_") == "ready_to_complete"
+                and self.props["ready_to_complete"]
+                and backend is not None):
+            backend.end_of_data()  # finish with the data it has
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -48,6 +66,7 @@ class TensorTrainer(SinkElement):
             num_inputs=self.props["num_inputs"],
             num_labels=self.props["num_labels"],
             num_training_samples=self.props["num_training_samples"],
+            num_validation_samples=self.props["num_validation_samples"],
             epochs=self.props["epochs"],
             custom=self.props["custom"],
         ))

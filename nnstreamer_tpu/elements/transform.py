@@ -31,6 +31,16 @@ class TensorTransform(TransformElement):
     ELEMENT_NAME = "tensor_transform"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    # reference read-only constant (gsttensor_transform.c
+    # transpose-rank-limit): max rank the transpose option string addresses
+    TRANSPOSE_RANK_LIMIT = 4
+    READONLY_PROPS = ("transpose-rank-limit",)
+
+    def get_property(self, key: str):
+        if key.replace("-", "_") == "transpose_rank_limit":
+            return self.TRANSPOSE_RANK_LIMIT
+        return super().get_property(key)
+
     PROPERTIES = {
         "mode": Prop(None, str, "dimchg|typecast|arithmetic|transpose|stand|clamp|padding"),
         "option": Prop("", str, "mode-specific option string"),

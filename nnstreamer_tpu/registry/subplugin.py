@@ -124,6 +124,12 @@ def _scan_configured(kind: SubpluginKind) -> None:
             logger.warning("configured subplugin module %s failed to import", mod)
 
 
+def names_csv(kind: SubpluginKind) -> str:
+    """Registered subplugin names as one comma-joined string — the value
+    of the reference's read-only ``sub-plugins`` element property."""
+    return ",".join(names(kind))
+
+
 def names(kind: SubpluginKind) -> List[str]:
     with _lock:
         _scan_builtin(kind)

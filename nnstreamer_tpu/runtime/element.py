@@ -171,8 +171,18 @@ class Element:
         if "=" in ln:
             self.set_property(*(p.strip() for p in ln.split("=", 1)))
 
+    # elements hosting a subplugin registry set this to their
+    # SubpluginKind; the reference's read-only ``sub-plugins`` property
+    # (registered subplugin names) is then served here for all of them
+    SUBPLUGIN_KIND = None
+
     def get_property(self, key: str) -> Any:
-        return self.props[key.replace("-", "_")]
+        key_n = key.replace("-", "_")
+        if key_n == "sub_plugins" and self.SUBPLUGIN_KIND is not None:
+            from ..registry.subplugin import names_csv
+
+            return names_csv(self.SUBPLUGIN_KIND)
+        return self.props[key_n]
 
     # -- pads ---------------------------------------------------------------
     def _add_pad(self, tmpl: PadTemplate, name: str) -> Pad:

@@ -46,9 +46,14 @@ def peak_flops_per_chip(device=None) -> Optional[float]:
         return None
     names.append(str(getattr(device, "device_kind", "")).lower())
     # tunneled rigs report an opaque kind; the TPU env contract still
-    # names the generation (e.g. TPU_ACCELERATOR_TYPE=v5litepod-4)
-    names.append(os.environ.get("TPU_ACCELERATOR_TYPE", "").lower())
-    names.append(os.environ.get("PALLAS_AXON_TPU_GEN", "").lower())
+    # names the generation (e.g. TPU_ACCELERATOR_TYPE=v5litepod-4) — but
+    # only consult it on TPU-family devices: a stale TPU env var on some
+    # other accelerator platform must not fabricate a TPU peak/MFU
+    from .hw_accel import is_tpu_platform
+
+    if is_tpu_platform(getattr(device, "platform", "")):
+        names.append(os.environ.get("TPU_ACCELERATOR_TYPE", "").lower())
+        names.append(os.environ.get("PALLAS_AXON_TPU_GEN", "").lower())
     for name in names:
         for key, peak in _PEAK_BF16:
             if key and key in name:

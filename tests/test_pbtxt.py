@@ -27,8 +27,12 @@ def test_emission_matches_reference_shape():
     assert 'input_stream: "tensor_converter_0_0"' in pb
     assert 'output_stream: "tee_0_0"' in pb and \
            'output_stream: "tee_0_1"' in pb
-    # second queue instance numbers its node (convert.c:28-39)
-    assert 'output_stream: "queue_1_0"' in pb
+    # a stream feeding a sink is named after the SINK node
+    # (convert.c:79-81) — the queues' output streams are the sink names,
+    # so the top-level output_stream lines reference produced streams
+    assert pb.count('output_stream: "tensor_sink"') == 2  # top + queue node
+    assert pb.count('output_stream: "fakesink"') == 2
+    assert "queue_1_0" not in pb
     # sinks do not get node blocks (reference: both-sided elements only)
     assert "tensor_sinkCalculator" not in pb
 
@@ -70,6 +74,25 @@ def test_from_pbtxt_colon_free_node_and_nested_options():
     p = parse_launch(back)
     kinds = sorted(e.ELEMENT_NAME for e in p.elements.values())
     assert kinds == ["tensor_converter", "tensor_sink", "videotestsrc"]
+
+
+def test_property_roundtrip_via_node_options():
+    """node_options carries non-default properties (exceeding the
+    reference converter's TODO, convert.c:111) and from_pbtxt replays
+    them into the reconstructed launch line."""
+    launch = ("tensor_src num-buffers=3 dimensions=4 types=float32 "
+              "! tensor_transform mode=arithmetic option=add:1.5 "
+              "! tensor_sink")
+    pb = to_pbtxt(parse_launch(launch))
+    assert 'option: "mode=arithmetic"' in pb
+    assert 'option: "option=add:1.5"' in pb
+    p2 = parse_launch(from_pbtxt(pb))
+    tr = [e for e in p2.elements.values()
+          if e.ELEMENT_NAME == "tensor_transform"][0]
+    assert tr.props["mode"] == "arithmetic"
+    assert tr.props["option"] == "add:1.5"
+    # second conversion is stable
+    assert to_pbtxt(p2).count('option: "mode=arithmetic"') == 1
 
 
 def test_from_pbtxt_missing_producer_raises():

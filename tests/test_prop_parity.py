@@ -1,0 +1,99 @@
+"""Property-parity regression: every reference element property is either
+implemented or n/a-annotated (VERDICT r4 #7 — the corpus kept finding
+gaps one at a time; tools/prop_diff.py kills the class)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+REF = "/root/reference"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference tree absent")
+def test_prop_diff_zero_unexplained():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "prop_diff.py"), REF],
+        capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, f"unexplained property gaps:\n{r.stderr}"
+    assert '"missing_unexplained_total": 0' in r.stdout.replace(" ", "").replace(
+        '"missing_unexplained_total":0', '"missing_unexplained_total": 0')
+
+
+class TestNewReferenceProps:
+    def test_rate_counters_and_duplicate(self):
+        # 10 fps input, 20 fps target: every frame duplicated once
+        pipe = parse_launch(
+            "tensor_src num-buffers=5 dimensions=2 types=float32 "
+            "framerate=10 pattern=counter "
+            "! tensor_rate framerate=20 name=r ! tensor_sink name=out")
+        out = []
+        pipe.get("out").connect(out.append)
+        pipe.run(timeout=20)
+        r = pipe.get("r")
+        assert r.get_property("in") == 5
+        assert r.get_property("duplicate") >= 3
+        assert r.get_property("out") == len(out)
+        assert r.get_property("drop") == 0
+
+    def test_filter_readonly_introspection(self):
+        pipe = parse_launch(
+            "tensor_src num-buffers=2 dimensions=4 types=float32 "
+            "! tensor_filter framework=jax model=builtin://scaler?factor=2 "
+            "name=f inputlayout=NHWC ! tensor_sink name=out")
+        pipe.run(timeout=30)
+        f = pipe.get("f")
+        assert "jax" in f.get_property("sub-plugins")
+        assert f.get_property("inputranks") == "1"
+        assert f.props["inputlayout"] == "NHWC"
+
+    def test_transform_rank_limit_and_join_pads(self):
+        pipe = parse_launch(
+            "join name=j ! tensor_sink name=out "
+            "tensor_src num-buffers=2 dimensions=2:3 types=float32 "
+            "! tensor_transform mode=transpose option=1:0 name=t "
+            "! j.sink_0")
+        pipe.run(timeout=20)
+        assert pipe.get("t").get_property("transpose-rank-limit") == 4
+        assert pipe.get("j").get_property("n-pads") == 1
+        assert pipe.get("j").get_property("active-pad") == "sink_0"
+
+    def test_crop_lateness_drops_stale_pairs(self):
+        from nnstreamer_tpu.core import Buffer
+        from nnstreamer_tpu.registry.elements import make_element
+
+        crop = make_element("tensor_crop", lateness=50)  # 50 ms
+        got = []
+        crop.src_pads[0].push = got.append  # capture without a pipeline
+        raw = Buffer([np.zeros((8, 8, 3), np.uint8)], pts=0.0)
+        info = Buffer([np.asarray([[1, 1, 4, 4]], np.float32)], pts=0.2)
+        crop.chain(crop.sink_pads[0], raw)
+        crop.chain(crop.sink_pads[1], info)
+        assert got == []  # 200 ms apart > 50 ms lateness: pair dropped
+        raw2 = Buffer([np.zeros((8, 8, 3), np.uint8)], pts=0.5)
+        info2 = Buffer([np.asarray([[1, 1, 4, 4]], np.float32)], pts=0.51)
+        crop.chain(crop.sink_pads[0], raw2)
+        crop.chain(crop.sink_pads[1], info2)
+        assert len(got) == 1
+
+    def test_iio_channel_select_and_split(self, tmp_path):
+        # fake polled sysfs tree: three *_raw channels
+        dev = tmp_path / "iio:device0"
+        dev.mkdir()
+        (dev / "name").write_text("fake\n")
+        for i, v in enumerate((100, 200, 300)):
+            (dev / f"in_voltage{i}_raw").write_text(f"{v}\n")
+        pipe = parse_launch(
+            f"tensor_src_iio iio-base-dir={tmp_path} device=fake "
+            "channels=0,2 merge-channels-data=false mode=one-shot raw=true "
+            "! tensor_sink name=out")
+        out = []
+        pipe.get("out").connect(out.append)
+        pipe.run(timeout=20)
+        assert len(out) == 1  # one-shot
+        tensors = [np.asarray(t) for t in out[0].tensors]
+        assert [int(t[0]) for t in tensors] == [100, 300]  # channels 0,2 split

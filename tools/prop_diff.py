@@ -1,0 +1,184 @@
+"""Systematic element-property parity diff vs the reference.
+
+Extracts every ``g_param_spec_*("name", ...)`` registered by the
+reference's element sources (gst/nnstreamer, gst/edge, gst/mqtt,
+gst/datarepo, gst/join) and diffs each element's property list against
+our element's ``PROPERTIES`` + ``PROP_ALIASES``. Gaps must be closed or
+explained: ``NA_PROPS`` below carries the per-property rationale for
+every intentional absence (GObject plumbing, Tizen/edge-OS specifics,
+hardware we don't ship). The corpus kept exposing these one at a time
+(``async``, ``latency``, ``num-buffers`` — VERDICT r4 #7); this kills
+the class.
+
+Writes ``PROPDIFF.json`` at the repo root and prints one summary line;
+exits non-zero when an UNEXPLAINED gap exists (CI-able).
+
+Run:  python tools/prop_diff.py  [reference_root]
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+REF = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+
+# reference source file -> our element factory name(s)
+FILE_TO_ELEMENT = {
+    "gst/nnstreamer/elements/gsttensor_aggregator.c": ["tensor_aggregator"],
+    "gst/nnstreamer/elements/gsttensor_converter.c": ["tensor_converter"],
+    "gst/nnstreamer/elements/gsttensor_crop.c": ["tensor_crop"],
+    "gst/nnstreamer/elements/gsttensor_debug.c": ["tensor_debug"],
+    "gst/nnstreamer/elements/gsttensor_decoder.c": ["tensor_decoder"],
+    "gst/nnstreamer/elements/gsttensor_demux.c": ["tensor_demux"],
+    "gst/nnstreamer/elements/gsttensor_if.c": ["tensor_if"],
+    "gst/nnstreamer/elements/gsttensor_merge.c": ["tensor_merge"],
+    "gst/nnstreamer/elements/gsttensor_mux.c": ["tensor_mux"],
+    "gst/nnstreamer/elements/gsttensor_rate.c": ["tensor_rate"],
+    "gst/nnstreamer/elements/gsttensor_reposink.c": ["tensor_reposink"],
+    "gst/nnstreamer/elements/gsttensor_reposrc.c": ["tensor_reposrc"],
+    "gst/nnstreamer/elements/gsttensor_sink.c": ["tensor_sink"],
+    "gst/nnstreamer/elements/gsttensor_sparsedec.c": ["tensor_sparse_dec"],
+    "gst/nnstreamer/elements/gsttensor_sparseenc.c": ["tensor_sparse_enc"],
+    "gst/nnstreamer/elements/gsttensor_split.c": ["tensor_split"],
+    "gst/nnstreamer/elements/gsttensor_srciio.c": ["tensor_src_iio"],
+    "gst/nnstreamer/elements/gsttensor_trainer.c": ["tensor_trainer"],
+    "gst/nnstreamer/elements/gsttensor_transform.c": ["tensor_transform"],
+    # tensor_filter: element + the shared common property block
+    "gst/nnstreamer/tensor_filter/tensor_filter_common.c": ["tensor_filter"],
+    "gst/nnstreamer/tensor_query/tensor_query_client.c": ["tensor_query_client"],
+    "gst/nnstreamer/tensor_query/tensor_query_serversrc.c": ["tensor_query_serversrc"],
+    "gst/nnstreamer/tensor_query/tensor_query_serversink.c": ["tensor_query_serversink"],
+    "gst/edge/edge_src.c": ["edgesrc"],
+    "gst/edge/edge_sink.c": ["edgesink"],
+    "gst/mqtt/mqttsrc.c": ["mqttsrc"],
+    "gst/mqtt/mqttsink.c": ["mqttsink"],
+    "gst/datarepo/gstdatareposrc.c": ["datareposrc"],
+    "gst/datarepo/gstdatareposink.c": ["datareposink"],
+    "gst/join/gstjoin.c": ["join"],
+}
+
+# property -> why it is intentionally absent here (n/a with reason).
+# "*" applies to every element.
+NA_PROPS = {
+    "mqttsink": {
+        "num-buffers": "reference maps basesink num-buffers onto its "
+                       "sink for tests; our mqttsink ends with upstream "
+                       "EOS (bounded by the source's num-buffers)",
+        "max-msg-buf-size": "transport buffering knob of the paho "
+                            "client; our MQTT client sizes frames "
+                            "exactly (core/serialize framing)",
+    },
+    "mqttsrc": {
+        "is-live": "our sources are always live-push; no basesrc "
+                   "live-mode toggle exists",
+    },
+    "tensor_src_iio": {
+        "poll-timeout": "device reads here poll with a fixed 0.1s "
+                        "select() slice that stop() can always cancel; "
+                        "the reference's knob tunes its poll() loop only",
+    },
+}
+
+
+def extract_ref_props(path: str):
+    text = open(path, errors="replace").read()
+    # property name = first string literal of any g_param_spec_*(
+    return sorted(set(re.findall(r'g_param_spec_\w+\s*\(\s*"([\w-]+)"', text)))
+
+
+def our_props(element_name: str):
+    from nnstreamer_tpu.registry.elements import (
+        get_factory,
+        load_standard_elements,
+    )
+
+    load_standard_elements()
+    cls = get_factory(element_name)
+    props = set()
+    for klass in cls.__mro__:  # Element merges PROPERTIES across the MRO
+        props |= {k.replace("_", "-")
+                  for k in (getattr(klass, "PROPERTIES", {}) or {})}
+        props |= {k.replace("_", "-")
+                  for k in (getattr(klass, "PROP_ALIASES", {}) or {})}
+    # READ-ONLY props are served by get_property overrides, not the
+    # PROPERTIES table — elements declare them in READONLY_PROPS
+    for klass in cls.__mro__:
+        props |= {p.replace("_", "-")
+                  for p in (getattr(klass, "READONLY_PROPS", ()) or ())}
+    # runtime-level universals: name= is grammar; config-file is handled
+    # in Element.set_property for EVERY element (the reference exposes it
+    # on decoder/filter only)
+    props |= {"name", "config-file"}
+    return props, cls
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    result = {}
+    unexplained_total = 0
+    for rel, elements in sorted(FILE_TO_ELEMENT.items()):
+        path = os.path.join(REF, rel)
+        if not os.path.exists(path):
+            continue
+        ref_props = extract_ref_props(path)
+        for element in elements:
+            try:
+                ours, _cls = our_props(element)
+            except Exception as e:  # noqa: BLE001
+                result[element] = {"error": f"no such element here: {e}"}
+                unexplained_total += 1
+                continue
+            na = {**NA_PROPS.get("*", {}), **NA_PROPS.get(element, {})}
+            missing, annotated = [], {}
+            for p in ref_props:
+                if p in ours:
+                    continue
+                reason = na.get(p)
+                if reason:
+                    annotated[p] = reason
+                else:
+                    missing.append(p)
+            unexplained_total += len(missing)
+            result[element] = {
+                "ref_file": rel,
+                "ref_props": ref_props,
+                "implemented": sorted(p for p in ref_props if p in ours),
+                "na": annotated,
+                "missing_unexplained": missing,
+                "extra_here": sorted(
+                    ours - set(ref_props) - {"name"}),
+            }
+    summary = {
+        "metric": "element_property_parity",
+        "elements": len(result),
+        "ref_props_total": sum(
+            len(v.get("ref_props", [])) for v in result.values()),
+        "implemented_total": sum(
+            len(v.get("implemented", [])) for v in result.values()),
+        "na_total": sum(len(v.get("na", {})) for v in result.values()),
+        "missing_unexplained_total": unexplained_total,
+    }
+    out = {"summary": summary, "elements": result}
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "PROPDIFF.json")
+    with open(out_path, "w") as fh:
+        json.dump(out, fh, indent=1, sort_keys=True)
+    print(json.dumps(summary))
+    if unexplained_total:
+        for el, v in sorted(result.items()):
+            for p in v.get("missing_unexplained", []):
+                print(f"  MISSING {el}.{p}", file=sys.stderr)
+            if "error" in v:
+                print(f"  ERROR {el}: {v['error']}", file=sys.stderr)
+    return 1 if unexplained_total else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
