@@ -208,13 +208,18 @@ class MqttSrc(SourceElement):
         topic = self.props["sub_topic"]
         if not topic:
             raise ElementError(f"{self.describe()}: sub-topic required")
-        timeout = self.props["timeout"]
-        if self.props["sub_timeout"] > 0:  # reference unit: microseconds
-            timeout = self.props["sub_timeout"] / 1e6
+        # sub-timeout (reference unit: microseconds) bounds the SUBSCRIBE
+        # handshake + caps wait only; the TCP connect keeps the separate
+        # 'timeout' property so a short caps wait can't break connecting
+        # to a slow broker
+        sub_timeout = self.props["timeout"]
+        if self.props["sub_timeout"] > 0:
+            sub_timeout = self.props["sub_timeout"] / 1e6
         _mqtt_qos0(self)
         self._client = mqtt.MqttClient(
             self.props["host"], self.props["port"],
-            client_id=self.props["client_id"], timeout=timeout,
+            client_id=self.props["client_id"],
+            timeout=self.props["timeout"],
             keep_alive=self.props["keep_alive_interval"],
             clean_session=self.props["cleansession"])
         caps_topic = f"{topic}/caps"
@@ -233,13 +238,14 @@ class MqttSrc(SourceElement):
 
         # '<topic>/#' also matches '<topic>' itself (MQTT wildcard rules),
         # so one subscription covers the caps topic and the data stream
-        self._client.subscribe(f"{topic}/#", on_message, timeout=timeout)
+        self._client.subscribe(f"{topic}/#", on_message,
+                               timeout=sub_timeout)
         try:
-            caps_str = self._caps_q.get(timeout=timeout)
+            caps_str = self._caps_q.get(timeout=sub_timeout)
         except _queue.Empty:
             raise ElementError(
                 f"{self.describe()}: no retained caps on '{caps_topic}' "
-                f"within {timeout}s — is the publisher up?")
+                f"within {sub_timeout}s — is the publisher up?")
         return parse_caps_string(caps_str)
 
     def start(self) -> None:

@@ -101,6 +101,8 @@ class TensorTrainer(SinkElement):
                 epochs=s.epoch_count,
                 training_loss=s.training_loss,
                 training_accuracy=s.training_accuracy,
+                validation_loss=s.validation_loss,
+                validation_accuracy=s.validation_accuracy,
                 model_saved=saved if done else None,
                 samples=self._pushed,
             )

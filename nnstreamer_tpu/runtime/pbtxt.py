@@ -63,7 +63,11 @@ def _launch_options(el) -> List[str]:
     original launch value when the element kept one, else skipped —
     pbtxt remains loadable either way."""
     out: List[str] = []
-    declared = getattr(type(el), "PROPERTIES", {})
+    # property tables are split across the MRO (Element merges them in
+    # __init__ as _prop_defs) — reading one class's table would omit
+    # inherited props like a paced source's num-buffers
+    declared = getattr(el, "_prop_defs", None) or getattr(
+        type(el), "PROPERTIES", {})
     values = getattr(el, "props", {})
     for key, prop in declared.items():
         v = values.get(key, prop.default)

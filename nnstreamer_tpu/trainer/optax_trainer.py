@@ -169,6 +169,14 @@ class OptaxTrainer(TrainerBackend):
         # donate params/opt_state: weights stay resident on device across steps
         self._train_step = jax.jit(step, donate_argnums=(0, 1))
 
+        def eval_step(params, inputs, labels):
+            out = loss_fn(params, inputs, labels)
+            if isinstance(out, tuple):
+                return out[0], out[1]
+            return out, {}
+
+        self._eval_step = jax.jit(eval_step)
+
     def _train_loop(self) -> None:
         try:
             self._run_epochs()
@@ -180,11 +188,24 @@ class OptaxTrainer(TrainerBackend):
     def _run_epochs(self) -> None:
         props = self.props
         per_epoch = props.num_training_samples or None
+        # reference epoch layout (gsttensor_trainer.c): each epoch is
+        # num-training-samples TRAIN frames followed by
+        # num-validation-samples VALIDATION frames (evaluated, no update)
+        val_per_epoch = props.num_validation_samples
+        if val_per_epoch and not per_epoch:
+            # without an epoch size there is no train/validation boundary;
+            # silently training on the "held-out" frames would report a
+            # fictitious validation score
+            raise ValueError(
+                "num-validation-samples requires num-training-samples to "
+                "delimit the epoch's train/validation split")
         batch_in: List[List[np.ndarray]] = []
         batch_lb: List[List[np.ndarray]] = []
         seen = 0
         epoch_losses: List[float] = []
         epoch_accs: List[float] = []
+        val_losses: List[float] = []
+        val_accs: List[float] = []
         ended = False
 
         def flush_batch():
@@ -203,8 +224,18 @@ class OptaxTrainer(TrainerBackend):
                 epoch_accs.append(float(metrics["accuracy"]))
             batch_in, batch_lb = [], []
 
+        def eval_sample(inputs, labels):
+            if self.params is None:
+                return  # no training step ran yet: nothing to evaluate
+            ins = [np.stack([x]) for x in inputs]
+            lbs = [np.stack([y]) for y in labels]
+            loss, metrics = self._eval_step(self.params, ins, lbs)
+            val_losses.append(float(loss))
+            if "accuracy" in metrics:
+                val_accs.append(float(metrics["accuracy"]))
+
         def end_epoch():
-            nonlocal epoch_losses, epoch_accs, seen
+            nonlocal epoch_losses, epoch_accs, val_losses, val_accs, seen
             flush_batch()
             if epoch_losses:
                 self.stats.training_loss = float(np.mean(epoch_losses))
@@ -212,8 +243,13 @@ class OptaxTrainer(TrainerBackend):
             if epoch_accs:
                 self.stats.training_accuracy = float(np.mean(epoch_accs))
                 self.accuracies.append(self.stats.training_accuracy)
+            if val_losses:
+                self.stats.validation_loss = float(np.mean(val_losses))
+            if val_accs:
+                self.stats.validation_accuracy = float(np.mean(val_accs))
             self.stats.epoch_count += 1
             epoch_losses, epoch_accs, seen = [], [], 0
+            val_losses, val_accs = [], []
             if self.stats.epoch_count % self._ckpt_every == 0:
                 self.save_checkpoint()  # no-op without ckpt_dir/params
 
@@ -224,12 +260,17 @@ class OptaxTrainer(TrainerBackend):
             if kind == "end":
                 ended = True
                 break
-            batch_in.append(inputs)
-            batch_lb.append(labels)
             seen += 1
-            if len(batch_in) >= self.batch_size:
+            if per_epoch and val_per_epoch and seen > per_epoch:
+                # validation tail of the epoch: evaluate, never update
                 flush_batch()
-            if per_epoch and seen >= per_epoch:
+                eval_sample(inputs, labels)
+            else:
+                batch_in.append(inputs)
+                batch_lb.append(labels)
+                if len(batch_in) >= self.batch_size:
+                    flush_batch()
+            if per_epoch and seen >= per_epoch + val_per_epoch:
                 end_epoch()
                 if self.stats.epoch_count >= props.epochs:
                     break

@@ -335,6 +335,42 @@ class TestConfigFile:
         assert np.array_equal(masked(to_bgrx(frame), cells), masked(gold, cells))
 
 
+class TestReferenceOwnConfigFile:
+    """The corpus line shape that was fixture-missing until r5:
+    ``tensor_decoder option1=mobilenet-ssd config-file=config_file.0``
+    with the reference's OWN config_file.0 verbatim (its relative
+    labels/priors paths resolve from the suite directory, exactly as
+    SSAT runs it) — byte parity against the shipped golden."""
+
+    def test_reference_config_file_0_byte_match(self, monkeypatch):
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        monkeypatch.chdir(REF)  # the suite dir: relative fixtures resolve
+        assert os.path.exists("config_file.0")
+        pipe = parse_launch(
+            "tensor_mux name=mux sync-mode=nosync "
+            "! tensor_decoder option1=mobilenet-ssd config-file=config_file.0 "
+            "option8=classic ! tensor_sink name=out "
+            "appsrc name=b caps=other/tensors,format=static,"
+            "dimensions=4:1917,types=float32 ! mux.sink_0 "
+            "appsrc name=d caps=other/tensors,format=static,"
+            "dimensions=91:1917,types=float32 ! mux.sink_1 ")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.play()
+        pipe.get("b").push_buffer(
+            fixture("mobilenetssd_tensors.0.0").reshape(-1, 4))
+        pipe.get("d").push_buffer(
+            fixture("mobilenetssd_tensors.1.0").reshape(-1, 91))
+        pipe.get("b").end_of_stream()
+        pipe.get("d").end_of_stream()
+        pipe.wait(timeout=20)
+        pipe.stop()
+        frame, cells = np.asarray(got[0].tensors[0]), got[0].meta["label_cells"]
+        gold = golden("mobilenetssd_golden.0", 120, 160)
+        assert np.array_equal(masked(to_bgrx(frame), cells), masked(gold, cells))
+
+
 class TestReferenceTopology:
     """The reference's ACTUAL launch shape — multifilesrc feeding raw
     fixture files through tensor_converter input-dim/input-type into a

@@ -78,6 +78,30 @@ class TestTrainer:
         import os
         assert os.path.exists(save)
 
+    def test_validation_split(self, tmp_path, model_config):
+        """num-validation-samples: each epoch's tail frames are evaluated
+        without updates (reference gsttensor_trainer.c:229) and reported
+        as validation loss in the completion message."""
+        data, meta = make_dataset(tmp_path, n=80)
+        pipe = parse_launch(
+            f"datareposrc location={data} json={meta} epochs=4 "
+            f"! tensor_trainer name=t model-config={model_config} "
+            "num-training-samples=64 num-validation-samples=16 epochs=4 "
+            "custom=batch:16,lr:0.1"
+        )
+        pipe.play()
+        msg = pipe.bus.wait_for((MessageType.ELEMENT, MessageType.ERROR),
+                                timeout=60)
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert msg is not None and msg.type is MessageType.ELEMENT
+        assert msg.data["event"] == "training-complete"
+        assert msg.data["epochs"] == 4
+        # the held-out tail was evaluated: validation tracks training on
+        # this learnable linear task
+        assert msg.data["validation_loss"] > 0.0
+        assert msg.data["validation_loss"] < 2.0
+
     def test_resume_from_checkpoint(self, tmp_path, model_config):
         data, meta = make_dataset(tmp_path)
         ckpt1 = str(tmp_path / "m1.msgpack")

@@ -45,7 +45,7 @@ def _n_params(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
 
 
-def _step_time(cfg, mesh, sizes, tokens_np, reps: int = 2):
+def _step_time(cfg, mesh, tokens_np, reps: int = 2):
     step, shard_params, data_sharding = make_train_step(cfg, mesh, lr=1e-2)
     params = shard_params(init_params(cfg))
     tokens = jax.device_put(tokens_np, data_sharding)
@@ -57,7 +57,7 @@ def _step_time(cfg, mesh, sizes, tokens_np, reps: int = 2):
     for _ in range(reps):
         params, loss = step(params, tokens)
     jax.block_until_ready(loss)
-    return (time.perf_counter() - t0) / reps, compile_s, float(loss), params
+    return (time.perf_counter() - t0) / reps, compile_s, float(loss)
 
 
 def main() -> None:
@@ -76,7 +76,7 @@ def main() -> None:
         cfg = TransformerConfig(max_seq=seq, attn_impl=attn_impl, **CFG)
         if n_params is None:
             n_params = _n_params(init_params(cfg))
-        step_s, compile_s, loss, _ = _step_time(cfg, mesh, sizes, tokens_np)
+        step_s, compile_s, loss = _step_time(cfg, mesh, tokens_np)
         rows.append({
             "config": f"lm_train_step_30m_8dev_{attn_impl}",
             "value": round(1.0 / step_s, 3), "unit": "steps/s",
@@ -89,8 +89,7 @@ def main() -> None:
 
     mesh1 = make_mesh(jax.devices()[:1], {"dp": 1, "tp": 1, "sp": 1})
     cfg1 = TransformerConfig(max_seq=seq, **CFG)
-    step_s, compile_s, loss, _ = _step_time(
-        cfg1, mesh1, {"dp": 1, "tp": 1, "sp": 1}, tokens_np)
+    step_s, compile_s, loss = _step_time(cfg1, mesh1, tokens_np)
     rows.append({
         "config": "lm_train_step_30m_1dev",
         "value": round(1.0 / step_s, 3), "unit": "steps/s",
