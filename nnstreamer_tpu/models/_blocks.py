@@ -42,10 +42,30 @@ def make_blocks(compute_dtype: str = "bfloat16"):
 
         @nn.compact
         def __call__(self, x):
-            x = nn.Conv(self.features, self.kernel, strides=self.strides,
-                        padding="SAME", feature_group_count=self.groups,
-                        kernel_dilation=self.dilation, use_bias=False,
-                        dtype=cdt)(x)
+            in_ch = x.shape[-1]
+            if self.groups > 1 and self.groups == in_ch \
+                    and self.features % in_ch == 0:
+                # depthwise: shifted elementwise multiply-adds instead of
+                # feature_group_count — XLA-CPU's grouped-conv lowering is
+                # ~50x slower (measured, tflite_import.depthwise_shift_add)
+                # and on TPU this fuses into VPU ops rather than issuing
+                # 1-wide MXU matmuls. Kernel shape matches what flax would
+                # create for the grouped conv: (kh, kw, 1, features).
+                from .tflite_import import depthwise_shift_add
+
+                kh, kw = self.kernel
+                w = self.param("depthwise_kernel",
+                               nn.initializers.lecun_normal(),
+                               (kh, kw, 1, self.features))
+                x = depthwise_shift_add(
+                    x.astype(cdt), w.astype(cdt).transpose(2, 0, 1, 3),
+                    (self.strides, self.strides), "SAME",
+                    (self.dilation, self.dilation))
+            else:
+                x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                            padding="SAME", feature_group_count=self.groups,
+                            kernel_dilation=self.dilation, use_bias=False,
+                            dtype=cdt)(x)
             # inference-mode BN = per-channel scale + bias
             scale = self.param("bn_scale", nn.initializers.ones, (self.features,))
             bias = self.param("bn_bias", nn.initializers.zeros, (self.features,))
