@@ -1,0 +1,51 @@
+"""Regenerate tiny_int8_perchannel.tflite.
+
+A minimal full-integer-quantized model in the MODERN tflite style the
+reference zoo lacks: int8 storage, per-channel weight scales, native
+int8 input/output (the zoo's mobilenet_v2 quant is legacy uint8
+per-tensor). Exercises the int8 executor's per-channel zero-point and
+multiplier handling (test_tflite_import.py
+test_per_channel_int8_model_all_modes_byte_exact).
+
+Run:  python tests/fixtures/make_tiny_int8_perchannel.py
+"""
+import os
+
+import numpy as np
+import tensorflow as tf
+
+
+def main() -> None:
+    inp = tf.keras.Input((16, 16, 3))
+    x = tf.keras.layers.Conv2D(8, 3, strides=2, padding="same",
+                               activation="relu")(inp)
+    x = tf.keras.layers.DepthwiseConv2D(3, padding="same",
+                                        activation="relu")(x)
+    x = tf.keras.layers.Conv2D(16, 1, activation="relu")(x)
+    x = tf.keras.layers.GlobalAveragePooling2D()(x)
+    x = tf.keras.layers.Dense(10)(x)
+    x = tf.keras.layers.Softmax()(x)
+    model = tf.keras.Model(inp, x)
+
+    conv = tf.lite.TFLiteConverter.from_keras_model(model)
+    conv.optimizations = [tf.lite.Optimize.DEFAULT]
+    rng = np.random.default_rng(0)
+
+    def rep():
+        for _ in range(20):
+            yield [rng.random((1, 16, 16, 3), np.float32)]
+
+    conv.representative_dataset = rep
+    conv.target_spec.supported_ops = [tf.lite.OpsSet.TFLITE_BUILTINS_INT8]
+    conv.inference_input_type = tf.int8
+    conv.inference_output_type = tf.int8
+    blob = conv.convert()
+    out = os.path.join(os.path.dirname(__file__),
+                       "tiny_int8_perchannel.tflite")
+    with open(out, "wb") as fh:
+        fh.write(blob)
+    print(f"wrote {out} ({len(blob)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -187,6 +187,52 @@ class TestEdgePubSub:
         finally:
             mqtt_mod.release_embedded_broker(broker)
 
+    def test_edgesink_wait_connection(self):
+        """wait-connection holds the first frames until a subscriber is
+        attached (reference edge_sink.c) — no frame may be lost to the
+        pub/sub void, and connection-timeout bounds the wait."""
+        pub = parse_launch(
+            "tensor_src num-buffers=5 dimensions=2 types=float32 "
+            "pattern=counter framerate=50 "
+            "! edgesink name=pub topic=held port=0 wait-connection=true "
+            "connection-timeout=10")
+        pub.play()
+        deadline = time.monotonic() + 5
+        while pub.get("pub").bound_port == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        port = pub.get("pub").bound_port
+        try:
+            time.sleep(0.3)  # frames are produced but held, not dropped
+            sub = parse_launch(
+                f"edgesrc dest-host=127.0.0.1 dest-port={port} topic=held "
+                "! tensor_sink name=out")
+            out = []
+            sub.get("out").connect(out.append)
+            sub.play()
+            deadline = time.monotonic() + 10
+            while len(out) < 5 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            sub.stop()
+            # ALL 5 frames arrive, including the pre-subscribe ones —
+            # frame 0 proves nothing was published into the void
+            assert len(out) == 5
+            assert float(np.asarray(out[0].tensors[0])[0]) == 0.0
+        finally:
+            pub.stop()
+
+    def test_edgesink_wait_connection_timeout_errors(self):
+        from nnstreamer_tpu.core import MessageType
+
+        pub = parse_launch(
+            "tensor_src num-buffers=3 dimensions=2 types=float32 "
+            "framerate=50 "
+            "! edgesink topic=nobody port=0 wait-connection=true "
+            "connection-timeout=0.2")
+        pub.play()
+        msg = pub.bus.wait_for((MessageType.ERROR,), timeout=5)
+        pub.stop()
+        assert msg is not None and "no subscriber" in msg.data["error"]
+
     def test_unknown_topic(self):
         pub = parse_launch(
             "tensor_src num-buffers=50 dimensions=1 framerate=50 "

@@ -371,6 +371,37 @@ class TestQuantizedExecModes:
         want = np.concatenate([np.asarray(f1(i)[0]) for i in imgs], axis=0)
         np.testing.assert_array_equal(got, want)
 
+    def test_per_channel_int8_model_all_modes_byte_exact(self):
+        """Modern tflite quantization: int8 storage, PER-CHANNEL weight
+        scales, native int8 input/output. Fixture generated by the TF
+        converter (tests/fixtures/tiny_int8_perchannel.tflite — conv +
+        depthwise + 1x1 + dense + softmax). All three exec modes must
+        match the interpreter; this pins the int8 executor's per-channel
+        zero-point/multiplier handling, untested by the uint8 zoo."""
+        import jax
+
+        from nnstreamer_tpu.models.tflite_import import load_tflite
+
+        path = os.path.join(os.path.dirname(__file__), "fixtures",
+                            "tiny_int8_perchannel.tflite")
+        it = _interp(path)
+        rng = np.random.default_rng(3)
+        xs = [rng.integers(-128, 127, (1, 16, 16, 3)).astype(np.int8)
+              for _ in range(4)]
+        for mode in ("fake-quant", "int8", "float"):
+            fn, in_info, out_info = load_tflite(path, {"quantized_exec": mode})
+            assert in_info.specs[0].dtype.np_dtype == np.int8
+            jfn = jax.jit(fn)
+            worst = 0
+            for x in xs:
+                ref = _run_interp(it, x)[0]
+                got = np.asarray(jfn(x)[0])
+                assert got.dtype == ref.dtype
+                worst = max(worst,
+                            int(np.abs(got.astype(int) - ref.astype(int)).max()))
+                assert got.argmax() == ref.argmax()
+            assert worst <= 1, f"{mode}: byte diff {worst}"
+
     def test_int8_rejects_float_graph_and_bad_mode(self):
         from nnstreamer_tpu.models.tflite_import import load_tflite
 
