@@ -191,7 +191,19 @@ def configure_default_platform(log=None) -> Optional[str]:
             "NNS_TPU_PROBE_CACHE", "/tmp/nns_tpu_probe_cache.json"))
     if plat:
         _log(f"probe says default platform = {plat}")
-        jax.config.update("jax_platforms", plat)
+        if plat == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        else:
+            # The probed name is the DEVICE platform, which can differ
+            # from the registered plugin name under an interposing proxy:
+            # axon presents "TPU v5 lite0" devices whose .platform (and
+            # even jax.default_backend()) say "tpu", yet forcing
+            # jax_platforms=tpu selects the real TPU plugin and fails
+            # ("No jellyfish device found") — measured live r5. The probe
+            # measured DEFAULT selection, so replicate exactly that:
+            # clear any override and let jax pick again in-process.
+            os.environ.pop("JAX_PLATFORMS", None)
+            jax.config.update("jax_platforms", None)
         return None
     err = ("device platform probe timed out after %.0fs (init hang — tunnel stuck)"
            % timeout_s if plat is None
