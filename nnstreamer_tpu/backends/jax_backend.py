@@ -354,6 +354,17 @@ class JaxBackend(FilterBackend):
         zero FLOPs (the reference must probe backends with real invokes)."""
         import jax
 
+        if getattr(self._fn, "host_native", False):
+            # a native program has a fixed compiled contract; accept only
+            # the recorded shapes (use quantized_exec:int8 for flexibility)
+            if self._in_info is not None and [
+                (tuple(s.shape), s.dtype) for s in in_info.specs
+            ] == [(tuple(s.shape), s.dtype) for s in self._in_info.specs]:
+                return self._out_info
+            raise ValueError(
+                "host-native model: input info is fixed at load "
+                f"({self._in_info}); cannot retarget to {in_info}")
+
         specs = [
             jax.ShapeDtypeStruct(s.shape, s.dtype.np_dtype) for s in in_info.specs
         ]
@@ -372,7 +383,15 @@ class JaxBackend(FilterBackend):
         import jax
 
         if self._jit is None:
-            self._jit = jax.jit(lambda *xs: _as_tuple(self._fn(*xs)))
+            if getattr(self._fn, "host_native", False):
+                # host-native executor (e.g. quantized_exec:int8-native,
+                # models/tflite_q8_native.py): a C++ program, not a jax
+                # computation — invoke directly, never trace
+                fn = self._fn
+                self._jit = lambda *xs: _as_tuple(
+                    fn(*(np.asarray(x) for x in xs)))
+            else:
+                self._jit = jax.jit(lambda *xs: _as_tuple(self._fn(*xs)))
         return self._jit
 
     def compile_cache_info(self) -> dict:
@@ -409,6 +428,10 @@ class JaxBackend(FilterBackend):
         if self._fn is None:
             raise RuntimeError("jax backend: invoke before open")
         self._track_signature(inputs)
+        if getattr(self._fn, "host_native", False):
+            # host program: the wrapper converts to numpy anyway — any
+            # device staging here would be an H2D+D2H round trip per frame
+            return list(self._jitted()(*inputs))
         if self._mesh is not None:
             return self._invoke_sharded(inputs)
         device_inputs = []

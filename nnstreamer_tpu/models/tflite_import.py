@@ -379,13 +379,25 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
     float_output = str(options.get("float_output", "")).lower() in ("1", "true", "yes")
     q_exec = str(options.get("quantized_exec", "fake-quant")
                  ).lower().replace("_", "-")
-    if q_exec not in ("fake-quant", "int8", "float"):
+    if q_exec not in ("fake-quant", "int8", "int8-native", "float"):
         raise ValueError(
             f"tflite import: quantized_exec:{q_exec!r} not one of "
-            "fake-quant|int8|float")
-    # read early: gates the RESHAPE batch-1 rewrite widening below — a
-    # [1,-1] rewrite is only safe when the caller DECLARED a runtime batch
-    batch_mode = bool(options.get("batch"))
+            "fake-quant|int8|int8-native|float")
+    # parse + validate early: gates the RESHAPE batch-1 rewrite widening
+    # below (a [1,-1] rewrite is only safe when the caller DECLARED a
+    # runtime batch) and feeds the int8-native builder before the jax
+    # relabel block — one validation for every exec mode
+    batch_opt = options.get("batch")
+    batch_mode = bool(batch_opt)
+    batch_n = 1
+    if batch_opt:
+        try:
+            batch_n = int(batch_opt)
+        except ValueError:
+            raise ValueError(f"tflite option batch:{batch_opt!r} is not an "
+                             "integer")
+        if batch_n < 1:
+            raise ValueError(f"tflite option batch:{batch_n} must be >= 1")
 
     with open(path, "rb") as fh:
         data = fh.read()
@@ -783,6 +795,14 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
 
         fn = build_int8_fn(steps, tensors, raw_consts, in_idx, out_idx,
                            float_output)
+    elif q_exec == "int8-native":
+        # C++ engine with requantize fused into the GEMM epilogue
+        # (native/csrc/nns_q8.cc) — the arithmetic twin of the XLA int8
+        # path; fn is a host callable, NOT jax-traceable (fn.host_native)
+        from .tflite_q8_native import build_native_fn
+
+        fn = build_native_fn(steps, tensors, raw_consts, in_idx, out_idx,
+                             float_output, batch=batch_n)
 
     def _spec(idx, force_float):
         t = tensors[idx]
@@ -799,15 +819,8 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
     # accepts aggregated batches. The MXU wants batches; a recorded-shape
     # batch=1 contract would force per-frame dispatch (reference tflite
     # interpreter behavior, tensor_filter_tensorflow_lite.cc resize path).
-    batch_opt = options.get("batch")
     if batch_opt:
-        try:
-            b = int(batch_opt)
-        except ValueError:
-            raise ValueError(f"tflite option batch:{batch_opt!r} is not an "
-                             "integer")
-        if b < 1:
-            raise ValueError(f"tflite option batch:{b} must be >= 1")
+        b = batch_n
 
         def _rebatch(info):
             return TensorsInfo.of(*(
@@ -815,6 +828,10 @@ def load_tflite(path: str, options: Optional[Dict[str, str]] = None
                 for s in info.specs))
 
         in_info = _rebatch(in_info)
+        if getattr(fn, "host_native", False):
+            # the native builder baked the batch into buffer sizes; the
+            # contract relabel is all that's left to do here
+            return fn, in_info, _rebatch(out_info)
         shapes = [jax.ShapeDtypeStruct(s.shape, s.dtype.np_dtype)
                   for s in in_info.specs]
         try:

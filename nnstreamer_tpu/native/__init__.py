@@ -16,13 +16,12 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import List, Optional, Sequence
 
 import numpy as np
 
-from ..utils.log import logger
+from ._build import load_once
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_HERE, "libnns_core.so")
@@ -35,26 +34,6 @@ _build_failed = False
 ABI_VERSION = 1
 
 
-def _build() -> bool:
-    # build to a unique temp path, then atomically publish — concurrent
-    # processes may race to build; os.replace keeps every reader consistent
-    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-    cmd = [
-        os.environ.get("CXX", "g++"), "-O3", "-std=c++17", "-fPIC", "-shared",
-        "-Wall", "-fvisibility=hidden", "-o", tmp, _SRC, "-lpthread",
-    ]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
-    except (OSError, subprocess.TimeoutExpired) as e:  # g++ missing/hung
-        logger.warning("native build unavailable: %s", e)
-        return False
-    if proc.returncode != 0:
-        logger.warning("native build failed:\n%s", proc.stderr)
-        return False
-    os.replace(tmp, _LIB_PATH)
-    return True
-
-
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
     with _lib_lock:
@@ -62,30 +41,11 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_LIB_PATH) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
-        ):
-            if not _build():
-                _build_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError as e:
-            logger.warning("native load failed: %s", e)
+        lib = load_once(_SRC, _LIB_PATH, ABI_VERSION, "nns_abi_version",
+                        _bind, extra_args=("-lpthread",))
+        if lib is None:
             _build_failed = True
             return None
-        if lib.nns_abi_version() != ABI_VERSION:
-            # rebuild so the NEXT process gets a good library, but don't
-            # re-dlopen here: glibc dedups by pathname and would hand back
-            # the stale mapping — fail native for this process instead
-            logger.warning("native ABI mismatch; rebuilding and disabling "
-                           "native for this process")
-            os.unlink(_LIB_PATH)
-            _build()
-            _build_failed = True
-            return None
-        _bind(lib)
         _lib = lib
         return _lib
 

@@ -1,0 +1,72 @@
+"""Shared build-on-demand loader for the native (C++) libraries.
+
+One implementation of the compile/atomic-publish/mtime-rebuild/ABI-check
+sequence, used by both ``libnns_core.so`` (``__init__.py``) and
+``libnns_q8.so`` (``q8.py``). Concurrent processes may race to build;
+building to a temp path and ``os.replace``-publishing keeps every reader
+consistent. Callers keep their own per-module cache + failure latch and
+call :func:`load_once` under their own lock.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Callable, Optional, Sequence
+
+from ..utils.log import logger
+
+
+def build(src: str, lib_path: str, extra_args: Sequence[str] = (),
+          timeout: float = 180.0) -> bool:
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
+    cmd = [
+        os.environ.get("CXX", "g++"), "-O3", "-std=c++17", "-fPIC",
+        "-shared", "-Wall", "-fvisibility=hidden", "-o", tmp, src,
+        *extra_args,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+    except (OSError, subprocess.TimeoutExpired) as e:  # g++ missing/hung
+        logger.warning("native build unavailable (%s): %s",
+                       os.path.basename(src), e)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native build failed (%s):\n%s",
+                       os.path.basename(src), proc.stderr)
+        return False
+    os.replace(tmp, lib_path)
+    return True
+
+
+def load_once(src: str, lib_path: str, abi_version: int, abi_symbol: str,
+              bind: Callable[[ctypes.CDLL], None],
+              extra_args: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
+    """Build (if stale/missing), dlopen, ABI-check, and bind. Returns the
+    bound library or None; the caller latches the failure."""
+    if not os.path.exists(lib_path) or (
+        os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(lib_path)
+    ):
+        if not build(src, lib_path, extra_args):
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError as e:
+        logger.warning("native load failed (%s): %s",
+                       os.path.basename(lib_path), e)
+        return None
+    abi_fn = getattr(lib, abi_symbol)
+    abi_fn.restype = ctypes.c_uint64
+    if abi_fn() != abi_version:
+        # rebuild so the NEXT process gets a good library, but don't
+        # re-dlopen here: glibc dedups by pathname and would hand back
+        # the stale mapping — fail native for this process instead
+        logger.warning("native ABI mismatch (%s); rebuilding and disabling "
+                       "for this process", os.path.basename(lib_path))
+        os.unlink(lib_path)
+        build(src, lib_path, extra_args)
+        return None
+    bind(lib)
+    return lib
