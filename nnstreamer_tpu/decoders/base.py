@@ -32,6 +32,35 @@ class Decoder:
     def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
         raise NotImplementedError
 
+    # ---- device-side reduction (TPU-first extension) -------------------
+    #
+    # The reference decodes on host from the full model output
+    # (gsttensor_decoder.c maps every GstMemory before the subplugin's
+    # ``decode``). On an accelerator that forces a full-width device→host
+    # copy per frame — for segmentation that is the whole logits volume.
+    # A decoder that implements ``make_reduce`` instead splits decoding
+    # into two stages:
+    #
+    #   reduce  (device, jnp-traceable, batched) : raw tensors → compact
+    #           arrays (argmax maps, top-k candidates, keypoint indices)
+    #   decode_reduced (host, per frame)         : compact arrays → media
+    #
+    # The tensor_decoder element jit-compiles ``reduce`` once per input
+    # shape and runs it on the device-resident batch BEFORE any transfer,
+    # so only the reduced arrays cross the device→host boundary — and a
+    # whole aggregated batch amortizes one dispatch + one pull.
+
+    def make_reduce(self, in_info: TensorsInfo):
+        """Return a jnp-traceable ``fn(tensors) -> tuple[arrays]`` where
+        every input/output carries a leading batch axis, or None when the
+        decoder only decodes raw tensors on host (the default)."""
+        return None
+
+    def decode_reduced(self, arrays, in_info: TensorsInfo) -> Optional[Buffer]:
+        """Host finish for one frame of ``make_reduce`` outputs (each
+        array has the batch axis already stripped)."""
+        raise NotImplementedError
+
 
 def register_decoder(cls):
     register(SubpluginKind.DECODER, cls.MODE, cls)

@@ -69,10 +69,42 @@ class ImageSegment(Decoder):
             d = (255 * (d - d.min()) / max(float(d.max() - d.min()), 1e-9)).astype(np.uint8)
             return Buffer([np.repeat(d[..., None] if d.ndim == 2 else d, 3, axis=-1)])
         classes = a.argmax(-1) if a.ndim == 3 else a.astype(np.int64)
+        return self._render_classes(classes)
+
+    def _render_classes(self, classes: np.ndarray) -> Buffer:
         frame = self.pal[classes % len(self.pal)]
         out = Buffer([frame.astype(np.uint8)])
         out.meta["class_map"] = classes
         return out
+
+    def make_reduce(self, in_info: TensorsInfo):
+        """Device stage: the logits volume (B,H,W,C) never leaves HBM —
+        only the argmax class map (or normalized depth map) crosses D2H
+        (C× less traffic; the decode itself rides the model's dispatch)."""
+        import jax.numpy as jnp
+
+        if self.fmt == "snpe-depth":
+            def reduce_depth(ts):
+                d = ts[0].astype(jnp.float32)
+                axes = tuple(range(1, d.ndim))
+                lo = jnp.min(d, axis=axes, keepdims=True)
+                hi = jnp.max(d, axis=axes, keepdims=True)
+                return ((255 * (d - lo) / jnp.maximum(hi - lo, 1e-9))
+                        .astype(jnp.uint8),)
+            return reduce_depth
+
+        def reduce_classes(ts):
+            a = ts[0]
+            if a.ndim >= 4:  # (B,H,W,C) logits → class ids
+                return (jnp.argmax(a, -1).astype(jnp.int32),)
+            return (a.astype(jnp.int32),)  # already class ids
+        return reduce_classes
+
+    def decode_reduced(self, arrays, in_info: TensorsInfo) -> Optional[Buffer]:
+        a = np.asarray(arrays[0])
+        if self.fmt == "snpe-depth":
+            return Buffer([np.repeat(a[..., None] if a.ndim == 2 else a, 3, axis=-1)])
+        return self._render_classes(a.astype(np.int64))
 
 
 # Default keypoint set: the 14-joint human skeleton the reference ships
@@ -166,35 +198,18 @@ class PoseEstimation(Decoder):
     def get_out_caps(self, in_info: TensorsInfo) -> Optional[Caps]:
         return Caps.new(VIDEO_MIME, format="RGBA", width=self.width, height=self.height)
 
-    def _decode_points(self, tensors):
-        """→ (pts (K,2) int output px, scores (K,), valid (K,) bool)."""
-        t = np.asarray(tensors[0]).astype(np.float32)
-        if self.mode == "coords":
-            k = t.reshape(-1, t.shape[-1])
-            xs = np.clip(k[:, 0] * (self.width - 1), 0, self.width - 1)
-            ys = np.clip(k[:, 1] * (self.height - 1), 0, self.height - 1)
-            scores = k[:, 2] if k.shape[1] > 2 else np.ones(len(k), np.float32)
-            pts = np.stack([xs, ys], axis=1).astype(np.int64)
-            return pts, scores, scores >= 0.5
-        a = t[0] if t.ndim == 4 else t  # (gy, gx, K)
-        gy, gx, n = a.shape  # decode every channel; labels only name them
-        heat = a
-        if self.mode == "heatmap-offset":
-            heat = 1.0 / (1.0 + np.exp(-heat))
-        flat = heat.reshape(-1, n)
-        idx = flat.argmax(0)  # first max in (gy, gx) scan order, like the ref
-        scores = flat[idx, np.arange(n)]
-        my, mx = np.unravel_index(idx, (gy, gx))
-        if self.mode == "heatmap-offset":
-            if len(tensors) < 2:
-                raise ValueError(
-                    "pose_estimation: heatmap-offset needs a second tensor "
-                    "of per-cell offsets (gy, gx, 2K); got a single-tensor "
-                    "frame — mux the offsets stream or use heatmap-only")
-            off = np.asarray(tensors[1]).astype(np.float32)
-            off = off[0] if off.ndim == 4 else off  # (gy, gx, 2K)
-            oy = off[my, mx, np.arange(n)]
-            ox = off[my, mx, n + np.arange(n)]
+    def _points_from_coords(self, t: np.ndarray):
+        k = t.astype(np.float32).reshape(-1, t.shape[-1])
+        xs = np.clip(k[:, 0] * (self.width - 1), 0, self.width - 1)
+        ys = np.clip(k[:, 1] * (self.height - 1), 0, self.height - 1)
+        scores = k[:, 2] if k.shape[1] > 2 else np.ones(len(k), np.float32)
+        pts = np.stack([xs, ys], axis=1).astype(np.int64)
+        return pts, scores, scores >= 0.5
+
+    def _scale_from_grid(self, my, mx, gy: int, gx: int, oy=None, ox=None):
+        """Grid indices (+ optional posenet offsets) → output-frame px,
+        the reference's integer math (tensordec-pose.c :765-800)."""
+        if oy is not None:
             posx = mx / max(gx - 1, 1) * self.in_width + ox
             posy = my / max(gy - 1, 1) * self.in_height + oy
             xs = (posx * self.width / self.in_width).astype(np.int64)
@@ -208,10 +223,93 @@ class PoseEstimation(Decoder):
             ys = my * self.height // self.in_height
         xs = np.clip(xs, 0, self.width - 1)
         ys = np.clip(ys, 0, self.height - 1)
-        return np.stack([xs, ys], axis=1), scores, scores >= 0.5
+        return np.stack([xs, ys], axis=1)
+
+    def _decode_points(self, tensors):
+        """→ (pts (K,2) int output px, scores (K,), valid (K,) bool)."""
+        t = np.asarray(tensors[0]).astype(np.float32)
+        if self.mode == "coords":
+            return self._points_from_coords(t)
+        a = t[0] if t.ndim == 4 else t  # (gy, gx, K)
+        gy, gx, n = a.shape  # decode every channel; labels only name them
+        heat = a
+        if self.mode == "heatmap-offset":
+            heat = 1.0 / (1.0 + np.exp(-heat))
+        flat = heat.reshape(-1, n)
+        idx = flat.argmax(0)  # first max in (gy, gx) scan order, like the ref
+        scores = flat[idx, np.arange(n)]
+        my, mx = np.unravel_index(idx, (gy, gx))
+        oy = ox = None
+        if self.mode == "heatmap-offset":
+            if len(tensors) < 2:
+                raise ValueError(
+                    "pose_estimation: heatmap-offset needs a second tensor "
+                    "of per-cell offsets (gy, gx, 2K); got a single-tensor "
+                    "frame — mux the offsets stream or use heatmap-only")
+            off = np.asarray(tensors[1]).astype(np.float32)
+            off = off[0] if off.ndim == 4 else off  # (gy, gx, 2K)
+            oy = off[my, mx, np.arange(n)]
+            ox = off[my, mx, n + np.arange(n)]
+        pts = self._scale_from_grid(my, mx, gy, gx, oy, ox)
+        return pts, scores, scores >= 0.5
+
+    def make_reduce(self, in_info: TensorsInfo):
+        """Device stage: heatmap argmax + score/offset gather on the
+        accelerator — only (B,K) index/score rows cross D2H instead of
+        the full heatmap (and offset) volumes."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.mode == "coords":  # already tiny; batch the pull anyway
+            return lambda ts: (ts[0].astype(jnp.float32),)
+
+        offset = self.mode == "heatmap-offset"
+
+        def reduce(ts):
+            t = ts[0].astype(jnp.float32)  # (B, gy, gx, K)
+            b, gy, gx, n = t.shape
+            flat = t.reshape(b, gy * gx, n)
+            idx = jnp.argmax(flat, axis=1)  # (B, K) first-max scan order
+            b_ix = jnp.arange(b)[:, None]
+            k_ix = jnp.arange(n)[None, :]
+            raw = flat[b_ix, idx, k_ix]
+            scores = jax.nn.sigmoid(raw) if offset else raw
+            my = (idx // gx).astype(jnp.int32)
+            mx = (idx % gx).astype(jnp.int32)
+            outs = [my, mx, scores.astype(jnp.float32)]
+            if offset:
+                if len(ts) < 2:
+                    raise ValueError(
+                        "pose_estimation: heatmap-offset needs a second "
+                        "tensor of per-cell offsets (gy, gx, 2K)")
+                off = ts[1].astype(jnp.float32).reshape(b, gy * gx, 2 * n)
+                outs.append(off[b_ix, idx, k_ix])
+                outs.append(off[b_ix, idx, n + k_ix])
+            # grid dims ride along per frame — scaling must not depend on
+            # negotiated specs (flexible streams have none)
+            outs.append(jnp.broadcast_to(jnp.asarray([gy, gx], jnp.int32),
+                                         (b, 2)))
+            return tuple(outs)
+        return reduce
+
+    def decode_reduced(self, arrays, in_info: TensorsInfo) -> Optional[Buffer]:
+        if self.mode == "coords":
+            pts, scores, valid = self._points_from_coords(np.asarray(arrays[0]))
+            return self._render(pts, scores, valid)
+        my, mx, scores = (np.asarray(a) for a in arrays[:3])
+        gy, gx = (int(v) for v in np.asarray(arrays[-1]))
+        oy = ox = None
+        if self.mode == "heatmap-offset":
+            oy, ox = np.asarray(arrays[3]), np.asarray(arrays[4])
+        pts = self._scale_from_grid(my.astype(np.int64), mx.astype(np.int64),
+                                    gy, gx, oy, ox)
+        return self._render(pts, scores, scores >= 0.5)
 
     def decode(self, buf: Buffer, in_info: TensorsInfo) -> Optional[Buffer]:
         pts, scores, valid = self._decode_points(buf.tensors)
+        return self._render(pts, scores, valid)
+
+    def _render(self, pts, scores, valid) -> Buffer:
         frame = np.zeros((self.height, self.width, 4), np.uint8)
         n = len(pts)
         default_labels = self.labels == [nm for nm, _ in _POSE_DEFAULT]

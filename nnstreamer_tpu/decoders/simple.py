@@ -91,6 +91,26 @@ class ImageLabeling(Decoder):
         out.meta["labels"] = labels
         return out
 
+    def make_reduce(self, in_info: TensorsInfo):
+        """Device stage: argmax over class scores on the accelerator —
+        one int32 per frame crosses D2H instead of the score vector."""
+        import jax.numpy as jnp
+
+        def reduce(ts):
+            s = ts[0]
+            return (jnp.argmax(s.reshape(s.shape[0], -1), -1).astype(jnp.int32),)
+        return reduce
+
+    def decode_reduced(self, arrays, in_info: TensorsInfo) -> Optional[Buffer]:
+        i = int(np.asarray(arrays[0]))
+        label = self.labels[i] if i < len(self.labels) else str(i)
+        out = Buffer([np.frombuffer(label.encode(), np.uint8)])
+        out.meta["label_index"] = i
+        out.meta["label"] = label
+        out.meta["label_indices"] = [i]
+        out.meta["labels"] = [label]
+        return out
+
 
 @register_decoder
 class OctetStream(Decoder):

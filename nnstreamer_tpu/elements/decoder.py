@@ -36,7 +36,13 @@ _OPTION_DOCS = {
 
 
 def _option_props():
-    props = {"mode": Prop(None, str, "decoder subplugin name")}
+    props = {"mode": Prop(None, str, "decoder subplugin name"),
+             "frames_in": Prop(1, int,
+                               "frames batched along the leading axis of "
+                               "each incoming buffer (TPU-first extension: "
+                               "an upstream tensor_aggregator batch decodes "
+                               "in ONE device reduction and is emitted as "
+                               "frames-in per-frame media buffers)")}
     for i in range(1, _N_OPTIONS + 1):
         props[f"option{i}"] = Prop(
             None, str,
@@ -64,24 +70,107 @@ class TensorDecoder(TransformElement):
         self.decoder = cls() if isinstance(cls, type) else cls
         options = [self.props[f"option{i}"] for i in range(1, _N_OPTIONS + 1)]
         self.decoder.init(options)
+        if self.props["frames_in"] < 1:
+            raise ElementError(f"{self.describe()}: frames-in must be >= 1")
         self._in_info: Optional[TensorsInfo] = None
+        self._frame_info: Optional[TensorsInfo] = None
+        self._reduce_jit = None  # (fn, built) — built lazily per caps
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
         self._in_info = tensors_info_from_caps(caps)
+        self._frame_info = self._per_frame_info(self._in_info)
+        self._reduce_jit = None
+
+    def _per_frame_info(self, info: TensorsInfo) -> TensorsInfo:
+        """Strip the frames-in batch from the leading axis of each spec —
+        the decoder subplugin always negotiates/decodes per frame."""
+        fi = self.props["frames_in"]
+        if fi == 1 or not info.specs:
+            return info
+        from ..core.tensors import TensorSpec
+
+        specs = []
+        for s in info.specs:
+            if not s.shape or s.shape[0] % fi:
+                raise ElementError(
+                    f"{self.describe()}: frames-in={fi} does not divide "
+                    f"leading dim of {s.describe()}")
+            specs.append(TensorSpec((s.shape[0] // fi, *s.shape[1:]), s.dtype))
+        return TensorsInfo.of(*specs)
 
     def transform_caps(self, src_pad: Pad) -> Caps:
-        out = self.decoder.get_out_caps(self._in_info)
+        out = self.decoder.get_out_caps(self._frame_info)
         if out is None:
             raise ElementError(
-                f"{self.describe()}: decoder rejects input {self._in_info.describe()}"
+                f"{self.describe()}: decoder rejects input {self._frame_info.describe()}"
             )
         return out
 
-    def transform(self, buf: Buffer) -> Optional[Buffer]:
-        out = self.decoder.decode(buf.as_numpy(), self._in_info)
+    def _push_decoded(self, out: Optional[Buffer], src: Buffer) -> None:
         if out is None:
-            return None
+            return
         decoder_meta = out.meta  # decode() results must survive the metadata copy
-        out.copy_metadata_from(buf)
+        out.copy_metadata_from(src)
         out.meta.update(decoder_meta)
-        return out
+        self.push(out)
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        fi = self.props["frames_in"]
+        if fi > 1:
+            # static caps are validated at negotiation (_per_frame_info);
+            # flexible streams must not silently drop/misalign rows
+            for t in buf.tensors:
+                if t.shape[0] % fi:
+                    raise ElementError(
+                        f"{self.describe()}: frames-in={fi} does not divide "
+                        f"leading dim {t.shape[0]} of incoming tensor")
+        reduce_fn = self._get_reduce()
+        if reduce_fn is not None and buf.on_device:
+            # device path: ONE jitted reduction over the whole batch, ONE
+            # small device→host pull, then per-frame host rendering
+            import jax
+
+            reduced = jax.device_get(reduce_fn(list(buf.tensors)))
+            for f in range(fi):
+                out = self.decoder.decode_reduced(
+                    [a[f] for a in reduced], self._frame_info)
+                self._push_decoded(out, buf)
+            return
+        host = buf.as_numpy()
+        if fi == 1:
+            self._push_decoded(
+                self.decoder.decode(host, self._frame_info), buf)
+            return
+        for f in range(fi):  # host batch: split and decode per frame
+            frame = Buffer([t[f * (t.shape[0] // fi):(f + 1) * (t.shape[0] // fi)]
+                            for t in host.tensors])
+            self._push_decoded(
+                self.decoder.decode(frame, self._frame_info), buf)
+
+    def _get_reduce(self):
+        """Lazily jit the decoder's device reduction for the current caps.
+        The jitted fn reshapes the concat-batched layout (fi*d0, ...) to
+        (fi, d0, ...) so reduce always sees a leading batch axis."""
+        if self._reduce_jit is not None:
+            return self._reduce_jit[0]
+        fn = self.decoder.make_reduce(self._frame_info)
+        if fn is None:
+            self._reduce_jit = (None,)
+            return None
+        import jax
+
+        fi = self.props["frames_in"]
+
+        def batched(tensors):
+            # (fi*d0, ...) → (fi, ...) when the frame's own leading dim d0
+            # is 1 (the common NHWC case), else (fi, d0, ...) — reduce
+            # always sees axis 0 = batch over frames
+            split = []
+            for t in tensors:
+                d0 = t.shape[0] // fi
+                split.append(t.reshape(fi, *t.shape[1:]) if d0 == 1
+                             else t.reshape(fi, d0, *t.shape[1:]))
+            return fn(split)
+
+        self._reduce_jit = (jax.jit(batched),)
+        return self._reduce_jit[0]

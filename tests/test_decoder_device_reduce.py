@@ -1,0 +1,267 @@
+"""Device-side decoder reduction (TPU-first extension).
+
+The reference decodes on host from fully-mapped model output
+(gsttensor_decoder.c); our decoders may instead run a jitted ``reduce``
+on the device-resident batch and only pull compact arrays
+(decoders/base.py make_reduce). These tests pin:
+
+  * parity: reduced path == legacy host decode, per frame;
+  * batching: ``tensor_decoder frames-in=N`` emits N media buffers from
+    one aggregated input buffer (device AND host input);
+  * caps: out caps negotiate from the per-frame info, not the batch.
+"""
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+
+def run_collect(launch: str, push, sink_name="out", timeout=30.0):
+    pipe = parse_launch(launch)
+    sink = pipe.get(sink_name)
+    collected = []
+    sink.connect(collected.append)
+    src = pipe.get("in")
+    pipe.play()
+    for b in push:
+        src.push_buffer(b)
+    src.end_of_stream()
+    pipe.wait(timeout=timeout)
+    pipe.stop()
+    return collected
+
+
+def _legacy_frames(dec_launch: str, dims: str, frames):
+    """Per-frame host decode through the unbatched element (the
+    reference-shaped path) — the parity oracle."""
+    return run_collect(
+        f"appsrc name=in caps=other/tensors,format=static,dimensions={dims},"
+        f"types=float32 ! {dec_launch} ! tensor_sink name=out",
+        push=frames)
+
+
+def _device_batched(dec_launch: str, dims: str, batched, fi: int):
+    import jax.numpy as jnp
+
+    if isinstance(batched, (list, tuple)):
+        buf = Buffer([jnp.asarray(t) for t in batched])
+    else:
+        buf = Buffer([jnp.asarray(batched)])
+    return run_collect(
+        f"appsrc name=in caps=other/tensors,format=static,dimensions={dims},"
+        f"types=float32 ! {dec_launch} frames-in={fi} ! tensor_sink name=out",
+        push=[buf])
+
+
+class TestImageSegmentReduce:
+    def test_batched_device_parity(self):
+        rng = np.random.default_rng(0)
+        logits = rng.standard_normal((4, 8, 6, 5)).astype(np.float32)
+        dec = "tensor_decoder mode=image_segment option1=tflite-deeplab"
+        legacy = _legacy_frames(dec, "5:6:8:1", [logits[i:i + 1] for i in range(4)])
+        reduced = _device_batched(dec, "5:6:8:4", logits, 4)
+        assert len(legacy) == len(reduced) == 4
+        for a, b in zip(legacy, reduced):
+            np.testing.assert_array_equal(np.asarray(a.tensors[0]),
+                                          np.asarray(b.tensors[0]))
+            np.testing.assert_array_equal(a.meta["class_map"], b.meta["class_map"])
+
+    def test_snpe_depth_device(self):
+        rng = np.random.default_rng(1)
+        depth = rng.standard_normal((3, 8, 6)).astype(np.float32) * 7.0
+        dec = "tensor_decoder mode=image_segment option1=snpe-depth"
+        legacy = _legacy_frames(dec, "6:8:1", [depth[i] for i in range(3)])
+        reduced = _device_batched(dec, "6:8:3", depth, 3)
+        assert len(reduced) == 3
+        for a, b in zip(legacy, reduced):
+            # float min/max on device vs host: allow ±1 quantization step
+            d = np.abs(np.asarray(a.tensors[0]).astype(np.int16)
+                       - np.asarray(b.tensors[0]).astype(np.int16))
+            assert d.max() <= 1
+
+
+class TestPoseReduce:
+    def test_heatmap_only_parity(self):
+        rng = np.random.default_rng(2)
+        heat = rng.standard_normal((4, 6, 6, 14)).astype(np.float32)
+        dec = ("tensor_decoder mode=pose_estimation option1=48:48 "
+               "option2=heatmap")
+        legacy = _legacy_frames(dec, "14:6:6:1",
+                                [heat[i:i + 1] for i in range(4)])
+        reduced = _device_batched(dec, "14:6:6:4", heat, 4)
+        assert len(legacy) == len(reduced) == 4
+        for a, b in zip(legacy, reduced):
+            np.testing.assert_array_equal(np.asarray(a.tensors[0]),
+                                          np.asarray(b.tensors[0]))
+            ka = [(k["x"], k["y"], k["valid"]) for k in a.meta["keypoints"]]
+            kb = [(k["x"], k["y"], k["valid"]) for k in b.meta["keypoints"]]
+            assert ka == kb
+
+    def test_heatmap_offset_parity(self):
+        rng = np.random.default_rng(3)
+        heat = rng.standard_normal((3, 5, 5, 17)).astype(np.float32)
+        off = rng.standard_normal((3, 5, 5, 34)).astype(np.float32) * 3.0
+        dec = ("tensor_decoder mode=pose_estimation option1=64:64 "
+               "option2=32:32 option4=heatmap-offset")
+        legacy = _legacy_frames(
+            dec, "17:5:5:1.34:5:5:1",
+            [Buffer([heat[i:i + 1], off[i:i + 1]]) for i in range(3)])
+        reduced = _device_batched(dec, "17:5:5:3.34:5:5:3",
+                                  [heat, off], 3)
+        assert len(legacy) == len(reduced) == 3
+        for a, b in zip(legacy, reduced):
+            ka = [(k["x"], k["y"], k["valid"]) for k in a.meta["keypoints"]]
+            kb = [(k["x"], k["y"], k["valid"]) for k in b.meta["keypoints"]]
+            assert ka == kb
+
+
+class TestLabelingReduce:
+    def test_batched_labels(self, tmp_path):
+        labels = tmp_path / "labels.txt"
+        labels.write_text("\n".join(f"c{i}" for i in range(10)))
+        rng = np.random.default_rng(4)
+        scores = rng.random((5, 10)).astype(np.float32)
+        dec = f"tensor_decoder mode=image_labeling option1={labels}"
+        reduced = _device_batched(dec, "10:5", scores, 5)
+        assert [b.meta["label_index"] for b in reduced] == \
+            [int(i) for i in scores.argmax(-1)]
+
+    def test_host_batched_split(self, tmp_path):
+        """frames-in on HOST input: split + legacy per-frame decode."""
+        labels = tmp_path / "labels.txt"
+        labels.write_text("\n".join(f"c{i}" for i in range(10)))
+        rng = np.random.default_rng(5)
+        scores = rng.random((5, 10)).astype(np.float32)
+        out = run_collect(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=10:5,"
+            f"types=float32 ! tensor_decoder mode=image_labeling "
+            f"option1={labels} frames-in=5 ! tensor_sink name=out",
+            push=[scores])
+        assert [b.meta["label_index"] for b in out] == \
+            [int(i) for i in scores.argmax(-1)]
+
+
+class TestBoundingBoxReduce:
+    def _frames(self, rng, n=12, c=6, b=4):
+        boxes = np.sort(rng.random((b, n, 4)).astype(np.float32), axis=-1)
+        boxes = boxes[..., [0, 1, 2, 3]]
+        boxes = np.stack([boxes[..., 0] * 0.5, boxes[..., 1] * 0.5,
+                          0.5 + boxes[..., 2] * 0.5, 0.5 + boxes[..., 3] * 0.5],
+                         axis=-1)  # ymin<ymax, xmin<xmax
+        scores = rng.random((b, n, c)).astype(np.float32)
+        return boxes, scores
+
+    def test_ssd_postprocess_parity(self):
+        rng = np.random.default_rng(6)
+        boxes, scores = self._frames(rng)
+        dec = ("tensor_decoder mode=bounding_boxes "
+               "option1=mobilenet-ssd-postprocess option4=64:64")
+        legacy = _legacy_frames(
+            dec, "4:12:1.6:12:1",
+            [Buffer([boxes[i:i + 1], scores[i:i + 1]]) for i in range(4)])
+        reduced = _device_batched(dec, "4:12:4.6:12:4", [boxes, scores], 4)
+        assert len(legacy) == len(reduced) == 4
+        for a, b in zip(legacy, reduced):
+            da = [(d["box"], d["class"]) for d in a.meta["detections"]]
+            db = [(d["box"], d["class"]) for d in b.meta["detections"]]
+            assert da == db
+            np.testing.assert_array_equal(np.asarray(a.tensors[0]),
+                                          np.asarray(b.tensors[0]))
+
+    def test_yolov5_parity(self):
+        rng = np.random.default_rng(7)
+        n, c = 20, 3
+        a = rng.random((2, n, 5 + c)).astype(np.float32)
+        dec = ("tensor_decoder mode=bounding_boxes option1=yolov5 "
+               "option4=64:64 option5=64:64")
+        legacy = _legacy_frames(dec, f"{5+c}:{n}:1",
+                                [a[i:i + 1] for i in range(2)])
+        reduced = _device_batched(dec, f"{5+c}:{n}:2", a, 2)
+        assert len(legacy) == len(reduced) == 2
+        for x, y in zip(legacy, reduced):
+            dx = [(d["box"], d["class"]) for d in x.meta["detections"]]
+            dy = [(d["box"], d["class"]) for d in y.meta["detections"]]
+            assert dx == dy
+
+    def test_topk_cap_engages(self):
+        """More candidates than DEVICE_TOPK: the cap keeps the highest
+        scores and decode still works."""
+        from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
+
+        rng = np.random.default_rng(8)
+        n = BoundingBoxes.DEVICE_TOPK + 40
+        boxes, scores = self._frames(rng, n=n, c=2, b=1)
+        dec = ("tensor_decoder mode=bounding_boxes "
+               "option1=mobilenet-ssd-postprocess option4=32:32")
+        reduced = _device_batched(dec, f"4:{n}:1.2:{n}:1",
+                                  [boxes, scores], 1)
+        assert len(reduced) == 1
+        assert reduced[0].meta["detections"]  # something above 0.25 survived
+
+
+class TestFlexibleStreams:
+    def test_pose_flexible_device(self):
+        """Flexible caps carry no specs: grid dims must ride with the
+        reduce outputs, not the negotiated info."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(10)
+        heat = rng.standard_normal((2, 6, 6, 14)).astype(np.float32)
+        out = run_collect(
+            "appsrc name=in caps=other/tensors,format=flexible "
+            "! tensor_decoder mode=pose_estimation option1=48:48 "
+            "option2=heatmap frames-in=2 ! tensor_sink name=out",
+            push=[Buffer([jnp.asarray(heat)])])
+        assert len(out) == 2
+        legacy = _legacy_frames(
+            "tensor_decoder mode=pose_estimation option1=48:48 option2=heatmap",
+            "14:6:6:1", [heat[i:i + 1] for i in range(2)])
+        for a, b in zip(legacy, out):
+            ka = [(k["x"], k["y"]) for k in a.meta["keypoints"]]
+            kb = [(k["x"], k["y"]) for k in b.meta["keypoints"]]
+            assert ka == kb
+
+    def test_flexible_indivisible_errors(self):
+        """frames-in not dividing a flexible buffer's leading dim must be
+        a bus ERROR, not silent row loss."""
+        from nnstreamer_tpu.core import MessageType
+
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=flexible "
+            "! tensor_decoder mode=image_labeling frames-in=4 "
+            "! tensor_sink name=out")
+        pipe.play()
+        try:
+            pipe.get("in").push_buffer(
+                Buffer([np.zeros((10, 7), np.float32)]))
+            msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=10)
+            assert msg is not None and "does not divide" in str(msg.data.get("error"))
+        finally:
+            pipe.stop()
+
+
+class TestCapsPerFrame:
+    def test_out_caps_strip_batch(self):
+        """Out caps come from per-frame info: a batched segment stream
+        negotiates the frame's WxH, not the batch."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(9)
+        logits = rng.standard_normal((4, 8, 6, 5)).astype(np.float32)
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=5:6:8:4,types=float32 "
+            "! tensor_decoder mode=image_segment option1=tflite-deeplab "
+            "frames-in=4 ! tensor_sink name=out")
+        sink = pipe.get("out")
+        got = []
+        sink.connect(got.append)
+        src = pipe.get("in")
+        pipe.play()
+        src.push_buffer(Buffer([jnp.asarray(logits)]))
+        src.end_of_stream()
+        pipe.wait(timeout=30.0)
+        pipe.stop()
+        assert len(got) == 4
+        assert got[0].tensors[0].shape == (8, 6, 3)  # H, W, RGB per frame

@@ -1,0 +1,119 @@
+"""One-shot tunnel/device microprofile: where do the milliseconds go?
+
+Measures, on the live device: H2D bandwidth, D2H bandwidth, empty-dispatch
+round-trip, mobilenet-v2 device-only forward at a few batch sizes, and the
+fused u8 pipeline graph's pure-device time. Prints one JSON line per probe.
+
+Rig harness (like tools/tpu_probe_loop.py) — not a framework component.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    import numpy as np
+
+    from nnstreamer_tpu.utils.hw_accel import configure_default_platform
+
+    err = configure_default_platform(log=_log)
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    _emit(probe="platform", platform=dev.platform, err=err)
+    if dev.platform == "cpu":
+        return
+
+    # dispatch RTT: tiny jitted add, timed per call
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    f(x).block_until_ready()
+    ts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    _emit(probe="dispatch_rtt_ms", p50=round(sorted(ts)[10] * 1e3, 3),
+          min=round(min(ts) * 1e3, 3))
+
+    # H2D bandwidth at a few sizes
+    for mb in (1, 8, 32):
+        a = np.random.randint(0, 255, (mb << 20,), np.uint8)
+        jax.device_put(a).block_until_ready()
+        ts = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            jax.device_put(a).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        t = min(ts)
+        _emit(probe="h2d", size_mb=mb, s=round(t, 4),
+              mb_per_s=round(mb / t, 1))
+
+    # D2H bandwidth
+    for mb in (1, 8, 32):
+        d = jax.device_put(np.zeros((mb << 20,), np.uint8))
+        d.block_until_ready()
+        np.asarray(d)
+        ts = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            np.asarray(d)
+            ts.append(time.perf_counter() - t0)
+        t = min(ts)
+        _emit(probe="d2h", size_mb=mb, s=round(t, 4),
+              mb_per_s=round(mb / t, 1))
+
+    # mobilenet forward, device-resident input (no transfer in the loop)
+    from nnstreamer_tpu.models.mobilenet_v2 import filter_model_u8
+
+    fn = jax.jit(filter_model_u8.make())
+    for b in (1, 64, 256):
+        xd = jax.device_put(
+            np.zeros((b, 224, 224, 3), np.uint8))
+        t0 = time.perf_counter()
+        fn(xd)[0].block_until_ready()
+        compile_s = time.perf_counter() - t0
+        ts = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            fn(xd)[0].block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        t = min(ts)
+        _emit(probe="mobilenet_u8_device_only", batch=b,
+              compile_s=round(compile_s, 1), s=round(t, 4),
+              fps=round(b / t, 1))
+
+    # end-to-end single invoke incl. H2D of the batch (what bench pays)
+    for b in (64, 256):
+        xh = np.zeros((b, 224, 224, 3), np.uint8)
+        fn(jax.device_put(xh))[0].block_until_ready()
+        ts = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            fn(xh)[0].block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        t = min(ts)
+        _emit(probe="mobilenet_u8_with_h2d", batch=b, s=round(t, 4),
+              fps=round(b / t, 1),
+              h2d_mb=round(b * 224 * 224 * 3 / 2**20, 1))
+
+
+if __name__ == "__main__":
+    main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
