@@ -413,6 +413,14 @@ class BoundingBoxes(Decoder):
             anc = jnp.asarray(self.palm_anchors)  # (A,4) [xc, yc, w, h]
             raw = ts[0].astype(jnp.float32).reshape(b, -1, 18)
             sc = ts[1].astype(jnp.float32).reshape(b, -1)
+            if raw.shape[1] != anc.shape[0] or sc.shape[1] != anc.shape[0]:
+                # trace-time shapes are static: same clear configuration
+                # error as the host path, not an opaque XLA broadcast
+                raise ValueError(
+                    f"mp-palm-detection: {raw.shape[1]} box rows / "
+                    f"{sc.shape[1]} scores vs {anc.shape[0]} anchors — "
+                    "check option5 (model input size) and option3 "
+                    "(anchor params)")
             scores = _sigmoid_jnp(jnp.clip(sc, -100.0, 100.0))
             yc = raw[..., 0] / self.in_height * anc[:, 3] + anc[:, 1]
             xc = raw[..., 1] / self.in_width * anc[:, 2] + anc[:, 0]
@@ -452,16 +460,15 @@ class BoundingBoxes(Decoder):
                     classes = cls.argmax(-1)
                 else:
                     scores, classes = obj, jnp.zeros(obj.shape, jnp.int32)
-            # normalize if values look like pixels (traced select — the
-            # host path's data-dependent branch, as a jnp.where)
-            pixels = cxcywh.max() > 2.0
-            scale = jnp.where(
-                pixels,
-                jnp.asarray([self.width, self.height, self.width, self.height],
-                            jnp.float32),
-                jnp.ones(4, jnp.float32))
-            cx, cy = cxcywh[..., 0] / scale[0], cxcywh[..., 1] / scale[1]
-            w, h = cxcywh[..., 2] / scale[2], cxcywh[..., 3] / scale[3]
+            # normalize if values look like pixels — PER FRAME, like the
+            # host path's data-dependent branch (a traced jnp.where here)
+            pixels = cxcywh.max(axis=(1, 2)) > 2.0  # (B,)
+            whwh = jnp.asarray([self.width, self.height,
+                                self.width, self.height], jnp.float32)
+            scale = jnp.where(pixels[:, None, None], whwh,
+                              jnp.ones(4, jnp.float32))  # (B, 1, 4)
+            cx, cy = cxcywh[..., 0] / scale[..., 0], cxcywh[..., 1] / scale[..., 1]
+            w, h = cxcywh[..., 2] / scale[..., 2], cxcywh[..., 3] / scale[..., 3]
             boxes = jnp.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2],
                               axis=-1)
             return boxes, scores, classes

@@ -96,7 +96,10 @@ class ImageSegment(Decoder):
         def reduce_classes(ts):
             a = ts[0]
             if a.ndim >= 4:  # (B,H,W,C) logits → class ids
-                return (jnp.argmax(a, -1).astype(jnp.int32),)
+                # argmax < C: one byte per pixel when it fits (D2H is the
+                # whole point of the reduction)
+                dt = jnp.uint8 if a.shape[-1] <= 255 else jnp.int32
+                return (jnp.argmax(a, -1).astype(dt),)
             return (a.astype(jnp.int32),)  # already class ids
         return reduce_classes
 

@@ -124,7 +124,11 @@ class TensorDecoder(TransformElement):
                     raise ElementError(
                         f"{self.describe()}: frames-in={fi} does not divide "
                         f"leading dim {t.shape[0]} of incoming tensor")
-        reduce_fn = self._get_reduce()
+        # the device reduction engages only on an EXPLICIT frames-in batch:
+        # at frames-in=1 a buffer's leading dim keeps its legacy per-mode
+        # meaning (e.g. image_labeling decodes a (B,C) host batch as B
+        # labels in one buffer) and decode() must see it unchanged
+        reduce_fn = self._get_reduce() if fi > 1 else None
         if reduce_fn is not None and buf.on_device:
             # device path: ONE jitted reduction over the whole batch, ONE
             # small device→host pull, then per-frame host rendering

@@ -95,6 +95,12 @@ class TensorSrc(_PacedSource):
         "types": Prop("float32", str, "dtype(s), '.'-separated"),
         "pattern": Prop("counter", str, "zeros | ones | random | counter"),
         "seed": Prop(0, int, "RNG seed for pattern=random"),
+        "device": Prop(False, prop_bool,
+                       "generate frames ON the accelerator (jitted jax.random"
+                       "/fill — the stream is device-resident from birth; "
+                       "downstream jitted stages never pay a host→device "
+                       "copy. TPU-first analog of videotestsrc feeding a "
+                       "device pipeline)"),
     }
 
     def __init__(self, name=None, **props):
@@ -107,14 +113,52 @@ class TensorSrc(_PacedSource):
             *(TensorSpec.from_dim_string(d, t) for d, t in zip(dims, types))
         )
         self._rng = np.random.default_rng(self.props["seed"])
+        self._dev_fn = None  # jitted device generator, built on first frame
 
     def get_src_caps(self) -> Caps:
         return caps_from_tensors_info(self._info)
+
+    def _device_create(self, idx: int):
+        """One jitted dispatch generates every tensor of the frame on the
+        default device; dispatch is async, so generation of frame N+1
+        overlaps downstream compute on frame N."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._dev_fn is None:
+            pattern = self.props["pattern"]
+            specs = list(self._info.specs)
+
+            def gen(key, i):
+                out = []
+                for s in specs:
+                    dt = jnp.dtype(s.dtype.np_dtype)
+                    if pattern == "zeros":
+                        out.append(jnp.zeros(s.shape, dt))
+                    elif pattern == "ones":
+                        out.append(jnp.ones(s.shape, dt))
+                    elif pattern == "random":
+                        key, sub = jax.random.split(key)
+                        if s.dtype.is_float:
+                            out.append(jax.random.uniform(
+                                sub, s.shape, jnp.float32).astype(dt))
+                        else:
+                            out.append(jax.random.randint(
+                                sub, s.shape, 0, 127, jnp.int32).astype(dt))
+                    else:  # counter
+                        out.append(jnp.full(s.shape, i).astype(dt))
+                return tuple(out)
+
+            self._dev_fn = jax.jit(gen)
+            self._dev_key = jax.random.key(self.props["seed"])
+        return list(self._dev_fn(jax.random.fold_in(self._dev_key, idx), idx))
 
     def create(self) -> Optional[Buffer]:
         kw = self._pace()
         if kw is None:
             return None
+        if self.props["device"]:
+            return Buffer(self._device_create(self._frame - 1), **kw)
         pattern = self.props["pattern"]
         arrays = []
         for spec in self._info.specs:

@@ -79,20 +79,8 @@ class _FilterEntry:
         return lambda x: apply_fn(params, x)
 
 
-class _FilterEntryU8:
-    """uint8-input variant: normalization ((x/127.5)-1) fused into the jitted
-    graph. The pipeline then ships RAW uint8 batches to the device — 4× less
-    host→HBM traffic than pre-normalized float32 (HBM/PCIe bandwidth is the
-    streaming bottleneck; the reference converts on CPU and pays full-width
-    copies per frame, gsttensor_transform.c arithmetic mode)."""
-
-    @staticmethod
-    def make():
-        import jax.numpy as jnp
-
-        fn = _FilterEntry.make()
-        return lambda x: fn(x.astype(jnp.bfloat16) * (1.0 / 127.5) - 1.0)
-
-
 filter_model = _FilterEntry()
-filter_model_u8 = _FilterEntryU8()
+
+from ._blocks import make_u8_entry  # noqa: E402
+
+filter_model_u8 = make_u8_entry(filter_model)

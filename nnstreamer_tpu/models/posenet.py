@@ -76,3 +76,6 @@ class _FilterEntry:
 
 
 filter_model = _FilterEntry()
+from ._blocks import make_u8_entry  # noqa: E402
+
+filter_model_u8 = make_u8_entry(filter_model)

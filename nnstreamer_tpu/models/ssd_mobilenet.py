@@ -184,6 +184,9 @@ class _FilterEntryRaw:
 
 filter_model = _FilterEntry()
 filter_model_raw = _FilterEntryRaw()
+from ._blocks import make_u8_entry  # noqa: E402
+
+filter_model_u8 = make_u8_entry(filter_model)
 
 
 def save_anchors(path: str, image_size: int = 224) -> None:

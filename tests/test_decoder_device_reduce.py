@@ -191,12 +191,12 @@ class TestBoundingBoxReduce:
 
         rng = np.random.default_rng(8)
         n = BoundingBoxes.DEVICE_TOPK + 40
-        boxes, scores = self._frames(rng, n=n, c=2, b=1)
+        boxes, scores = self._frames(rng, n=n, c=2, b=2)
         dec = ("tensor_decoder mode=bounding_boxes "
                "option1=mobilenet-ssd-postprocess option4=32:32")
-        reduced = _device_batched(dec, f"4:{n}:1.2:{n}:1",
-                                  [boxes, scores], 1)
-        assert len(reduced) == 1
+        reduced = _device_batched(dec, f"4:{n}:2.2:{n}:2",
+                                  [boxes, scores], 2)
+        assert len(reduced) == 2
         assert reduced[0].meta["detections"]  # something above 0.25 survived
 
 
@@ -265,3 +265,36 @@ class TestCapsPerFrame:
         pipe.stop()
         assert len(got) == 4
         assert got[0].tensors[0].shape == (8, 6, 3)  # H, W, RGB per frame
+
+
+class TestDeviceSource:
+    def test_tensor_src_device_resident(self):
+        """device=true: frames are born on the device; patterns hold."""
+        from nnstreamer_tpu.core.buffer import _is_device_array
+
+        pipe = parse_launch(
+            "tensor_src device=true pattern=random num-buffers=3 seed=7 "
+            "dimensions=4:6:2 types=uint8 ! tensor_sink name=out")
+        got = []
+        pipe.get("out").connect(got.append)
+        pipe.run(timeout=30)
+        assert len(got) == 3
+        assert all(_is_device_array(b.tensors[0]) for b in got)
+        a0 = np.asarray(got[0].tensors[0])
+        assert a0.shape == (2, 6, 4) and a0.dtype == np.uint8
+        # distinct frames (keys fold the frame index)
+        assert not np.array_equal(a0, np.asarray(got[1].tensors[0]))
+
+    def test_device_src_to_batched_decoder(self):
+        """Full device-resident path: on-device source → batched decoder
+        reduce → per-frame host render, no full-width D2H anywhere."""
+        out = []
+        pipe = parse_launch(
+            "tensor_src device=true pattern=random num-buffers=2 "
+            "dimensions=5:6:8:4 types=float32 "
+            "! tensor_decoder mode=image_segment option1=tflite-deeplab "
+            "frames-in=4 ! tensor_sink name=out")
+        pipe.get("out").connect(out.append)
+        pipe.run(timeout=30)
+        assert len(out) == 8  # 2 buffers × 4 frames
+        assert out[0].tensors[0].shape == (8, 6, 3)

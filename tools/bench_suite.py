@@ -428,33 +428,35 @@ def main() -> None:
         _log(f"{name} FAILED: {e}")
         record(name, 0.0, 0, batch)
 
-    # -- 2-4. detection / pose / segmentation (per-frame decoders) ----------
+    # -- 2-4. detection / pose / segmentation -------------------------------
+    # TPU-first topology (r5): uint8 ingest with normalization fused into
+    # the model graph (4× less H2D), model batched via the aggregator, and
+    # the DECODER batched too (frames-in=N): candidate parsing / argmax /
+    # keypoint gather run as one jitted device reduction per batch, so
+    # only compact arrays cross D2H (decoders/base.py make_reduce). The
+    # reference-shaped per-frame host decode remains the p50 topology.
     per_frame = [
         # SSD's anchor grid is baked for its 224 input; pose/segment heads
         # are fully convolutional and follow BENCHS_SIZE
         ("ssd_mobilenet_bounding_boxes", 224,
-         "nnstreamer_tpu.models.ssd_mobilenet:filter_model",
+         "nnstreamer_tpu.models.ssd_mobilenet:filter_model_u8",
          "tensor_decoder mode=bounding_boxes "
          "option1=mobilenet-ssd-postprocess option3=,30 option4=224:224"),
         ("posenet_pose_estimation", size,
-         "nnstreamer_tpu.models.posenet:filter_model",
+         "nnstreamer_tpu.models.posenet:filter_model_u8",
          f"tensor_decoder mode=pose_estimation option1={size}:{size} "
          "option2=heatmap"),
         ("deeplab_image_segment", size,
-         "nnstreamer_tpu.models.deeplab:filter_model",
+         "nnstreamer_tpu.models.deeplab:filter_model_u8",
          "tensor_decoder mode=image_segment option1=tflite-deeplab"),
     ]
-    # on an accelerator the MODEL runs batched (aggregate → filter →
-    # re-split) while the decoder stays per-frame like the reference; the
-    # chip must not idle at batch=1 when the tunnel finally answers
     pf_batch = int(os.environ.get("BENCHS_PERFRAME_BATCH",
                                   "1" if on_cpu else str(batch)))
-    # burst-aware sizing: the re-split aggregator delivers frames in
-    # near-simultaneous bursts of pf_batch, so (a) at least 4 whole
-    # batches must run, (b) the frame budget quantizes to full batches
-    # (the aggregator drops a partial tail at EOS), and (c) warmup ends
-    # on a burst boundary with >=2 bursts left in the measured window —
-    # otherwise the span is measured inside one burst and fps is garbage
+    # burst-aware sizing: the batched decoder emits frames in bursts of
+    # pf_batch, so (a) at least 4 whole batches must run, (b) the frame
+    # budget quantizes to full batches (the aggregator drops a partial
+    # tail at EOS), and (c) warmup ends on a burst boundary with >=2
+    # bursts left in the measured window
     pf_batch = max(1, min(pf_batch, frames // 4))
     pf_frames = (frames // pf_batch) * pf_batch
     pf_warmup = max(warmup_batches, 2) * pf_batch
@@ -462,26 +464,26 @@ def main() -> None:
         _log(f"{name}: size={in_size} frames={pf_frames} model_batch={pf_batch}")
         try:
             # mesh the batched model stage only when the batch divides the
-            # dp axis (same rule as config 1; the decoder stays per-frame)
+            # dp axis (same rule as config 1)
             pf_mesh = mesh_custom if (mesh_custom
                                       and pf_batch % n_dev == 0) else ""
             stage = (f"tensor_filter framework=jax model={model} "
                      + (f"custom={pf_mesh} " if pf_mesh else "")
                      + "sync-invoke=false")
+            dec_stage = dec
             if pf_batch > 1:
                 stage = (
                     f"tensor_aggregator frames-out={pf_batch} frames-dim=0 "
                     "concat=true ! queue max-size-buffers=4 "
-                    f"! {stage} "
-                    f"! tensor_aggregator frames-in={pf_batch} frames-out=1 "
-                    "frames-dim=0")
+                    f"! {stage}")
+                dec_stage = f"{dec} frames-in={pf_batch}"
             pipe = parse_launch(
                 f"tensor_src num-buffers={pf_frames} "
                 f"dimensions=3:{in_size}:{in_size}:1 "
-                "types=float32 pattern=random "
+                "types=uint8 pattern=random "
                 f"! {stage} "
                 "! queue max-size-buffers=8 "
-                f"! {dec} ! tensor_sink name=out max-stored=1")
+                f"! {dec_stage} ! tensor_sink name=out max-stored=1")
             fps, n = _run_fps(pipe, "out", pf_frames, pf_warmup, deadline)
             extra = {}
             try:  # aux (MFU, p50) fails soft — never costs the fps number
@@ -490,12 +492,12 @@ def main() -> None:
                 mod_name, attr = model.split(":")
                 entry = getattr(importlib.import_module(mod_name), attr)
                 extra = _model_perf(entry, (1, in_size, in_size, 3),
-                                    "float32", fps,
+                                    "uint8", fps,
                                     n_chips=n_dev if pf_mesh else 1)
                 extra.update(_mesh_fields(pf_mesh, n_dev))
                 _log(f"{name}: p50 pipeline latency (batch=1) ...")
                 extra["p50_pipeline_ms"] = round(
-                    _pipeline_p50(model, in_size, dec), 2)
+                    _pipeline_p50(model, in_size, dec, dtype="uint8"), 2)
             except Exception as e:  # noqa: BLE001
                 _log(f"{name} aux (mfu/p50) failed: {e}")
             record(name, fps, n, pf_batch, extra)
