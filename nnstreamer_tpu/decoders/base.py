@@ -16,6 +16,13 @@ from ..registry.subplugin import SubpluginKind, register
 class Decoder:
     MODE = ""
 
+    # Whether the device reduction may engage at frames-in=1 (the leading
+    # axis is then the frame's own dim, unambiguous for image-shaped
+    # modes). Decoders whose legacy decode() gives the leading axis a
+    # DIFFERENT per-buffer meaning at fi=1 (image_labeling: (B, C) host
+    # batch → B labels in ONE buffer) opt out.
+    FI1_DEVICE_REDUCE = True
+
     def init(self, options: List[Optional[str]]) -> None:
         """Receive option1..optionN (None where unset)."""
         self.options = options

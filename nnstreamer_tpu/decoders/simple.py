@@ -59,6 +59,11 @@ class ImageLabeling(Decoder):
 
     MODE = "image_labeling"
 
+    # at frames-in=1 a (B, C) buffer legacy-decodes to B labels in ONE
+    # buffer — the leading axis is not a per-buffer frame count, so the
+    # device reduction must not re-interpret it (elements/decoder.py)
+    FI1_DEVICE_REDUCE = False
+
     def init(self, options):
         super().init(options)
         self.labels: List[str] = []

@@ -124,11 +124,12 @@ class TensorDecoder(TransformElement):
                     raise ElementError(
                         f"{self.describe()}: frames-in={fi} does not divide "
                         f"leading dim {t.shape[0]} of incoming tensor")
-        # the device reduction engages only on an EXPLICIT frames-in batch:
-        # at frames-in=1 a buffer's leading dim keeps its legacy per-mode
-        # meaning (e.g. image_labeling decodes a (B,C) host batch as B
-        # labels in one buffer) and decode() must see it unchanged
-        reduce_fn = self._get_reduce() if fi > 1 else None
+        # at frames-in=1 the device reduction engages only for decoders
+        # whose leading-dim meaning is unambiguous (FI1_DEVICE_REDUCE —
+        # image_labeling opts out: its decode() gives a (B, C) buffer the
+        # legacy one-buffer-of-B-labels meaning and must see it unchanged)
+        reduce_fn = (self._get_reduce()
+                     if fi > 1 or self.decoder.FI1_DEVICE_REDUCE else None)
         if reduce_fn is not None and buf.on_device:
             # device path: ONE jitted reduction over the whole batch, ONE
             # small device→host pull, then per-frame host rendering

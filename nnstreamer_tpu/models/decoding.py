@@ -29,13 +29,17 @@ from __future__ import annotations
 from .transformer import TransformerConfig, _rmsnorm
 
 
-def init_cache(cfg: TransformerConfig, batch: int):
-    """Zeroed K/V cache: list of {"k","v"} (B, H, max_seq, head_dim)."""
+def init_cache(cfg: TransformerConfig, batch: int, dtype=None):
+    """Zeroed K/V cache: list of {"k","v"} (B, H, max_seq, head_dim).
+
+    ``dtype`` defaults to float32; serving passes the params' dtype so a
+    bfloat16-weight model also halves its per-step cache HBM reads."""
     import jax.numpy as jnp
 
+    dtype = dtype or jnp.float32
     shape = (batch, cfg.heads, cfg.max_seq, cfg.head_dim)
     return [
-        {"k": jnp.zeros(shape, jnp.float32), "v": jnp.zeros(shape, jnp.float32)}
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(cfg.layers)
     ]
 
@@ -181,7 +185,8 @@ def prefill(cfg: TransformerConfig, params, tokens, cache, mesh=None,
         if pad:
             tokens = jnp.pad(tokens, ((0, 0), (0, pad)))
             S = S + pad
-    x = params["embed"][tokens] + params["pos"][:S][None, :, :]
+    x = (params["embed"][tokens]
+         + params["pos"][:S][None, :, :]).astype(jnp.float32)
     x = constrain(x, "dp", "sp", None)
     mask = None if ctx_attn is not None else jnp.tril(jnp.ones((S, S), bool))
     for li, blk in enumerate(params["blocks"]):
@@ -193,9 +198,9 @@ def prefill(cfg: TransformerConfig, params, tokens, cache, mesh=None,
             v = constrain(v, "dp", "tp", "sp", None)
         cache[li] = {
             "k": jax.lax.dynamic_update_slice(
-                cache[li]["k"], k, (0, 0, 0, 0)),
+                cache[li]["k"], k.astype(cache[li]["k"].dtype), (0, 0, 0, 0)),
             "v": jax.lax.dynamic_update_slice(
-                cache[li]["v"], v, (0, 0, 0, 0)),
+                cache[li]["v"], v.astype(cache[li]["v"].dtype), (0, 0, 0, 0)),
         }
         if ctx_attn is not None:
             o = ctx_attn(q, k, v)
@@ -230,7 +235,8 @@ def prefill_continue(cfg: TransformerConfig, params, tokens, cache, start,
 
     B, P = tokens.shape
     x = (params["embed"][tokens]
-         + jax.lax.dynamic_slice_in_dim(params["pos"], start, P, 0))
+         + jax.lax.dynamic_slice_in_dim(params["pos"], start, P, 0)
+         ).astype(jnp.float32)
     positions = jnp.arange(cfg.max_seq)
     q_pos = start + jnp.arange(P)
     visible = (positions[None, None, None, :]
@@ -239,8 +245,10 @@ def prefill_continue(cfg: TransformerConfig, params, tokens, cache, start,
         h = _rmsnorm(x, blk["ln1"])
         q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
         q, k, v = (_split_heads(cfg, t) for t in (q, k, v))  # (B,H,P,Dh)
-        ck = jax.lax.dynamic_update_slice(cache[li]["k"], k, (0, 0, start, 0))
-        cv = jax.lax.dynamic_update_slice(cache[li]["v"], v, (0, 0, start, 0))
+        ck = jax.lax.dynamic_update_slice(
+            cache[li]["k"], k.astype(cache[li]["k"].dtype), (0, 0, start, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cache[li]["v"], v.astype(cache[li]["v"].dtype), (0, 0, start, 0))
         cache[li] = {"k": ck, "v": cv}
         att = (q @ ck.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
         att = jnp.where(visible, att, -1e30)           # (B,H,P,max_seq)
@@ -263,8 +271,9 @@ def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None,
     import jax.numpy as jnp
 
     B = token.shape[0]
-    x = params["embed"][token] + jax.lax.dynamic_index_in_dim(
-        params["pos"], pos, axis=0, keepdims=False)  # (B, D)
+    x = (params["embed"][token] + jax.lax.dynamic_index_in_dim(
+        params["pos"], pos, axis=0, keepdims=False)
+         ).astype(jnp.float32)  # (B, D)
     x = x[:, None, :]                                # (B, 1, D)
     positions = jnp.arange(cfg.max_seq)
     visible = (positions <= pos)[None, None, None, :]  # (1,1,1,max_seq)
@@ -272,6 +281,8 @@ def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None,
         h = _rmsnorm(x, blk["ln1"])
         q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
         q, k, v = (_split_heads(cfg, t) for t in (q, k, v))  # (B,H,1,Dh)
+        k = k.astype(cache[li]["k"].dtype)
+        v = v.astype(cache[li]["v"].dtype)
         if sp_attn is not None:
             o, ck, cv = sp_attn(q, k, v, cache[li]["k"], cache[li]["v"], pos)
             cache[li] = {"k": ck, "v": cv}
@@ -315,7 +326,8 @@ def decode_step(cfg: TransformerConfig, params, token, pos, cache, mesh=None,
 
 
 def make_generate(cfg: TransformerConfig, mesh=None,
-                  temperature: float = 0.0, context_parallel: bool = False):
+                  temperature: float = 0.0, context_parallel: bool = False,
+                  cache_len: int = 0):
     """Build ``generate(params, prompt (B, S), steps, [rng]) -> (B, S+steps)``
     — jitted prefill + ``lax.scan`` over decode_step. ``temperature`` 0 =
     greedy (deterministic); >0 = categorical sampling (pass ``rng``).
@@ -325,11 +337,30 @@ def make_generate(cfg: TransformerConfig, mesh=None,
     :func:`cache_pspecs`; XLA inserts the tp all-reduces per step. With
     ``context_parallel`` the cache sequence axis additionally shards over
     ``sp`` and attention runs via :func:`make_sp_cache_attention`.
+
+    ``cache_len`` right-sizes the serving cache: every decode step reads
+    the WHOLE cache (masked), so a model trained at max_seq=2048 serving
+    prompt+steps=640 would pay 3.2× the attention HBM traffic it needs.
+    Pass the actual serving length (≤ cfg.max_seq) and the cache, masks
+    and scan are built at that size; position embeddings still come from
+    the full table. 0 = cfg.max_seq.
+
+    The cache (and its HBM read per step) follows the params dtype: cast
+    params to bfloat16 for serving and the K/V cache stores bfloat16
+    too, halving decode bandwidth; activations stay float32 throughout.
     """
     import functools
+    from dataclasses import replace
 
     import jax
     import jax.numpy as jnp
+
+    if cache_len:
+        if cache_len > cfg.max_seq:
+            raise ValueError(
+                f"cache_len {cache_len} exceeds the model's max_seq "
+                f"{cfg.max_seq} (position table size)")
+        cfg = replace(cfg, max_seq=cache_len)
 
     sp_attn = None
     if context_parallel:
@@ -355,7 +386,8 @@ def make_generate(cfg: TransformerConfig, mesh=None,
         if S + steps > cfg.max_seq:
             raise ValueError(
                 f"prompt ({S}) + steps ({steps}) exceeds max_seq {cfg.max_seq}")
-        cache = _constrain_cache(init_cache(cfg, B))
+        cache = _constrain_cache(
+            init_cache(cfg, B, dtype=params["embed"].dtype))
         logits, cache, pos = prefill(cfg, params, prompt, cache, mesh,
                                      context_parallel=context_parallel)
         if rng is None:

@@ -298,3 +298,42 @@ class TestDeviceSource:
         pipe.run(timeout=30)
         assert len(out) == 8  # 2 buffers × 4 frames
         assert out[0].tensors[0].shape == (8, 6, 3)
+
+
+class TestFi1Reduce:
+    def test_segment_fi1_device_uses_reduce(self, monkeypatch):
+        """frames-in=1 device stream: image-shaped modes still reduce on
+        device (no full-volume D2H); legacy decode() is never called."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.decoders.segment_pose import ImageSegment
+
+        def _boom(self, buf, info):
+            raise AssertionError("legacy decode() ran on the device path")
+        monkeypatch.setattr(ImageSegment, "decode", _boom)
+        rng = np.random.default_rng(13)
+        logits = rng.standard_normal((1, 8, 6, 5)).astype(np.float32)
+        out = run_collect(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=5:6:8:1,types=float32 "
+            "! tensor_decoder mode=image_segment option1=tflite-deeplab "
+            "! tensor_sink name=out",
+            push=[Buffer([jnp.asarray(logits)])])
+        assert len(out) == 1 and out[0].tensors[0].shape == (8, 6, 3)
+        np.testing.assert_array_equal(
+            out[0].meta["class_map"], logits[0].argmax(-1))
+
+    def test_labeling_fi1_keeps_legacy_batched_meaning(self):
+        """image_labeling at fi=1: a (B, C) device buffer still decodes to
+        ONE buffer of B labels (the documented legacy semantics)."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(14)
+        scores = rng.random((5, 10)).astype(np.float32)
+        out = run_collect(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=10:5,types=float32 "
+            "! tensor_decoder mode=image_labeling ! tensor_sink name=out",
+            push=[Buffer([jnp.asarray(scores)])])
+        assert len(out) == 1
+        assert out[0].meta["label_indices"] == [int(i) for i in scores.argmax(-1)]
