@@ -241,6 +241,63 @@ def main() -> None:
         fps = n_measured * BATCH / span if span > 0 else 0.0
         _log(f"throughput: {n_measured} batches in {span:.2f}s = {fps:.0f} fps")
 
+        # Device-resident pipeline: the same topology with tensor_src
+        # device=true — frames are born on the chip (jitted jax.random),
+        # so this measures the FRAMEWORK + model throughput with ingest
+        # off the critical path. On this rig the host-ingest number above
+        # is bounded by the axon tunnel (~tens of MB/s, measured below);
+        # a production v5e host ingests over PCIe at GB/s, where the
+        # device-resident number is the representative one.
+        fps_dev = None
+        if (platform != "cpu" or os.environ.get("BENCH_FORCE_DEVICE_SRC")) \
+                and not partial \
+                and not os.environ.get("BENCH_NO_DEVICE_SRC"):
+            try:
+                dev_batches = min(MEASURE_BATCHES, 20) + WARMUP_BATCHES
+                pipe_d = parse_launch(
+                    f"tensor_src device=true pattern=random "
+                    f"num-buffers={dev_batches} "
+                    f"dimensions=3:224:224:{BATCH} types=uint8 "
+                    f"! tensor_filter framework=jax model={model} "
+                    + (f"custom={mesh_custom} " if mesh_custom else "")
+                    + "shared-tensor-filter-key=bench sync-invoke=false "
+                    "! queue max-size-buffers=4 "
+                    "! tensor_sink name=out max-stored=1")
+                times_d = []
+
+                def on_dev_batch(b):
+                    for t in b.tensors:
+                        if hasattr(t, "block_until_ready"):
+                            t.block_until_ready()
+                    times_d.append(time.monotonic())
+
+                pipe_d.get("out").connect(on_dev_batch)
+                _log(f"device-resident pipeline: {dev_batches} batches ...")
+                pipe_d.run(timeout=DEADLINE_S)
+                if len(times_d) > WARMUP_BATCHES + 1:
+                    span_d = times_d[-1] - times_d[WARMUP_BATCHES - 1]
+                    fps_dev = (len(times_d) - WARMUP_BATCHES) * BATCH / span_d
+                    _log(f"device-resident: {fps_dev:.0f} fps")
+            except Exception as e:  # noqa: BLE001 — aux number, fail soft
+                _log(f"device-resident pipeline failed: {e}")
+
+        # measured tunnel/interconnect H2D bandwidth — the context that
+        # explains the gap between the two fps numbers
+        h2d_mb_s = None
+        if platform != "cpu" and not partial:
+            try:
+                blob = np.zeros((32 << 20,), np.uint8)
+                jax.device_put(blob).block_until_ready()
+                bw = []
+                for _ in range(3):
+                    t0 = time.monotonic()
+                    jax.device_put(blob).block_until_ready()
+                    bw.append(blob.nbytes / 1e6 / (time.monotonic() - t0))
+                h2d_mb_s = max(bw)
+                _log(f"measured H2D bandwidth: {h2d_mb_s:.1f} MB/s")
+            except Exception as e:  # noqa: BLE001
+                _log(f"H2D bandwidth probe failed: {e}")
+
         # p50 single-frame end-to-end latency, batch=1 through the same shared
         # backend (same fused-u8 graph) so fps and p50 describe one model.
         # Skipped when the deadline already hit: a stalled device would hang
@@ -282,14 +339,30 @@ def main() -> None:
             perf = perf_record(frame_flops, fps,
                                n_chips=len(devices) if mesh_custom else 1,
                                device=devices[0])
+            if fps_dev:
+                perf_d = perf_record(
+                    frame_flops, fps_dev,
+                    n_chips=len(devices) if mesh_custom else 1,
+                    device=devices[0])
+                perf["device_resident_mfu"] = perf_d.get("mfu")
         except Exception as e:  # noqa: BLE001
             _log(f"MFU accounting failed: {e}")
 
+    # value/vs_baseline keep the r1..r4 measurement definition (full
+    # host-ingest pipeline) for cross-round comparability. The
+    # device-resident number (ingest off the critical path — what a
+    # PCIe-attached production host would see, since PCIe is not the
+    # bottleneck at these rates) and the measured tunnel bandwidth ride
+    # along as their own fields so the gap is explained, not hidden.
     result = {
         "metric": "mobilenet_v2_224_pipeline_fps",
         "value": round(fps, 1),
         "unit": "fps",
         "vs_baseline": round(fps / BASELINE_FPS, 3),
+        "device_resident_fps": round(fps_dev, 1) if fps_dev else None,
+        "device_resident_vs_baseline": (round(fps_dev / BASELINE_FPS, 3)
+                                        if fps_dev else None),
+        "h2d_mb_per_s": round(h2d_mb_s, 1) if h2d_mb_s else None,
         "p50_latency_ms": round(p50_ms, 2) if p50_ms is not None else None,
         "batch": BATCH,
         "platform": platform,

@@ -128,8 +128,12 @@ class TensorDecoder(TransformElement):
         # whose leading-dim meaning is unambiguous (FI1_DEVICE_REDUCE —
         # image_labeling opts out: its decode() gives a (B, C) buffer the
         # legacy one-buffer-of-B-labels meaning and must see it unchanged)
+        # getattr: duck-typed decoder objects registered without the
+        # Decoder base keep their pre-reduce fi=1 behavior
         reduce_fn = (self._get_reduce()
-                     if fi > 1 or self.decoder.FI1_DEVICE_REDUCE else None)
+                     if fi > 1 or getattr(self.decoder,
+                                          "FI1_DEVICE_REDUCE", False)
+                     else None)
         if reduce_fn is not None and buf.on_device:
             # device path: ONE jitted reduction over the whole batch, ONE
             # small device→host pull, then per-frame host rendering
@@ -158,7 +162,8 @@ class TensorDecoder(TransformElement):
         (fi, d0, ...) so reduce always sees a leading batch axis."""
         if self._reduce_jit is not None:
             return self._reduce_jit[0]
-        fn = self.decoder.make_reduce(self._frame_info)
+        maker = getattr(self.decoder, "make_reduce", None)  # duck-typed
+        fn = maker(self._frame_info) if maker is not None else None
         if fn is None:
             self._reduce_jit = (None,)
             return None

@@ -51,6 +51,23 @@ class _LMServingEntry:
     cfg: TransformerConfig
     default_steps: int = 8
     seed: int = 0
+    # serving-efficiency knobs (models/decoding.py rationale): weights AND
+    # KV cache in this dtype (activations stay f32); cache sized to the
+    # actual serving length instead of cfg.max_seq. None/0 = train config.
+    serve_dtype: Optional[str] = None
+    cache_len: int = 0
+
+    @property
+    def _cfg_serve(self) -> TransformerConfig:
+        if self.cache_len:
+            from dataclasses import replace
+
+            if self.cache_len > self.cfg.max_seq:
+                raise ValueError(
+                    f"cache_len {self.cache_len} exceeds max_seq "
+                    f"{self.cfg.max_seq}")
+            return replace(self.cfg, max_seq=self.cache_len)
+        return self.cfg
 
     def _shard_params(self, mesh):
         """Init params and, when ``mesh`` carries a real tp axis, place
@@ -63,6 +80,13 @@ class _LMServingEntry:
         from .transformer import init_params, param_pspecs
 
         params = init_params(self.cfg, seed=self.seed)
+        if self.serve_dtype:
+            import jax.numpy as jnp
+
+            dt = jnp.dtype(self.serve_dtype)
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(dt) if a.dtype == jnp.float32 else a,
+                params)
         use_tp = (mesh is not None and "tp" in mesh.axis_names
                   and mesh.shape["tp"] > 1)
         if use_tp:
@@ -85,7 +109,8 @@ class _LMServingEntry:
         params, use_tp = self._shard_params(mesh)
         # dp-only / single-device: params replicate as jit constants; the
         # backend's dp batch sharding alone parallelizes the batch
-        gen = make_generate(self.cfg, mesh=mesh if use_tp else None)
+        gen = make_generate(self.cfg, mesh=mesh if use_tp else None,
+                            cache_len=self.cache_len)
         steps = _steps(self.default_steps)
 
         def serve(tokens):
@@ -123,7 +148,7 @@ class _LMServingEntry:
             prefill_continue,
         )
 
-        cfg = self.cfg
+        cfg = self._cfg_serve
         params, use_tp = self._shard_params(mesh)
         step_mesh = mesh if use_tp else None
 
@@ -162,7 +187,8 @@ class _LMServingEntry:
 
         @jax.jit
         def _prefill(params, tokens, key):
-            cache = constrain(init_cache(cfg, tokens.shape[0]))
+            cache = constrain(init_cache(cfg, tokens.shape[0],
+                                         dtype=params["embed"].dtype))
             logits, cache, pos = prefill(cfg, params, tokens, cache,
                                          step_mesh)
             return _pick(logits, key), pos, constrain(cache)

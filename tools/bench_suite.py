@@ -212,16 +212,27 @@ def _bench_lm_decode(platform: str, on_cpu: bool,
                           "error": str(e)[:300]}), flush=True)
         return
     rng = np.random.default_rng(3)
-    # the streaming form is built ONCE (init_params for the big config is
-    # not free); stream(tokens, steps) takes shapes per call
-    stream = None
-    if not os.environ.get("BENCHS_SKIP_STREAM"):
-        try:
-            from nnstreamer_tpu.models.lm_serving import _LMServingEntry
+    # the streaming form is rebuilt per point with the SAME serving
+    # config as the scan row (bf16 weights+cache, right-sized cache) so
+    # the stream-vs-scan delta isolates the per-token dispatch tax and
+    # nothing else
+    stream_dtype = None if on_cpu else "bfloat16"
+    _stream_cache = {}
 
-            stream = _LMServingEntry(cfg).make_streaming()
-        except Exception as e:  # noqa: BLE001
-            _log(f"transformer_lm_decode stream build failed: {e}")
+    def _stream_for(c_len):
+        if os.environ.get("BENCHS_SKIP_STREAM"):
+            return None
+        if c_len not in _stream_cache:
+            try:
+                from nnstreamer_tpu.models.lm_serving import _LMServingEntry
+
+                _stream_cache[c_len] = _LMServingEntry(
+                    cfg, serve_dtype=stream_dtype,
+                    cache_len=c_len).make_streaming()
+            except Exception as e:  # noqa: BLE001
+                _log(f"transformer_lm_decode stream build failed: {e}")
+                _stream_cache[c_len] = None
+        return _stream_cache[c_len]
     for B, P, S in points:
         name = f"transformer_lm_decode_b{B}_p{P}_s{S}"
         if time.monotonic() - t_start > deadline_s:
@@ -255,6 +266,7 @@ def _bench_lm_decode(platform: str, on_cpu: bool,
             # the scan's decode_tokens_per_s is the per-token dispatch
             # tax, not prefill; min over reps like every other number.
             stream_tps = None
+            stream = _stream_for(c_len) if S > 1 else None
             if stream is not None and S > 1:
                 try:
                     s_steps = min(S, 32)
