@@ -572,7 +572,13 @@ def main() -> None:
                                  "1" if on_cpu else str(batch)))
     quant_rows = [("mobilenet_v2_quant_tflite_on_xla", q_exec, q_batch),
                   ("mobilenet_v2_quant_tflite_on_xla_oracle",
-                   "fake-quant", q_batch)]
+                   "fake-quant", q_batch),
+                  # the C++ engine (native/csrc/nns_q8.cc) always executes
+                  # on the HOST cpu — batch 1, the interpreter's operating
+                  # point, so this row pairs with the interpreter row on
+                  # every platform
+                  ("mobilenet_v2_quant_tflite_int8_native",
+                   "int8-native", 1)]
     for name, exec_mode, qb in quant_rows if os.path.exists(ref_quant) else []:
         _log(f"{name}: exec={exec_mode} batch={qb} frames={frames}")
         try:
