@@ -581,11 +581,16 @@ def main() -> None:
                    "int8-native", 1)]
     for name, exec_mode, qb in quant_rows if os.path.exists(ref_quant) else []:
         _log(f"{name}: exec={exec_mode} batch={qb} frames={frames}")
+        # the C++ engine executes on the HOST cpu regardless of the jax
+        # platform: a mesh label (or a per-chip MFU denominator) on that
+        # row would claim accelerator devices for a single-host number
+        host_native = exec_mode == "int8-native"
+        q_mesh = "" if host_native else mesh_custom
         try:
             q_custom = ",".join(
                 p for p in (f"quantized_exec:{exec_mode}",
                             f"batch:{qb}" if qb > 1 else "",
-                            mesh_custom) if p)
+                            q_mesh) if p)
             agg = (f"! tensor_aggregator frames-out={qb} frames-dim=0 "
                    "concat=true " if qb > 1 else "")
             pipe = parse_launch(
@@ -603,17 +608,19 @@ def main() -> None:
                                 max(warmup_batches, (frames // qb) // 3),
                                 deadline)
             extra = {"quantized_exec": exec_mode}
-            try:
-                from nnstreamer_tpu.models.tflite_import import load_tflite
+            if not host_native:  # host engine is not jit-lowerable: the
+                # XLA cost analysis would rebuild the graph for a None
+                try:
+                    from nnstreamer_tpu.models.tflite_import import load_tflite
 
-                q_fn, _, _ = load_tflite(
-                    ref_quant, {"quantized_exec": exec_mode})
-                extra.update(_model_perf(
-                    q_fn, (1, 224, 224, 3), "uint8", fps_b * qb,
-                    n_chips=n_dev if mesh_custom else 1))
-            except Exception as e:  # noqa: BLE001
-                _log(f"{name} aux (mfu) failed: {e}")
-            extra.update(_mesh_fields(mesh_custom, n_dev))
+                    q_fn, _, _ = load_tflite(
+                        ref_quant, {"quantized_exec": exec_mode})
+                    extra.update(_model_perf(
+                        q_fn, (1, 224, 224, 3), "uint8", fps_b * qb,
+                        n_chips=n_dev if q_mesh else 1))
+                except Exception as e:  # noqa: BLE001
+                    _log(f"{name} aux (mfu) failed: {e}")
+            extra.update(_mesh_fields(q_mesh, n_dev))
             record(name, fps_b * qb, n * qb, qb, extra)
         except Exception as e:
             _log(f"{name} FAILED: {e}")

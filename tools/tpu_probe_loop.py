@@ -48,7 +48,7 @@ LOG_PATH = os.environ.get("PROBE_LOG", os.path.join(ROOT, f"PROBE_LOG_{ROUND}.js
 # value order: the driver-gate number first in case the window dies
 EVIDENCE = [
     (["bench.py"], f"BENCH_TPU_{ROUND}.json", 1500),
-    (["tools/bench_suite.py"], f"BENCH_SUITE_TPU_{ROUND}.json", 2400),
+    (["tools/bench_suite.py"], f"BENCH_SUITE_TPU_{ROUND}.json", 3300),
     (["tools/device_parity.py"], f"PARITY_TPU_{ROUND}.json", 1200),
     (["tools/entry_check.py"], f"ENTRY_TPU_{ROUND}.json", 900),
 ]
@@ -62,22 +62,33 @@ def _log_line(entry: dict) -> None:
     print(json.dumps(entry), flush=True)
 
 
-def _run_and_capture(cmd, out_path: str, timeout_s: float, env: dict) -> bool:
-    """Run `cmd`; save the stdout JSON line(s) to out_path. True on a
-    parseable result."""
+def _run_and_capture(cmd, out_path: str, timeout_s: float, env: dict) -> str:
+    """Run `cmd`; save the stdout JSON line(s) to out_path. Returns
+    "ok" (complete), "partial" (timed out but salvaged live accelerator
+    rows), or "fail"."""
+    partial = False
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
     try:
-        proc = subprocess.run(cmd, env=env, timeout=timeout_s,
-                              stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        raw_out, raw_err = proc.communicate(timeout=timeout_s)
+        rc = proc.returncode
     except subprocess.TimeoutExpired:
+        # salvage whatever rows the script already printed — a timed-out
+        # suite with 8 finished configs beats an empty artifact (the
+        # r5 04:00 window died exactly this way). kill + drain collects
+        # everything the child flushed before the kill.
         _log_line({"event": "bench_timeout", "cmd": cmd[-1], "timeout_s": timeout_s})
-        return False
-    lines = [ln for ln in proc.stdout.decode(errors="replace").splitlines()
+        proc.kill()
+        raw_out, raw_err = proc.communicate()
+        rc = -1
+        partial = True
+    lines = [ln for ln in raw_out.decode(errors="replace").splitlines()
              if ln.strip().startswith("{")]
-    tail = proc.stderr.decode(errors="replace")[-2000:]
+    tail = raw_err.decode(errors="replace")[-2000:]
     if not lines:
         _log_line({"event": "bench_no_output", "cmd": cmd[-1],
-                   "rc": proc.returncode, "stderr_tail": tail})
-        return False
+                   "rc": rc, "stderr_tail": tail})
+        return "fail"
     results = []
     for ln in lines:
         try:
@@ -86,13 +97,26 @@ def _run_and_capture(cmd, out_path: str, timeout_s: float, env: dict) -> bool:
             pass
     if not results:
         _log_line({"event": "bench_unparseable_output", "cmd": cmd[-1],
-                   "rc": proc.returncode, "lines": lines[-3:],
+                   "rc": rc, "lines": lines[-3:],
                    "stderr_tail": tail})
-        return False
+        return "fail"
+    if partial:
+        for r in results:
+            if isinstance(r, dict):
+                r["capture_partial"] = True
     with open(out_path, "w") as fh:
         json.dump(results[-1] if len(results) == 1 else results, fh, indent=1)
-    _log_line({"event": "bench_saved", "path": out_path, "result": results[-1]})
-    return True
+    _log_line({"event": "bench_saved", "path": out_path,
+               "partial": partial, "result": results[-1]})
+    # only a COMPLETE run blocks later re-capture; a salvaged partial
+    # whose rows ran on an accelerator still proves the window is ALIVE,
+    # so the capture chain should continue with the cheaper artifacts
+    if not partial:
+        return "ok"
+    alive = any(isinstance(r, dict)
+                and (r.get("platform") or r.get("jax_platform"))
+                not in (None, "cpu") for r in results)
+    return "partial" if alive else "fail"
 
 
 _last_hang_sig: list = [None]
@@ -148,12 +172,14 @@ def capture_evidence(platform: str) -> None:
             continue  # captured in an earlier window; don't re-burn time
         cmd = [sys.executable] + [os.path.join(ROOT, *rel_cmd[0].split("/"))] \
             + rel_cmd[1:]
-        ok = _run_and_capture(cmd, os.path.join(ROOT, out_name),
-                              timeout_s=timeout_s, env=env)
-        if not ok:
+        status = _run_and_capture(cmd, os.path.join(ROOT, out_name),
+                                  timeout_s=timeout_s, env=env)
+        if status == "fail":
             # window probably died mid-step — stop here; a later probe
             # re-enters and retries only what is still missing
             break
+        # "partial": the salvaged rows ran on the accelerator, so the
+        # window is alive — keep going with the cheaper artifacts
         # re-seed ONLY after a success: the step's completion is fresh
         # proof of liveness, whereas re-seeding after a failure would
         # steer the next step into unbounded init on a dead tunnel
@@ -172,6 +198,8 @@ def _artifact_on_device(path: str) -> bool:
     except (OSError, ValueError):
         return False
     rows = data if isinstance(data, list) else [data]
+    if any(isinstance(r, dict) and r.get("capture_partial") for r in rows):
+        return False  # salvaged from a timeout — retry in a later window
     plats = [r.get("platform") or r.get("jax_platform")
              for r in rows if isinstance(r, dict)]
     plats = [p for p in plats if p]
