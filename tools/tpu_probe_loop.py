@@ -51,6 +51,9 @@ EVIDENCE = [
     (["tools/bench_suite.py"], f"BENCH_SUITE_TPU_{ROUND}.json", 3300),
     (["tools/device_parity.py"], f"PARITY_TPU_{ROUND}.json", 1200),
     (["tools/entry_check.py"], f"ENTRY_TPU_{ROUND}.json", 900),
+    # microprofile: dispatch RTT, H2D/D2H bandwidth, device-only model
+    # fps — the numbers that attribute the host-ingest gap to the tunnel
+    (["tools/tpu_profile.py"], f"PROFILE_TPU_{ROUND}.json", 600),
 ]
 
 
