@@ -654,6 +654,11 @@ def main() -> None:
     # -- 5. among-device: sharded stream over 2 loopback query workers ------
     name = "tensor_query_sharded_x2"
     _log(f"{name}: 2 loopback workers, frames={frames}")
+    # workers serve the north star's classification model (BASELINE
+    # config #5 names no model): uint8 frames on the wire + fused-u8
+    # mobilenet, so the sharded stream measures query/shard/re-join
+    # mechanics, not a 22 MB/frame logits volume (the r4 worker ran
+    # full deeplab and the TPU row was pure tunnel D2H)
     servers = []
     try:
         ports = []
@@ -661,9 +666,9 @@ def main() -> None:
             srv = parse_launch(
                 f"tensor_query_serversrc name=ssrc id={i} port=0 "
                 f"caps=other/tensors,format=static,dimensions=3:{size}:{size}:1,"
-                "types=float32 "
+                "types=uint8 "
                 "! tensor_filter framework=jax "
-                "model=nnstreamer_tpu.models.deeplab:filter_model "
+                "model=nnstreamer_tpu.models.mobilenet_v2:filter_model_u8 "
                 f"! tensor_query_serversink id={i}")
             srv.play()
             servers.append(srv)
@@ -676,7 +681,7 @@ def main() -> None:
             ports.append(ssrc.bound_port)
         client = parse_launch(
             f"tensor_src num-buffers={frames} dimensions=3:{size}:{size}:1 "
-            "types=float32 pattern=random "
+            "types=uint8 pattern=random "
             "! tensor_shard name=s "
             f"s.src_0 ! queue ! tensor_query_client host=127.0.0.1 "
             f"port={ports[0]} ! u.sink_0 "
