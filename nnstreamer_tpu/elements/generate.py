@@ -62,6 +62,15 @@ class TensorGenerate(Element):
                              "persist the KV cache across prompt buffers "
                              "(multi-turn; buffer meta reset=True starts "
                              "a new conversation)"),
+        "serve_dtype": Prop("", str,
+                            "serving dtype for the entry's params + KV "
+                            "cache (e.g. bfloat16 — halves decode HBM "
+                            "reads; activations stay float32; entry must "
+                            "be a dataclass with a serve_dtype field)"),
+        "cache_len": Prop(0, int,
+                          "right-size the serving KV cache/masks to this "
+                          "length instead of the model's max_seq (entry "
+                          "dataclass field cache_len; 0 = max_seq)"),
         "temperature": Prop(0.0, float,
                             "0 = greedy (deterministic); > 0 = categorical "
                             "sampling"),
@@ -94,6 +103,28 @@ class TensorGenerate(Element):
                 f"make_streaming(mesh), got {model!r}")
         mod_name, _, attr = model.partition(":")
         entry = getattr(importlib.import_module(mod_name), attr)
+        sd, cl = self.props["serve_dtype"], self.props["cache_len"]
+        if cl < 0:
+            raise ElementError(
+                f"{self.name}: cache-len must be >= 0 (0 = model max_seq), "
+                f"got {cl}")
+        if sd or cl:
+            import dataclasses
+
+            kw = {}
+            if sd:
+                kw["serve_dtype"] = sd
+            if cl:
+                kw["cache_len"] = cl
+            fields = ({f.name for f in dataclasses.fields(entry)}
+                      if dataclasses.is_dataclass(entry)
+                      and not isinstance(entry, type) else set())
+            if not fields >= kw.keys():
+                raise ElementError(
+                    f"{self.name}: serve-dtype/cache-len need a dataclass "
+                    f"entry instance with those fields; {model} is "
+                    f"{type(entry).__name__}")
+            entry = dataclasses.replace(entry, **kw)
         conversation = self.props["conversation"]
         maker = getattr(
             entry, "make_session" if conversation else "make_streaming",

@@ -248,3 +248,33 @@ def test_element_temperature_prop(prompt):
     tc = np.concatenate([np.asarray(b.tensors[0]) for b in bufs_c], axis=1)
     np.testing.assert_array_equal(ta, tb)
     assert (ta != tc).any()
+
+
+def test_serve_knobs_on_launch_line(prompt):
+    """serve-dtype/cache-len reach the entry from the launch string;
+    cache-len alone is token-exact vs the default stream."""
+    base = _generate_stream(prompt)
+    sized = _generate_stream(prompt, extra_props=f"cache-len={P + S + 2}")
+    assert len(sized) == len(base) == S
+    for a, b in zip(base, sized):
+        np.testing.assert_array_equal(np.asarray(a.tensors[0]),
+                                      np.asarray(b.tensors[0]))
+    bf16 = _generate_stream(
+        prompt, extra_props=f"cache-len={P + S + 2} serve-dtype=bfloat16")
+    assert len(bf16) == S  # runs end-to-end; dtype may flip rare argmax ties
+
+
+def test_serve_knobs_need_dataclass_entry(prompt):
+    pipe = parse_launch(
+        "appsrc name=in caps=other/tensors,format=static,"
+        f"dimensions={P}:{B},types=int32 "
+        "! tensor_generate model=nnstreamer_tpu.models.mobilenet_v2:filter_model "
+        "serve-dtype=bfloat16 steps=2 "
+        "! tensor_sink name=out")
+    pipe.play()
+    try:
+        pipe.get("in").push_buffer(prompt)
+        msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=30)
+        assert msg is not None and "dataclass" in str(msg.data.get("error"))
+    finally:
+        pipe.stop()
