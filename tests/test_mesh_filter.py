@@ -179,3 +179,26 @@ def test_query_server_with_mesh_sharded_filter():
         assert server.get("f").backend_mesh.size == 8
     finally:
         server.stop()
+
+
+def test_mesh_filter_into_batched_decoder_reduce():
+    """Mesh-sharded filter output (GSPMD jax.Array over dp) flows into the
+    batched device-side decoder reduction: the reduce jit consumes the
+    sharded batch directly and the emitted per-frame labels match the
+    unsharded run frame-for-frame."""
+    labels = "/tmp/nns_mesh_dec_labels.txt"
+    with open(labels, "w") as fh:
+        fh.write("\n".join(f"c{i}" for i in range(64)))
+    launch = (
+        "tensor_src num-buffers=16 dimensions=64:1 types=float32 "
+        "pattern=random seed=5 "
+        "! tensor_aggregator frames-out=8 frames-dim=0 concat=true "
+        "! tensor_filter framework=jax model=builtin://scaler?factor=2 "
+        "custom={c} name=f "
+        f"! tensor_decoder mode=image_labeling option1={labels} frames-in=8 "
+        "! tensor_sink name=out max-stored=64")
+    got_mesh, mesh = _run(launch.format(c="mesh:dp=8"))
+    got_single, _ = _run(launch.format(c="device:0"))
+    assert mesh is not None and len(got_mesh) == len(got_single) == 16
+    assert [b.meta["label_index"] for b in got_mesh] == \
+        [b.meta["label_index"] for b in got_single]
