@@ -19,15 +19,16 @@ _NUM_CLASSES = 21  # PASCAL-VOC, like the reference's deeplab demo
 
 
 def build_deeplab(num_classes: int = _NUM_CLASSES, image_size: int = 224,
-                  compute_dtype: str = "bfloat16"):
+                  compute_dtype: str = "auto"):
     """Returns ``(apply_fn, params)``: ``apply_fn(params, x_nhwc_f32) ->
     (B, H, W, num_classes) logits`` at input resolution."""
     import jax
     import jax.numpy as jnp
     from flax import linen as nn
 
-    from ._blocks import make_blocks
+    from ._blocks import make_blocks, resolve_compute_dtype
 
+    compute_dtype = resolve_compute_dtype(compute_dtype)
     cdt = jnp.dtype(compute_dtype)
     ConvBnRelu, InvertedResidual = make_blocks(compute_dtype)
 

@@ -29,15 +29,16 @@ _BODY = [
 
 
 def build_mobilenet_v2(num_classes: int = 1001, width_mult: float = 1.0,
-                       compute_dtype: str = "bfloat16"):
+                       compute_dtype: str = "auto"):
     """Returns ``(apply_fn, params)``: ``apply_fn(params, x_nhwc_f32) ->
     logits`` — a pure jax-traceable callable (jit/pjit-ready)."""
     import jax
     import jax.numpy as jnp
     from flax import linen as nn
 
-    from ._blocks import make_blocks
+    from ._blocks import make_blocks, resolve_compute_dtype
 
+    compute_dtype = resolve_compute_dtype(compute_dtype)
     cdt = jnp.dtype(compute_dtype)
     ConvBnRelu, InvertedResidual = make_blocks(compute_dtype)
 

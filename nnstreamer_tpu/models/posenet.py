@@ -14,7 +14,7 @@ _NUM_KEYPOINTS = 17
 
 
 def build_posenet(num_keypoints: int = _NUM_KEYPOINTS, image_size: int = 224,
-                  compute_dtype: str = "bfloat16"):
+                  compute_dtype: str = "auto"):
     """Returns ``(apply_fn, params)``: ``apply_fn(params, x_nhwc_f32) ->
     (B, H/8, W/8, K) sigmoid heatmaps``. ``apply_fn.keypoints`` maps the
     same input to normalized (B, K, 2) [x, y] coordinates on device."""
@@ -22,8 +22,9 @@ def build_posenet(num_keypoints: int = _NUM_KEYPOINTS, image_size: int = 224,
     import jax.numpy as jnp
     from flax import linen as nn
 
-    from ._blocks import make_blocks
+    from ._blocks import make_blocks, resolve_compute_dtype
 
+    compute_dtype = resolve_compute_dtype(compute_dtype)
     cdt = jnp.dtype(compute_dtype)
     ConvBnRelu, InvertedResidual = make_blocks(compute_dtype)
 

@@ -69,7 +69,7 @@ def decode_boxes_np(loc: np.ndarray, anchors: np.ndarray,
 
 
 def build_ssd_mobilenet(num_classes: int = 91, image_size: int = 224,
-                        compute_dtype: str = "bfloat16"):
+                        compute_dtype: str = "auto"):
     """Returns ``(apply_fn, params, anchors)``.
 
     ``apply_fn(params, x_nhwc_f32) -> (boxes, scores)`` with boxes
@@ -80,8 +80,9 @@ def build_ssd_mobilenet(num_classes: int = 91, image_size: int = 224,
     import jax.numpy as jnp
     from flax import linen as nn
 
-    from ._blocks import make_blocks
+    from ._blocks import make_blocks, resolve_compute_dtype
 
+    compute_dtype = resolve_compute_dtype(compute_dtype)
     cdt = jnp.dtype(compute_dtype)
     ConvBnRelu, InvertedResidual = make_blocks(compute_dtype)
     strides = (8, 16, 32, 64)
