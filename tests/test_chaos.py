@@ -127,3 +127,37 @@ class TestDelayBackpressure:
                 "! tensor_sink name=out max-stored=128")
             outs.append([float(np.asarray(b.tensors[0])[0]) for b in got])
         assert outs[0] == outs[1]
+
+
+class TestDeviceResidentChaos:
+    def test_batched_device_decode_survives_batch_drops(self):
+        """r5 device path under loss: whole device-resident batches drop
+        upstream of the batched decoder; every surviving batch still
+        expands to exactly frames-in per-frame buffers, in order."""
+        fi = 4
+        pipe, got = run_all(
+            f"tensor_src device=true num-buffers=20 dimensions=8:{fi} "
+            "types=float32 pattern=random seed=29 "
+            "! tensor_fault name=f drop-prob=0.3 seed=31 "
+            f"! tensor_decoder mode=image_labeling frames-in={fi} "
+            "! tensor_sink name=out max-stored=256",
+            timeout=60.0)
+        stats = pipe.get("f").stats
+        assert stats["dropped"] > 0
+        assert len(got) == stats["passed"] * fi
+        # every emitted label index is a valid per-frame argmax result
+        assert all(0 <= b.meta["label_index"] < 8 for b in got)
+
+    def test_corrupted_batch_still_decodes_per_frame(self):
+        """Corruption pulls the batch to host (fault mutates bytes): the
+        decoder's HOST batched-split path must still emit frames-in
+        buffers of garbage labels, never crash or change count."""
+        fi = 4
+        pipe, got = run_all(
+            f"tensor_src device=true num-buffers=10 dimensions=8:{fi} "
+            "types=float32 pattern=random seed=37 "
+            "! tensor_fault corrupt-prob=1.0 seed=41 "
+            f"! tensor_decoder mode=image_labeling frames-in={fi} "
+            "! tensor_sink name=out max-stored=64",
+            timeout=60.0)
+        assert len(got) == 10 * fi
