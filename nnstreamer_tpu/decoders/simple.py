@@ -49,6 +49,24 @@ class DirectVideo(Decoder):
             a = np.clip(a, 0, 255).astype(np.uint8)
         return Buffer([a])
 
+    def make_reduce(self, in_info: TensorsInfo):
+        """Device stage: clip+cast to uint8 on the accelerator — float
+        video tensors cross D2H at 1 byte/px instead of 4."""
+        import jax.numpy as jnp
+
+        def reduce(ts):
+            a = ts[0]
+            if a.dtype == jnp.uint8:
+                return (a,)
+            return (jnp.clip(a, 0, 255).astype(jnp.uint8),)
+        return reduce
+
+    def decode_reduced(self, arrays, in_info: TensorsInfo) -> Optional[Buffer]:
+        a = np.asarray(arrays[0])
+        if a.ndim == 4:
+            a = a[0]
+        return Buffer([a])
+
 
 @register_decoder
 class ImageLabeling(Decoder):

@@ -337,3 +337,19 @@ class TestFi1Reduce:
             push=[Buffer([jnp.asarray(scores)])])
         assert len(out) == 1
         assert out[0].meta["label_indices"] == [int(i) for i in scores.argmax(-1)]
+
+
+class TestDirectVideoReduce:
+    def test_float_frames_cast_on_device(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(15)
+        frames = (rng.random((3, 6, 4, 3)) * 300 - 20).astype(np.float32)
+        dec = "tensor_decoder mode=direct_video"
+        legacy = _legacy_frames(dec, "3:4:6:1",
+                                [frames[i:i + 1] for i in range(3)])
+        reduced = _device_batched(dec, "3:4:6:3", frames, 3)
+        assert len(legacy) == len(reduced) == 3
+        for a, b in zip(legacy, reduced):
+            np.testing.assert_array_equal(np.asarray(a.tensors[0]),
+                                          np.asarray(b.tensors[0]))
