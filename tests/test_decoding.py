@@ -223,3 +223,18 @@ class TestServingCache:
         gen_out, gen_ref = out[:, 8:], ref[:, 8:]
         agree = float(jnp.mean((gen_out == gen_ref).astype(jnp.float32)))
         assert agree >= 0.5
+
+    def test_session_capacity_tracks_cache_len(self):
+        """Multi-turn serving with a right-sized cache: the capacity
+        guard raises cleanly when history+turn would overflow cache_len
+        (not max_seq) instead of silently clamping cache writes."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.lm_serving import _LMServingEntry
+
+        entry = _LMServingEntry(CFG, cache_len=16)
+        session = entry.make_session()
+        prompt = jnp.zeros((1, 6), jnp.int32)
+        list(session.generate(prompt, 4))          # pos -> 10
+        with pytest.raises(ValueError, match="16"):
+            list(session.generate(prompt, 8))      # 10 + 6 + 8 > 16
