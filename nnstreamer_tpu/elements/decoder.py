@@ -75,6 +75,8 @@ class TensorDecoder(TransformElement):
         self._in_info: Optional[TensorsInfo] = None
         self._frame_info: Optional[TensorsInfo] = None
         self._reduce_jit = None  # (fn, built) — built lazily per caps
+        self._reduce_sigs: set = set()
+        self._sig_warned = False
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
         self._in_info = tensors_info_from_caps(caps)
@@ -139,6 +141,7 @@ class TensorDecoder(TransformElement):
             # small device→host pull, then per-frame host rendering
             import jax
 
+            self._track_signature(buf)
             reduced = jax.device_get(reduce_fn(list(buf.tensors)))
             for f in range(fi):
                 out = self.decoder.decode_reduced(
@@ -155,6 +158,27 @@ class TensorDecoder(TransformElement):
                             for t in host.tensors])
             self._push_decoded(
                 self.decoder.decode(frame, self._frame_info), buf)
+
+    def _track_signature(self, buf: Buffer) -> None:
+        """Same shape-bucketing pressure valve as the jax filter backend
+        (jax_backend._track_signature): a flexible stream pushing a new
+        shape per buffer forces an XLA recompile of the reduce each time —
+        warn once so the user buckets shapes upstream."""
+        sig = tuple((getattr(t, "shape", None), getattr(t, "dtype", None))
+                    for t in buf.tensors)
+        sigs = self._reduce_sigs
+        if sig in sigs:
+            return
+        sigs.add(sig)
+        if len(sigs) >= 32 and not self._sig_warned:
+            self._sig_warned = True
+            from ..utils.log import logger
+
+            logger.warning(
+                "%s: device reduction hit %d distinct input signatures — "
+                "a flexible stream is forcing XLA recompiles per shape; "
+                "bucket shapes upstream (tensor_aggregator / pad)",
+                self.describe(), len(sigs))
 
     def _get_reduce(self):
         """Lazily jit the decoder's device reduction for the current caps.
