@@ -488,3 +488,53 @@ class TestBatchOption:
             load_tflite(path, {"batch": "x"})
         with pytest.raises(ValueError, match="batch"):
             load_tflite(path, {"batch": "0"})
+
+
+@pytest.mark.slow
+def test_zoo_quant_through_batched_device_decoder():
+    """The reference's real quantized MobileNet, int8 execution, batched
+    through the r5 device-side decoder reduction: aggregator batch of 4 →
+    int8 XLA graph → image_labeling frames-in=4 → 8 per-frame labels,
+    identical to the tflite interpreter's argmax on the same frames."""
+    from nnstreamer_tpu.core import Buffer
+    from nnstreamer_tpu.runtime.parse import parse_launch
+
+    path = os.path.join(REF_MODELS, "mobilenet_v2_1.0_224_quant.tflite")
+    rng = np.random.default_rng(19)
+    frames = rng.integers(0, 255, (8, 224, 224, 3)).astype(np.uint8)
+    pipe = parse_launch(
+        "appsrc name=in caps=other/tensors,format=static,"
+        "dimensions=3:224:224:1,types=uint8 "
+        "! tensor_aggregator frames-out=4 frames-dim=0 concat=true "
+        f"! tensor_filter framework=jax model={path} "
+        "custom=quantized_exec:int8,batch:4 "
+        "! tensor_decoder mode=image_labeling frames-in=4 "
+        "! tensor_sink name=out max-stored=16")
+    got = []
+    pipe.get("out").connect(got.append)
+    src = pipe.get("in")
+    pipe.play()
+    for i in range(8):
+        src.push_buffer(Buffer([frames[i:i + 1]]))
+    src.end_of_stream()
+    pipe.wait(timeout=600)
+    pipe.stop()
+    assert len(got) == 8
+    # decode-path property: the pipeline's labels are EXACTLY the argmax
+    # of the int8 XLA graph the filter ran (the device reduction must not
+    # change the answer)
+    from nnstreamer_tpu.models.tflite_import import load_tflite
+
+    fn, _, _ = load_tflite(path, {"quantized_exec": "int8", "batch": "4"})
+    own = np.concatenate([np.asarray(fn(frames[:4])[0]),
+                          np.asarray(fn(frames[4:])[0])])
+    assert [b.meta["label_index"] for b in got] == \
+        [int(i) for i in own.argmax(-1)]
+    # interpreter agreement follows the int8 contract (±4 bytes, noise
+    # images have near-ties): majority top-1, not exactness
+    it = _interp(path)
+    want = [int(_run_interp(it, frames[i:i + 1])[0].argmax())
+            for i in range(8)]
+    agree = sum(a == b for a, b in
+                zip([b.meta["label_index"] for b in got], want))
+    assert agree >= 6, f"top-1 parity too low: {agree}/8"
