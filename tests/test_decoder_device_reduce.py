@@ -353,3 +353,31 @@ class TestDirectVideoReduce:
         for a, b in zip(legacy, reduced):
             np.testing.assert_array_equal(np.asarray(a.tensors[0]),
                                           np.asarray(b.tensors[0]))
+
+
+class TestQosInterplay:
+    def test_throttled_stream_through_batched_decoder(self):
+        """tensor_rate framerate cap upstream of the batched device
+        decoder: throttling changes arrival pacing, never the per-batch
+        frame expansion or label values."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(21)
+        scores = rng.random((2, 4, 6)).astype(np.float32)
+        outs = []
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=6:4,types=float32 "
+            "! tensor_rate framerate=200/1 "
+            "! tensor_decoder mode=image_labeling frames-in=4 "
+            "! tensor_sink name=out max-stored=16")
+        pipe.get("out").connect(outs.append)
+        pipe.play()
+        for i in range(2):
+            pipe.get("in").push_buffer(Buffer([jnp.asarray(scores[i])]))
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert len(outs) == 8
+        assert [b.meta["label_index"] for b in outs] == \
+            [int(i) for i in scores.reshape(8, 6).argmax(-1)]
