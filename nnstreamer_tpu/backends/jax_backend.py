@@ -43,6 +43,39 @@ from .base import (
 )
 
 
+def _apply_serve_knobs(entry, custom: dict, model: str):
+    """``custom=serve_dtype:bfloat16,cache_len:640`` on a module:attr
+    entry: rebuild the (dataclass) entry with the serving-efficiency
+    fields (models/lm_serving.py — bf16 weights+KV cache, right-sized
+    cache). Mirrors tensor_generate's serve-dtype/cache-len launch
+    props on the whole-sequence tensor_filter surface."""
+    sd = custom.get("serve_dtype")
+    cl = custom.get("cache_len")
+    if not sd and not cl:
+        return entry
+    import dataclasses
+
+    kw = {}
+    if sd:
+        kw["serve_dtype"] = sd
+    if cl:
+        try:
+            kw["cache_len"] = int(cl)
+        except ValueError:
+            raise ValueError(f"custom=cache_len:{cl!r} is not an integer")
+        if kw["cache_len"] < 0:
+            raise ValueError(f"custom=cache_len:{cl} must be >= 0")
+    fields = ({f.name for f in dataclasses.fields(entry)}
+              if dataclasses.is_dataclass(entry)
+              and not isinstance(entry, type) else set())
+    if not fields >= kw.keys():
+        raise ValueError(
+            f"custom serve_dtype/cache_len need a dataclass model entry "
+            f"with those fields; {model} is {type(entry).__name__}")
+    return dataclasses.replace(entry, **kw)
+
+
+
 def _builtin_models() -> Dict[str, Callable[[dict], Callable]]:
     import jax.numpy as jnp
 
@@ -330,6 +363,7 @@ class JaxBackend(FilterBackend):
             mod_name, _, attr = model.partition(":")
             mod = importlib.import_module(mod_name)
             fn = getattr(mod, attr)
+            fn = _apply_serve_knobs(fn, props.custom_dict(), model)
             if self._mesh is not None:
                 # shard-aware entry: the model builds against the mesh
                 # (tp PartitionSpecs on params/cache; lm_serving.py)

@@ -89,3 +89,63 @@ def test_heads_not_divisible_by_tp_posts_error():
     pipe.stop()
     assert msg is not None
     assert "not divisible" in str(msg.data.get("error", ""))
+
+
+class TestFilterServeKnobs:
+    def test_custom_serve_knobs_reach_entry(self):
+        """tensor_filter custom=serve_dtype/cache_len: the whole-sequence
+        serving surface gets the same knobs as tensor_generate."""
+        import os
+
+        import numpy as np
+
+        from nnstreamer_tpu.core import Buffer
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        prompt = np.random.default_rng(31).integers(
+            0, 64, (2, 6)).astype(np.int32)
+        os.environ["NNS_LM_STEPS"] = "4"
+        try:
+            outs = {}
+            for custom in ("", "custom=cache_len:16 "):
+                pipe = parse_launch(
+                    "appsrc name=in caps=other/tensors,format=static,"
+                    "dimensions=6:2,types=int32 "
+                    "! tensor_filter framework=jax "
+                    f"model=nnstreamer_tpu.models.lm_serving:tiny {custom}"
+                    "! tensor_sink name=out")
+                got = []
+                pipe.get("out").connect(
+                    lambda b: got.append(np.asarray(b.tensors[0])))
+                pipe.play()
+                pipe.get("in").push_buffer(Buffer([prompt]))
+                pipe.get("in").end_of_stream()
+                pipe.wait(timeout=120)
+                pipe.stop()
+                outs[custom] = got[0]
+        finally:
+            del os.environ["NNS_LM_STEPS"]
+        # right-sized cache is token-exact with the full-cache run
+        np.testing.assert_array_equal(outs[""], outs["custom=cache_len:16 "])
+
+    def test_custom_serve_knobs_need_dataclass(self):
+        from nnstreamer_tpu.core import MessageType
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        import numpy as np
+
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=4:2,types=float32 "
+            "! tensor_filter framework=jax "
+            "model=nnstreamer_tpu.models.mobilenet_v2:filter_model "
+            "custom=serve_dtype:bfloat16 "
+            "! tensor_sink name=out")
+        pipe.play()
+        try:
+            pipe.get("in").push_buffer(
+                np.zeros((2, 4), np.float32))
+            msg = pipe.bus.wait_for((MessageType.ERROR,), timeout=30)
+            assert msg is not None and "dataclass" in str(msg.data.get("error"))
+        finally:
+            pipe.stop()
