@@ -212,10 +212,39 @@ class TensorRegion(Decoder):
             order = np.argsort(-scores.reshape(-1))[: self.num]
         else:
             order = np.arange(min(self.num, boxes.shape[0]))
-        sel = boxes[order]
+        return self._regions_from(boxes[order])
+
+    def _regions_from(self, sel: np.ndarray) -> Buffer:
         ymin, xmin, ymax, xmax = sel[:, 0], sel[:, 1], sel[:, 2], sel[:, 3]
         x = np.round(xmin * self.frame_w).astype(np.int32)
         y = np.round(ymin * self.frame_h).astype(np.int32)
         w = np.round((xmax - xmin) * self.frame_w).astype(np.int32)
         h = np.round((ymax - ymin) * self.frame_h).astype(np.int32)
         return Buffer([np.stack([x, y, w, h], axis=1)])
+
+    def make_reduce(self, in_info: TensorsInfo):
+        """Device stage for the SIMPLIFIED mode only: top-num selection
+        on the accelerator, (num, 4) rows per frame cross D2H. The
+        priors (reference byte-parity) mode never reduces."""
+        if self.priors is not None:
+            return None
+        import jax.numpy as jnp
+        from jax import lax
+
+        num = self.num
+
+        def reduce(ts):
+            boxes = ts[0].reshape(ts[0].shape[0], -1, 4).astype(jnp.float32)
+            if len(ts) > 1:
+                s = ts[1].astype(jnp.float32)
+                s = s.reshape(boxes.shape[0], boxes.shape[1], -1).max(-1)
+                k = min(num, boxes.shape[1])
+                _, idx = lax.top_k(s, k)
+                sel = jnp.take_along_axis(boxes, idx[..., None], axis=1)
+            else:
+                sel = boxes[:, :num]
+            return (sel,)
+        return reduce
+
+    def decode_reduced(self, arrays, in_info: TensorsInfo) -> Optional[Buffer]:
+        return self._regions_from(np.asarray(arrays[0]))

@@ -381,3 +381,21 @@ class TestQosInterplay:
         assert len(outs) == 8
         assert [b.meta["label_index"] for b in outs] == \
             [int(i) for i in scores.reshape(8, 6).argmax(-1)]
+
+
+class TestTensorRegionReduce:
+    def test_simplified_mode_parity(self):
+        rng = np.random.default_rng(22)
+        raw = np.sort(rng.random((3, 10, 4)).astype(np.float32), axis=-1)
+        boxes = raw[..., [0, 1, 2, 3]]
+        scores = rng.random((3, 10)).astype(np.float32)
+        dec = "tensor_decoder mode=tensor_region option1=2 option2=64:48"
+        legacy = _legacy_frames(
+            dec, "4:10:1.10",
+            [Buffer([boxes[i:i + 1], scores[i]]) for i in range(3)])
+        reduced = _device_batched(dec, "4:10:3.30",
+                                  [boxes, scores.reshape(-1)], 3)
+        assert len(legacy) == len(reduced) == 3
+        for a, b in zip(legacy, reduced):
+            np.testing.assert_array_equal(np.asarray(a.tensors[0]),
+                                          np.asarray(b.tensors[0]))
