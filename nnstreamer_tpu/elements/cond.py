@@ -136,11 +136,29 @@ class TensorIf(TransformElement):
             if fn is None:
                 raise ElementError(f"{self.describe()}: no custom condition '{opt}'")
             return fn(buf)
+        from ..core.buffer import _is_device_array
+
         if kind == "a-value":
             t_idx, _, flat_idx = opt.partition(":")
-            a = np.asarray(buf.tensors[int(t_idx or 0)])
-            return float(a.reshape(-1)[int(flat_idx or 0)])
-        t = np.asarray(buf.tensors[int(opt or 0)], dtype=np.float64)
+            t = buf.tensors[int(t_idx or 0)]
+            if _is_device_array(t):
+                # gather ONE element on device; only the scalar crosses
+                # D2H (a full np.asarray pull here would ship the whole
+                # tensor per frame at every branch point)
+                return float(t.reshape(-1)[int(flat_idx or 0)])
+            return float(np.asarray(t).reshape(-1)[int(flat_idx or 0)])
+        t = buf.tensors[int(opt or 0)]
+        if _is_device_array(t):
+            import jax.numpy as jnp
+
+            # reduce on device (f32 accumulation — jax's default; the
+            # host path keeps its f64 exactness), pull the scalar
+            red = jnp.sum if kind == "tensor-total-value" else jnp.mean
+            if kind in ("tensor-total-value", "tensor-average-value"):
+                return float(red(t.astype(jnp.float32)))
+            raise ElementError(
+                f"{self.describe()}: unknown compared-value '{kind}'")
+        t = np.asarray(t, dtype=np.float64)
         if kind == "tensor-total-value":
             return float(t.sum())
         if kind == "tensor-average-value":

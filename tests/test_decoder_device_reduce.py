@@ -399,3 +399,58 @@ class TestTensorRegionReduce:
         for a, b in zip(legacy, reduced):
             np.testing.assert_array_equal(np.asarray(a.tensors[0]),
                                           np.asarray(b.tensors[0]))
+
+
+class TestTensorIfDeviceScalar:
+    def test_device_stream_branches_like_host(self):
+        """tensor_if on a device-resident stream: the compared value is
+        reduced on device (scalar D2H only) and branching matches the
+        host-array run exactly."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(23)
+        frames = (rng.random((6, 1, 8)) * 4).astype(np.float32)
+
+        def run(push):
+            out = []
+            pipe = parse_launch(
+                "appsrc name=in caps=other/tensors,format=static,"
+                "dimensions=8:1,types=float32 "
+                "! tensor_if compared-value=tensor-average-value "
+                "compared-value-option=0 operator=ge supplied-value=2.0 "
+                "then=passthrough else=skip "
+                "! tensor_sink name=out max-stored=16")
+            pipe.get("out").connect(out.append)
+            pipe.play()
+            for b in push:
+                pipe.get("in").push_buffer(b)
+            pipe.get("in").end_of_stream()
+            pipe.wait(timeout=30)
+            pipe.stop()
+            return [np.asarray(b.tensors[0]) for b in out]
+
+        host = run([Buffer([frames[i]]) for i in range(6)])
+        dev = run([Buffer([jnp.asarray(frames[i])]) for i in range(6)])
+        assert 0 < len(host) < 6  # the threshold actually splits the set
+        assert len(host) == len(dev)
+        for a, b in zip(host, dev):
+            np.testing.assert_array_equal(a, b)
+
+    def test_a_value_device_single_element(self):
+        import jax.numpy as jnp
+
+        x = np.arange(12, dtype=np.float32).reshape(1, 12)
+        out = []
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=12:1,types=float32 "
+            "! tensor_if compared-value=a-value compared-value-option=0:5 "
+            "operator=eq supplied-value=5 then=passthrough else=skip "
+            "! tensor_sink name=out")
+        pipe.get("out").connect(out.append)
+        pipe.play()
+        pipe.get("in").push_buffer(Buffer([jnp.asarray(x)]))
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert len(out) == 1  # element [5] == 5.0 → passthrough
