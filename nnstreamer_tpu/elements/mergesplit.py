@@ -80,7 +80,17 @@ class TensorMerge(Element):
             if parts is None:
                 return
         axis = self.props["option"]
-        merged = np.concatenate([np.asarray(p.tensors[0]) for p in parts], axis=axis)
+        # device residency: jax arrays concatenate on device (lazy
+        # dispatch), so filter→merge chains never bounce through host —
+        # same stance as the aggregator's window
+        if any(p.on_device for p in parts):
+            import jax.numpy as jnp
+
+            merged = jnp.concatenate(
+                [jnp.asarray(p.tensors[0]) for p in parts], axis=axis)
+        else:
+            merged = np.concatenate(
+                [np.asarray(p.tensors[0]) for p in parts], axis=axis)
         out = Buffer([merged]).copy_metadata_from(parts[0])
         out.pts = max((p.pts for p in parts if p.pts is not None), default=None)
         self.push(out)
@@ -161,7 +171,8 @@ class TensorSplit(Element):
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
         axis = self.props["axis"]
-        a = np.asarray(buf.tensors[0])
+        # device arrays slice lazily on device (no D2H); host stays numpy
+        a = buf.tensors[0] if buf.on_device else np.asarray(buf.tensors[0])
         segs = self._segments(a.shape[axis])
         offsets = [sum(segs[:i]) for i in range(len(segs))]
         picked = self._picked(len(segs))

@@ -454,3 +454,33 @@ class TestTensorIfDeviceScalar:
         pipe.wait(timeout=30)
         pipe.stop()
         assert len(out) == 1  # element [5] == 5.0 → passthrough
+
+
+class TestMergeSplitResidency:
+    def test_device_arrays_stay_resident_through_merge_and_split(self):
+        """tensor_split → branches → tensor_merge on a device stream:
+        tensors remain jax Arrays end-to-end (no host bounce) and values
+        round-trip exactly."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.core.buffer import _is_device_array
+
+        x = np.arange(24, dtype=np.float32).reshape(1, 24)
+        out = []
+        pipe = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=24:1,types=float32 "
+            "! tensor_split name=s axis=1 tensorseg=8,16 "
+            "s.src_0 ! queue ! m.sink_0 "
+            "s.src_1 ! queue ! m.sink_1 "
+            "tensor_merge name=m mode=linear option=1 "
+            "! tensor_sink name=out")
+        pipe.get("out").connect(out.append)
+        pipe.play()
+        pipe.get("in").push_buffer(Buffer([jnp.asarray(x)]))
+        pipe.get("in").end_of_stream()
+        pipe.wait(timeout=30)
+        pipe.stop()
+        assert len(out) == 1
+        assert _is_device_array(out[0].tensors[0])
+        np.testing.assert_array_equal(np.asarray(out[0].tensors[0]), x)
