@@ -484,3 +484,30 @@ class TestMergeSplitResidency:
         assert len(out) == 1
         assert _is_device_array(out[0].tensors[0])
         np.testing.assert_array_equal(np.asarray(out[0].tensors[0]), x)
+
+
+class TestResidencyMatrix:
+    """Sweeping guard: routing/plumbing elements must not pull device
+    arrays to host as a side effect (the residency chain in
+    docs/device-pipelines.md)."""
+
+    @pytest.mark.parametrize("mid", [
+        "queue max-size-buffers=4",
+        "tensor_debug",
+        "tensor_rate framerate=1000/1",
+        "tensor_if compared-value=tensor-average-value operator=ge "
+        "supplied-value=-1e9 then=passthrough else=skip",
+        "tensor_mux name=x",  # single-pad mux degenerates to passthrough
+        "tensor_fault drop-prob=0.0 seed=1",
+    ])
+    def test_element_preserves_device_residency(self, mid):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.core.buffer import _is_device_array
+
+        out = run_collect(
+            "appsrc name=in caps=other/tensors,format=static,"
+            f"dimensions=6:2,types=float32 ! {mid} ! tensor_sink name=out",
+            push=[Buffer([jnp.ones((2, 6), jnp.float32)])])
+        assert len(out) == 1
+        assert _is_device_array(out[0].tensors[0]), f"{mid} pulled to host"
