@@ -23,7 +23,15 @@ os.environ["NNS_ENTRY_NO_PROBE"] = "1"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices; the equivalent XLA flag is
+    # read at first backend init, which has not happened yet (importing
+    # jax does not initialize a backend)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 

@@ -271,6 +271,14 @@ class JaxBackend(FilterBackend):
         """The device mesh this backend shards over (None = single-device)."""
         return self._mesh
 
+    @property
+    def model_callable(self) -> Optional[Callable]:
+        """The loaded jax-traceable model callable (None before open).
+        The serving layer (elements/serving.py) jits this itself so its
+        compile-count hook sees every trace; host-native programs
+        (``host_native`` attr) must go through :meth:`invoke` instead."""
+        return self._fn
+
     def _setup_mesh(self, spec: str) -> None:
         """``custom=mesh:dp=N`` / ``mesh:auto`` / ``mesh:DxT`` —
         in-pipeline sharded execution over the local device mesh (SURVEY

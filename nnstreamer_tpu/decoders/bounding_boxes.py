@@ -104,6 +104,16 @@ class BoundingBoxes(Decoder):
         self.log_results = self.option(7, "0") not in ("0", "", "false")
         self.style = self.option(8, "overlay")
         self.layout = self.option(9, "auto")
+        # option10 (our extension): device-path candidate cap before NMS
+        # (DEVICE_TOPK default). Exposed because the cap silently changes
+        # results when a scene has more above-threshold candidates than
+        # it keeps (ADVICE.md) — decode_reduced warns when that happens.
+        self.device_topk = int(self.option(10, str(self.DEVICE_TOPK)))
+        if self.device_topk < 1:
+            raise ValueError(
+                f"bounding_boxes: option10 (device top-k) must be >= 1, "
+                f"got {self.device_topk}")
+        self._topk_warned = False
         self._apply_mode_option3(self.option(3))
         self._tracker = None
         if self.style == "classic" and self.track:
@@ -361,8 +371,9 @@ class BoundingBoxes(Decoder):
     # greedy NMS on ≤K candidates is microseconds. The ``classic``
     # byte-parity path never reduces (host-exact by design).
 
-    DEVICE_TOPK = 256  # candidate cap; every score above threshold in a
-    # realistic scene fits — beyond it the reference caps detections too
+    DEVICE_TOPK = 256  # default candidate cap (option10 overrides); every
+    # score above threshold in a realistic scene fits — beyond it the
+    # reference caps detections too
 
     def make_reduce(self, in_info: TensorsInfo):
         if self.style == "classic" or self.fmt in _custom_parsers:
@@ -371,16 +382,21 @@ class BoundingBoxes(Decoder):
         import jax.numpy as jnp
         from jax import lax
 
-        k_cap = self.DEVICE_TOPK
+        k_cap = self.device_topk
+        thresh = self.score_threshold
 
         def reduce(ts):
             boxes, scores, classes = self._parse_jnp(ts, jnp)
+            # counted BEFORE the cap: decode_reduced compares it against
+            # the kept count to detect a truncation that silently diverges
+            # device results from a host decode of the identical stream
+            n_above = (scores > thresh).sum(-1).astype(jnp.int32)
             if boxes.shape[1] > k_cap:
                 scores, idx = lax.top_k(scores, k_cap)
                 boxes = jnp.take_along_axis(boxes, idx[..., None], axis=1)
                 classes = jnp.take_along_axis(classes, idx, axis=1)
             return (boxes.astype(jnp.float32), scores.astype(jnp.float32),
-                    classes.astype(jnp.int32))
+                    classes.astype(jnp.int32), n_above)
         return reduce
 
     def _parse_jnp(self, ts, jnp):
@@ -475,7 +491,17 @@ class BoundingBoxes(Decoder):
         raise ValueError(f"bounding_boxes: unknown format '{self.fmt}'")
 
     def decode_reduced(self, arrays, in_info: TensorsInfo) -> Optional[Buffer]:
-        boxes, scores, classes = (np.asarray(a) for a in arrays)
+        boxes, scores, classes, n_above = (np.asarray(a) for a in arrays)
+        if not self._topk_warned and int(n_above) > boxes.shape[0]:
+            self._topk_warned = True
+            from ..utils.log import logger
+
+            logger.warning(
+                "bounding_boxes[%s]: device top-k cap %d truncated %d "
+                "above-threshold candidates — results diverge from a host "
+                "decode of this stream; raise option10 (device top-k) to "
+                "keep them (further truncations are silent)",
+                self.fmt, boxes.shape[0], int(n_above) - boxes.shape[0])
         return self._render_overlay(boxes, scores, classes.astype(np.int64))
 
     # -- decode -------------------------------------------------------------

@@ -116,7 +116,19 @@ class ImageLabeling(Decoder):
 
     def make_reduce(self, in_info: TensorsInfo):
         """Device stage: argmax over class scores on the accelerator —
-        one int32 per frame crosses D2H instead of the score vector."""
+        one int32 per frame crosses D2H instead of the score vector.
+
+        Engages only when the per-frame layout yields ONE label per
+        frame (leading dim 1 / 1-D scores): a per-frame leading dim
+        d0 > 1 means the host path emits d0 labels per frame, and a
+        flattened argmax here would encode row*C+class — device and
+        host paths must emit the same labels (ADVICE.md), so those
+        layouts (and unknown/flexible specs) stay on the host."""
+        if not in_info.specs:
+            return None  # flexible stream: per-frame layout unknowable here
+        shape = in_info.specs[0].shape
+        if len(shape) >= 2 and shape[0] > 1:
+            return None
         import jax.numpy as jnp
 
         def reduce(ts):

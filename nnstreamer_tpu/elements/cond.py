@@ -51,6 +51,20 @@ _OPERATORS = {
 
 @register_element
 class TensorIf(TransformElement):
+    """Branch the stream on a per-buffer condition. Precision note:
+    `tensor-total-value`/`tensor-average-value` reduce device-resident
+    buffers in float32 ON the accelerator (only the scalar crosses D2H)
+    but host-resident buffers in float64 — the compared value can differ
+    in the last bits depending on where the buffer lives, so `eq`/`ne`
+    compare with a small relative tolerance (1e-6) on the device path
+    and threshold operators (`gt`/`lt`/...) should not be aimed exactly
+    at a value the reduction computes. `a-value` reads one element with
+    no accumulation and is exact on both paths.
+
+    Reference analog: gsttensor_if.c (which is host-only and always
+    f64-exact; the residency dependence is ours, bought for keeping the
+    branch decision on-device)."""
+
     ELEMENT_NAME = "tensor_if"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
     # static "src" merges both branches onto one stream; the reference
@@ -65,7 +79,11 @@ class TensorIf(TransformElement):
     )
     PROPERTIES = {
         "compared_value": Prop("a-value", str,
-                               "a-value | tensor-total-value | tensor-average-value | custom"),
+                               "a-value | tensor-total-value | "
+                               "tensor-average-value | custom "
+                               "(total/average reduce in f32 on device "
+                               "buffers vs f64 on host — see precision "
+                               "note above)"),
         "compared_value_option": Prop("0", str,
                                       "a-value: 'tensorIdx:flatIdx'; total/average: tensor idx; custom: registered name"),
         "operator": Prop("gt", str, "|".join(_OPERATORS)),
@@ -128,14 +146,23 @@ class TensorIf(TransformElement):
         return caps_from_tensors_info(TensorsInfo.of(*(info.specs[i] for i in picks)))
 
     # -- condition ----------------------------------------------------------
-    def _compared_value(self, buf: Buffer) -> float:
+    # equality tolerance for the device reduce path: its f32 accumulation
+    # legitimately differs from the host's f64 in the last bits, so an
+    # exact eq/ne there would branch on buffer RESIDENCY (docs/elements.md)
+    _DEVICE_EQ_RTOL = 1e-6
+
+    def _compared_value(self, buf: Buffer):
+        """Returns (value, approx): approx marks the device total/average
+        reduction, whose f32 accumulation is not bit-identical to the
+        host's f64 path — equality operators then compare with a small
+        tolerance instead of branching on residency."""
         kind = self.props["compared_value"]
         opt = self.props["compared_value_option"]
         if kind == "custom":
             fn = _custom_conditions.get(opt)
             if fn is None:
                 raise ElementError(f"{self.describe()}: no custom condition '{opt}'")
-            return fn(buf)
+            return fn(buf), False
         from ..core.buffer import _is_device_array
 
         if kind == "a-value":
@@ -144,9 +171,10 @@ class TensorIf(TransformElement):
             if _is_device_array(t):
                 # gather ONE element on device; only the scalar crosses
                 # D2H (a full np.asarray pull here would ship the whole
-                # tensor per frame at every branch point)
-                return float(t.reshape(-1)[int(flat_idx or 0)])
-            return float(np.asarray(t).reshape(-1)[int(flat_idx or 0)])
+                # tensor per frame at every branch point). A single
+                # element is exact — no accumulation, no tolerance.
+                return float(t.reshape(-1)[int(flat_idx or 0)]), False
+            return float(np.asarray(t).reshape(-1)[int(flat_idx or 0)]), False
         t = buf.tensors[int(opt or 0)]
         if _is_device_array(t):
             import jax.numpy as jnp
@@ -155,25 +183,30 @@ class TensorIf(TransformElement):
             # host path keeps its f64 exactness), pull the scalar
             red = jnp.sum if kind == "tensor-total-value" else jnp.mean
             if kind in ("tensor-total-value", "tensor-average-value"):
-                return float(red(t.astype(jnp.float32)))
+                return float(red(t.astype(jnp.float32))), True
             raise ElementError(
                 f"{self.describe()}: unknown compared-value '{kind}'")
         t = np.asarray(t, dtype=np.float64)
         if kind == "tensor-total-value":
-            return float(t.sum())
+            return float(t.sum()), False
         if kind == "tensor-average-value":
-            return float(t.mean())
+            return float(t.mean()), False
         raise ElementError(f"{self.describe()}: unknown compared-value '{kind}'")
 
     def _evaluate(self, buf: Buffer) -> bool:
         kind = self.props["compared_value"]
-        value = self._compared_value(buf)
+        value, approx = self._compared_value(buf)
         if kind == "custom":
             return bool(value)
         op = self.props["operator"]
         if op not in _OPERATORS:
             raise ElementError(f"{self.describe()}: unknown operator '{op}'")
         supplied = [parse_number(p) for p in str(self.props["supplied_value"]).split(":")]
+        if approx and op in ("eq", "ne"):
+            scale = max(1.0, abs(value), abs(float(supplied[0])))
+            equal = abs(value - float(supplied[0])) \
+                <= self._DEVICE_EQ_RTOL * scale
+            return equal if op == "eq" else not equal
         return _OPERATORS[op](value, supplied)
 
     # -- actions ------------------------------------------------------------

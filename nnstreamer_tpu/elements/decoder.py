@@ -32,6 +32,9 @@ _OPTION_DOCS = {
        "reference's golden fixtures, tests/test_reference_parity.py)",
     9: "decoder option #9 — for bounding_boxes, yolov8 tensor layout "
        "auto|boxes-first|coords-first",
+    10: "decoder option #10 — for bounding_boxes, device-path candidate "
+        "cap before NMS (default 256); a warning fires once when the cap "
+        "truncates above-threshold candidates",
 }
 
 

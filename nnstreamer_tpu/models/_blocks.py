@@ -26,10 +26,11 @@ def init_params(model, input_shape, seed: int = 0):
 
 
 def resolve_compute_dtype(compute_dtype: str) -> str:
-    """``auto`` → bfloat16 on TPU-class devices (MXU-native, half the HBM
-    reads), float32 anywhere else (XLA-CPU *emulates* bf16 — measured
-    2.7× slower than f32 for the zoo MobileNet on this rig's CPU
-    fallback). Explicit dtypes pass through."""
+    """``auto`` → bfloat16 on accelerators with native bf16 compute
+    (TPU: MXU-native; GPU: tensor-core bf16 since Ampere/ROCm CDNA —
+    half the HBM reads either way), float32 on CPU (XLA-CPU *emulates*
+    bf16 — measured 2.7× slower than f32 for the zoo MobileNet on this
+    rig's CPU fallback). Explicit dtypes pass through."""
     if compute_dtype != "auto":
         return compute_dtype
     import jax
@@ -46,7 +47,9 @@ def resolve_compute_dtype(compute_dtype: str) -> str:
         platform = jax.devices()[0].platform
     except Exception:  # backend raised (not hung): universal default
         return "float32"
-    return "bfloat16" if is_tpu_platform(platform) else "float32"
+    if is_tpu_platform(platform) or platform in ("gpu", "cuda", "rocm"):
+        return "bfloat16"
+    return "float32"
 
 
 def make_blocks(compute_dtype: str = "auto"):

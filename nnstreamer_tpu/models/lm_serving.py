@@ -287,6 +287,15 @@ class _LMServingEntry:
 
         return stream
 
+    def make_continuous(self, slots: int = 4, mesh=None):
+        """Continuous-batching decode state for the serving layer: a
+        fixed-``slots`` engine where sequences join/retire independently
+        between decode steps (``serving.DecodeScheduler`` drives it).
+        Params honor the entry's serve knobs (serve_dtype, cache_len)."""
+        from ..serving.lm_engine import from_entry
+
+        return from_entry(self, slots=slots, mesh=mesh)
+
     def make_session(self, mesh=None, temperature: float = 0.0):
         """Stateful multi-turn serving: ``session.generate(tokens, steps)``
         yields like the stream form but the KV cache persists across

@@ -62,6 +62,7 @@ class QueryServer:
         self._lock = threading.Lock()
         self._running = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
+        self._serve_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "QueryServer":
@@ -85,6 +86,75 @@ class QueryServer:
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2.0)
             self._accept_thread = None
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=2.0)
+            self._serve_thread = None
+
+    # -- serving-scheduler bridge -------------------------------------------
+    def attach_scheduler(self, scheduler, priority: int = 0,
+                         deadline_s: Optional[float] = None) -> None:
+        """Serve this server's inbox through a continuous-batching
+        :class:`~nnstreamer_tpu.serving.Scheduler` — N TCP clients each
+        sending batch-1 frames transparently share one coalesced device
+        batch (the serving-layer replacement for a serversrc→filter→
+        serversink sub-pipeline, which executes each client's frame as
+        its own invoke). Answers route back per ``client_id``; shed
+        requests answer with a typed ERROR message instead of silence.
+
+        Standalone-server mode only: the bridge consumes ``inbox``, so do
+        not combine with a ``tensor_query_serversrc`` on the same id.
+        """
+        if self._serve_thread is not None:
+            raise RuntimeError("a scheduler is already attached")
+        self.start()
+
+        def _error_reply(client_id: int, err: BaseException) -> None:
+            with self._lock:
+                conn = self._clients.get(client_id)
+            if conn is not None:
+                try:
+                    send_msg(conn, MsgType.ERROR,
+                             f"{type(err).__name__}: {err}".encode())
+                except OSError:
+                    pass
+
+        def _answer(client_id: int, req) -> None:
+            if req.error is not None:
+                _error_reply(client_id, req.error)
+                return
+            out = Buffer(list(req.result()))
+            out.meta["serving"] = dict(req.metrics)
+            self.send(client_id, out)
+
+        def _serve_loop() -> None:
+            from ..serving import AdmissionError, ServingError
+
+            while self._running.is_set():
+                try:
+                    item = self.inbox.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+                if isinstance(item, tuple):  # ("eos", client_id)
+                    continue
+                client_id = item.meta.get("client_id")
+                try:
+                    scheduler.submit(
+                        tuple(item.tensors), priority=priority,
+                        deadline_s=deadline_s,
+                        on_done=lambda req, cid=client_id: _answer(cid, req))
+                except AdmissionError:
+                    pass  # on_done already delivered the typed ERROR
+                except ServingError as err:
+                    # e.g. SchedulerClosedError: submit raises before a
+                    # Request exists so no on_done fires — answer here and
+                    # keep serving, so every later frame also gets the
+                    # typed ERROR instead of a dead thread's silence
+                    _error_reply(client_id, err)
+
+        self._serve_thread = threading.Thread(
+            target=_serve_loop, name=f"qserver:{self.port}:serve",
+            daemon=True)
+        self._serve_thread.start()
 
     # -- accept/read --------------------------------------------------------
     def _accept_loop(self) -> None:

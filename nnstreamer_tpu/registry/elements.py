@@ -49,6 +49,7 @@ _STANDARD_MODULES = (
     "nnstreamer_tpu.elements.trainer",
     "nnstreamer_tpu.elements.tee",
     "nnstreamer_tpu.elements.shard",
+    "nnstreamer_tpu.elements.serving",
     "nnstreamer_tpu.elements.mqtt",
     "nnstreamer_tpu.elements.iio",
     "nnstreamer_tpu.elements.media",

@@ -1,0 +1,153 @@
+"""Slot-based continuous-decode engine over the LM decoding primitives
+(L6 serving ← models/decoding.py).
+
+The batched-generation paths in ``models/lm_serving.py`` decode a FIXED
+batch: everyone prefills together, everyone steps together, the batch
+drains before the next one forms. Continuous batching needs per-slot
+independence — each sequence has its own position and lifetime — which
+this engine gets by **vmapping** :func:`models.decoding.decode_step` over
+a leading slot axis: one compiled program steps every slot, each against
+its own KV cache and position, exactly the math of S independent
+batch-1 decoders but issued as ONE device call per token.
+
+Join protocol (driven by ``DecodeScheduler``):
+
+* ``admit(slot, prompt, steps)`` — prefill the prompt in isolation
+  (batch-1 cache), then scatter the fresh cache into the slot axis of
+  the batched state (one jitted ``.at[slot].set`` per join). Prefill
+  compiles once per distinct prompt length — bucket prompt lengths
+  upstream if that matters for your traffic.
+* ``step()`` — one vmapped decode step over ALL slots. Inactive slots
+  compute garbage at position 0 (static shapes are the point); the
+  scheduler ignores their outputs and ``admit`` overwrites their state.
+* ``release(slot)`` — host bookkeeping only; device state is dead until
+  the next admit overwrites it.
+
+Greedy (argmax) decoding only — sampling policy belongs to the caller's
+model entry; the scheduler contract is deterministic token streams.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .request import ServingError
+
+
+class ContinuousLMEngine:
+    """Fixed-slot continuous decoder for a transformer config + params
+    (build via ``lm_serving._LMServingEntry.make_continuous``)."""
+
+    def __init__(self, cfg, params, slots: int = 4):
+        if slots < 1:
+            raise ValueError(f"slots={slots} must be >= 1")
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.decoding import decode_step, init_cache, prefill
+
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots
+        self.compile_count = 0
+        self._jnp = jnp
+
+        cache_dtype = params["embed"].dtype
+        proto = init_cache(cfg, 1, dtype=cache_dtype)
+        # batched state: every cache leaf gains a leading slot axis
+        self._cache = jax.tree_util.tree_map(
+            lambda a: jnp.zeros((slots, *a.shape), a.dtype), proto)
+        self._tok = np.zeros((slots, 1), np.int32)
+        self._pos = np.zeros((slots,), np.int32)
+        self._mask = np.zeros((slots,), bool)
+
+        def _prefill(p, tokens):
+            self.compile_count += 1  # trace-time only: once per prompt len
+            cache = init_cache(cfg, 1, dtype=cache_dtype)
+            logits, cache, pos = prefill(cfg, p, tokens, cache)
+            return (jnp.argmax(logits, -1).astype(jnp.int32), cache,
+                    pos.astype(jnp.int32))
+
+        self._prefill = jax.jit(_prefill)
+
+        def _one_step(p, token, pos, cache):
+            logits, cache = decode_step(cfg, p, token, pos, cache)
+            return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+        def _step(p, token, pos, cache):
+            self.compile_count += 1  # trace-time only: one step program
+            return jax.vmap(_one_step, in_axes=(None, 0, 0, 0))(
+                p, token, pos, cache)
+
+        # donate the batched cache: each step rewrites one position per
+        # slot in place — without donation every token holds two full
+        # slot-caches in device memory
+        self._step = functools.partial(
+            jax.jit(_step, donate_argnums=(3,)), params)
+
+        def _insert(state, new, slot):
+            self.compile_count += 1
+            return jax.tree_util.tree_map(
+                lambda s, n: s.at[slot].set(n), state, new)
+
+        self._insert = jax.jit(_insert, donate_argnums=(0,))
+
+    # -- scheduler contract --------------------------------------------------
+    def validate(self, tokens: np.ndarray, steps: int) -> None:
+        if tokens.ndim != 1 or tokens.size == 0:
+            raise ValueError(
+                f"prompt must be non-empty 1-D tokens, got {tokens.shape}")
+        if tokens.size + steps > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt ({tokens.size}) + steps ({steps}) exceeds "
+                f"max_seq {self.cfg.max_seq}")
+
+    def admit(self, slot: int, tokens: np.ndarray, steps: int) -> int:
+        if self._mask[slot]:
+            raise ServingError(f"slot {slot} already active")
+        tokens = np.asarray(tokens, np.int32)
+        self.validate(tokens, steps)
+        first, cache1, pos = self._prefill(self.params, tokens[None, :])
+        self._cache = self._insert(self._cache, cache1, slot)
+        self._tok[slot, 0] = int(first[0])
+        self._pos[slot] = int(pos)
+        self._mask[slot] = True
+        return int(first[0])
+
+    def step(self) -> np.ndarray:
+        """One decode step over every slot; returns (slots,) int32 (only
+        active-slot entries are meaningful)."""
+        jnp = self._jnp
+        tok_dev, self._cache = self._step(
+            jnp.asarray(self._tok), jnp.asarray(self._pos), self._cache)
+        tok = np.asarray(tok_dev)[:, 0]
+        self._pos = self._pos + self._mask.astype(np.int32)
+        self._tok[self._mask, 0] = tok[self._mask]
+        return tok
+
+    def release(self, slot: int) -> None:
+        self._mask[slot] = False
+        self._tok[slot, 0] = 0
+        self._pos[slot] = 0
+
+    # -- introspection --------------------------------------------------------
+    @property
+    def active_slots(self) -> int:
+        return int(self._mask.sum())
+
+
+def from_entry(entry, slots: int = 4,
+               mesh=None) -> "ContinuousLMEngine":
+    """Build an engine from an ``lm_serving`` entry (params initialized /
+    dtype-cast per the entry's serve knobs; ``mesh`` reserved for
+    sharded slot state — single-device only today)."""
+    if mesh is not None:
+        raise NotImplementedError(
+            "continuous decode is single-device today; shard the batch "
+            "with the whole-sequence lm_serving paths instead")
+    cfg = entry._cfg_serve
+    params, _ = entry._shard_params(None)
+    return ContinuousLMEngine(cfg, params, slots=slots)

@@ -1,0 +1,470 @@
+"""Continuous-batching schedulers (L6 serving).
+
+Two loops over the same admission/queue/bucketing machinery:
+
+* :class:`Scheduler` — one-shot models (classification, detection, any
+  ``tensor_filter``-style callable): requests coalesce into shape-bucketed
+  padded batches (``batcher.py``), one jitted call serves many clients.
+* :class:`DecodeScheduler` — iterative LM decode against a slot-based
+  engine (``lm_engine.py``): new requests JOIN the running batch between
+  decode steps (prefill into a free slot), finished sequences RETIRE
+  early and free their slot — the Hermes/Orca-style continuous batching
+  loop (arxiv 2409.04249).
+
+Both record per-request metrics (queue wait, batch id, bucket, device
+time, ttft, total) and register with ``serving.metrics_snapshot()``.
+
+The executor's **compile-count hook** makes the no-recompile-storm
+property testable: ``JitExecutor`` counts XLA traces (the counter lives
+in the traced function body, so it increments exactly once per
+signature), and steady-state same-bucket traffic must hold it at one.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.log import logger
+from .batcher import Batch, BatchFormer
+from .metrics import ServingMetrics, register_scheduler
+from .queue import RequestQueue
+from .request import (
+    AdmissionError,
+    Request,
+    SchedulerClosedError,
+    ServingError,
+)
+
+
+def _block_ready(outputs) -> None:
+    try:
+        import jax
+
+        jax.block_until_ready(outputs)
+    except (ImportError, TypeError):
+        pass  # numpy outputs (host-native executors) are already ready
+
+
+class JitExecutor:
+    """jit-wraps a jax-traceable callable and counts compiles: the
+    counter increments inside the traced body, which Python only executes
+    when XLA traces a NEW input signature — the compile-count hook the
+    bucketing tests assert against."""
+
+    def __init__(self, fn: Callable):
+        import jax
+
+        self.fn = fn
+        self.compiles = 0
+        self._jit = jax.jit(self._traced)
+
+    def _traced(self, *xs):
+        self.compiles += 1  # runs at trace time only, once per signature
+        out = self.fn(*xs)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    def __call__(self, *xs):
+        return self._jit(*xs)
+
+
+class BackendExecutor:
+    """Route batches through an opened FilterBackend (its own compile
+    cache applies — e.g. host-native programs that must not be traced)."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self.compiles = 0  # tracked by the backend, not here
+
+    def __call__(self, *xs):
+        return tuple(self.backend.invoke(list(xs)))
+
+
+class Scheduler:
+    """One-shot continuous batcher: ``submit()`` from any thread; a
+    single loop thread forms bucketed batches and executes them.
+
+    ``fn`` — jax-traceable callable batching over axis 0 (wrapped in a
+    :class:`JitExecutor`), or pass a prebuilt ``executor``.
+    """
+
+    def __init__(self, fn: Optional[Callable] = None, *,
+                 executor=None,
+                 bucket_sizes: Sequence[int] = (1, 2, 4, 8),
+                 max_wait_s: float = 0.005,
+                 idle_linger_s: float = 0.0005,
+                 max_depth: int = 256,
+                 predictive_shed: bool = True,
+                 name: str = "scheduler",
+                 autostart: bool = True,
+                 on_close: Optional[Callable[[], None]] = None):
+        if (fn is None) == (executor is None):
+            raise ValueError("pass exactly one of fn= or executor=")
+        self.executor = executor if executor is not None else JitExecutor(fn)
+        self.former = BatchFormer(bucket_sizes, max_wait_s,
+                                  idle_linger_s=idle_linger_s)
+        self.queue = RequestQueue(max_depth,
+                                  est_batch_rows=self.former.max_bucket,
+                                  predictive_shed=predictive_shed,
+                                  on_shed=self._on_queue_shed)
+        self.metrics = ServingMetrics()
+        self._on_close = on_close
+        self.name = register_scheduler(name, self)
+        self._running = threading.Event()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Scheduler":
+        if self._thread is not None:
+            return self
+        self._running.set()
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"serving:{self.name}",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _on_queue_shed(self, req: Request) -> None:
+        """A request's deadline expired while queued (shed at pop time —
+        queue.py already failed its future with the typed error)."""
+        self.metrics.record_shed(deadline=True)
+
+    def close(self) -> None:
+        """Stop the loop and fail everything still pending with
+        SchedulerClosedError (never silently dropped)."""
+        self._closed = True
+        self._running.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        err = SchedulerClosedError(f"scheduler {self.name} closed")
+        for req in self.queue.drain() + self.former.drain():
+            req.fail(err)
+            self.metrics.record_request_done(req, failed=True)
+        if self._on_close is not None:
+            self._on_close()
+            self._on_close = None
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, tensors: Sequence, priority: int = 0,
+               deadline_s: Optional[float] = None,
+               on_done: Optional[Callable[[Request], None]] = None
+               ) -> Request:
+        """Admit a request (tensors batch over axis 0; a lower priority
+        number schedules sooner; ``deadline_s`` is a relative latency
+        budget). Raises a typed :class:`AdmissionError` when shed —
+        admission control happens HERE, synchronously, so a saturated
+        server pushes back instead of buffering unboundedly."""
+        if self._closed:
+            raise SchedulerClosedError(f"scheduler {self.name} is closed")
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        req = Request(tensors, priority=priority, deadline=deadline,
+                      on_done=on_done)
+        self.metrics.record_submit()
+        try:
+            self.queue.put(req)
+        except AdmissionError as e:
+            from .request import DeadlineExceededError
+
+            self.metrics.record_shed(
+                deadline=isinstance(e, DeadlineExceededError))
+            raise
+        self._fail_if_closed_after_put(req)
+        return req
+
+    def _fail_if_closed_after_put(self, req: Request) -> None:
+        """close() may have drained the queue between our _closed check
+        and queue.put — the request would strand forever. Re-check and
+        drain again: if close ran, everything just enqueued (ours
+        included) gets the same typed error close() gives."""
+        if not self._closed:
+            return
+        err = SchedulerClosedError(f"scheduler {self.name} closed")
+        stranded = self.queue.drain()
+        for r in stranded:
+            r.fail(err)
+            self.metrics.record_request_done(r, failed=True)
+        if req in stranded:
+            raise err
+
+    def __call__(self, tensors: Sequence, **kw) -> Tuple:
+        """Convenience: submit and block for the result."""
+        timeout = kw.pop("timeout", 60.0)
+        return self.submit(tensors, **kw).result(timeout)
+
+    @property
+    def compile_count(self) -> int:
+        """XLA compiles the executor has performed (the no-recompile
+        assertion hook; meaningful for JitExecutor)."""
+        return self.executor.compiles
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["queue_depth"] = self.queue.depth()
+        snap["estimated_wait_ms"] = self.queue.estimated_wait_s() * 1e3
+        snap["compile_count"] = self.compile_count
+        return snap
+
+    # -- loop ---------------------------------------------------------------
+    def _loop(self) -> None:
+        while self._running.is_set():
+            flush_in = self.former.next_flush_in()
+            timeout = 0.05 if flush_in is None else min(flush_in, 0.05)
+            req = self.queue.get(timeout=timeout)
+            if req is not None:
+                self.former.add(req)
+                # bulk-drain the backlog — one loop pass forms the
+                # largest batch it allows, one lock acquisition for the
+                # whole drain instead of one per queued request
+                short = self.former.max_bucket - self.former.pending_rows()
+                if short > 0:
+                    for more in self.queue.pop_upto(short):
+                        self.former.add(more)
+            for batch in self.former.take_ready(
+                    idle=self.queue.depth() == 0):
+                self._execute(batch)
+
+    def _execute(self, batch: Batch) -> None:
+        t_start = time.monotonic()
+        for r in batch.requests:
+            r.metrics["queue_wait_s"] = t_start - r.metrics["enqueue_time"]
+            r.metrics["batch_id"] = batch.id
+            r.metrics["bucket"] = batch.padded_rows
+        try:
+            inputs = batch.stacked_tensors()
+            outputs = self.executor(*inputs)
+            _block_ready(outputs)
+        except Exception as e:  # noqa: BLE001 - must fail futures, not the loop
+            err = e if isinstance(e, ServingError) else ServingError(
+                f"batch {batch.id} execution failed: {e}")
+            logger.exception("serving %s: batch %d failed", self.name,
+                             batch.id)
+            for r in batch.requests:
+                r.fail(err)
+                self.metrics.record_request_done(r, failed=True)
+            return
+        device_s = time.monotonic() - t_start
+        self.queue.observe_service_time(device_s)
+        self.metrics.record_batch(batch.rows, batch.padded_rows, device_s)
+        from ..utils import trace as _trace
+
+        if _trace.ACTIVE:
+            _trace.notify_serving(
+                "batch", self.name, t_start, device_s,
+                {"batch_id": batch.id, "rows": batch.rows,
+                 "bucket": batch.padded_rows})
+        now = time.monotonic()
+        for r, outs in zip(batch.requests, batch.split_outputs(outputs)):
+            r.metrics["device_time_s"] = device_s
+            r.metrics["ttft_s"] = now - r.metrics["enqueue_time"]
+            r.complete(outs)
+            self.metrics.record_request_done(r)
+        # these clients just got results — closed-loop traffic resubmits
+        # within the next max-wait window, so hold the idle-boundary
+        # flush until that many rows land (or the window lapses) rather
+        # than fragmenting the incoming burst into batch-of-1 flushes
+        self.former.expect(batch.rows, self.former.max_wait_s)
+
+
+class DecodeScheduler:
+    """Continuous-batching loop for iterative decode: a fixed-slot engine
+    steps ALL active sequences in one compiled call; requests join
+    between steps (prefill into a free slot) and retire the moment they
+    finish (max steps or ``eos_id``), freeing the slot for the next
+    queued request — no drain barrier between batches.
+
+    The engine contract (``lm_engine.ContinuousLMEngine`` implements it):
+
+    * ``slots`` — fixed batch capacity;
+    * ``admit(slot, tokens, steps) -> int`` — prefill; returns the first
+      generated token;
+    * ``step() -> np.ndarray (slots,)`` — one decode step over every
+      slot (inactive slots compute garbage; the loop ignores them);
+    * ``release(slot)`` — slot freed (optional);
+    * ``compile_count`` — optional compile hook.
+    """
+
+    def __init__(self, engine, *,
+                 max_depth: int = 256,
+                 predictive_shed: bool = True,
+                 name: str = "decode",
+                 autostart: bool = True):
+        self.engine = engine
+        self.queue = RequestQueue(max_depth, est_batch_rows=engine.slots,
+                                  predictive_shed=predictive_shed,
+                                  on_shed=self._on_queue_shed)
+        self.metrics = ServingMetrics()
+        self.name = register_scheduler(name, self)
+        self._active: Dict[int, Request] = {}
+        self._free: List[int] = list(range(engine.slots))[::-1]
+        self._running = threading.Event()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "DecodeScheduler":
+        if self._thread is not None:
+            return self
+        self._running.set()
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"serving:{self.name}",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closed = True
+        self._running.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        err = SchedulerClosedError(f"scheduler {self.name} closed")
+        for req in list(self._active.values()) + self.queue.drain():
+            req.fail(err)
+            self.metrics.record_request_done(req, failed=True)
+        self._active.clear()
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, tokens, steps: int, priority: int = 0,
+               deadline_s: Optional[float] = None,
+               eos_id: Optional[int] = None,
+               on_done: Optional[Callable[[Request], None]] = None
+               ) -> Request:
+        """Queue a prompt (1-D int32) for up to ``steps`` generated
+        tokens (fewer when ``eos_id`` appears). The result tuple holds
+        one (n,) int32 array of generated tokens."""
+        if self._closed:
+            raise SchedulerClosedError(f"scheduler {self.name} is closed")
+        if steps < 1:
+            raise ValueError(f"steps={steps} must be >= 1")
+        tokens = np.asarray(tokens)
+        if tokens.ndim != 1:
+            raise ValueError(
+                f"decode prompt must be 1-D tokens, got shape {tokens.shape}")
+        validate = getattr(self.engine, "validate", None)
+        if validate is not None:
+            validate(tokens, steps)  # fail fast (e.g. prompt+steps > max_seq)
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        req = Request((tokens,), priority=priority, deadline=deadline,
+                      steps=steps, eos_id=eos_id, on_done=on_done)
+        self.metrics.record_submit()
+        try:
+            self.queue.put(req)
+        except AdmissionError as e:
+            from .request import DeadlineExceededError
+
+            self.metrics.record_shed(
+                deadline=isinstance(e, DeadlineExceededError))
+            raise
+        self._fail_if_closed_after_put(req)
+        return req
+
+    _on_queue_shed = Scheduler._on_queue_shed
+    _fail_if_closed_after_put = Scheduler._fail_if_closed_after_put
+
+    @property
+    def compile_count(self) -> int:
+        return getattr(self.engine, "compile_count", 0)
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["queue_depth"] = self.queue.depth()
+        snap["estimated_wait_ms"] = self.queue.estimated_wait_s() * 1e3
+        snap["active_slots"] = len(self._active)
+        snap["slots"] = self.engine.slots
+        snap["compile_count"] = self.compile_count
+        return snap
+
+    # -- loop ---------------------------------------------------------------
+    def _admit_one(self, req: Request) -> None:
+        slot = self._free.pop()
+        t0 = time.monotonic()
+        req.metrics["queue_wait_s"] = t0 - req.metrics["enqueue_time"]
+        try:
+            first = int(self.engine.admit(slot, req.tensors[0], req.steps))
+        except Exception as e:  # noqa: BLE001 - engine rejected this prompt
+            self._free.append(slot)
+            req.fail(e if isinstance(e, ServingError)
+                     else ServingError(f"decode admit failed: {e}"))
+            self.metrics.record_request_done(req, failed=True)
+            return
+        now = time.monotonic()
+        req.metrics["slot"] = slot
+        req.metrics["ttft_s"] = now - req.metrics["enqueue_time"]
+        req.metrics["prefill_s"] = now - t0
+        req.tokens.append(first)
+        if self._finished(req, first):
+            self._retire(slot, req, early=False)
+        else:
+            self._active[slot] = req
+
+    def _finished(self, req: Request, last_token: int) -> bool:
+        if len(req.tokens) >= req.steps:
+            return True
+        return req.eos_id is not None and last_token == req.eos_id
+
+    def _retire(self, slot: int, req: Request, early: bool) -> None:
+        self._active.pop(slot, None)
+        release = getattr(self.engine, "release", None)
+        if release is not None:
+            release(slot)
+        self._free.append(slot)
+        if early:
+            self.metrics.record_early_retire()
+        req.metrics["decode_steps"] = len(req.tokens)
+        req.complete((np.asarray(req.tokens, np.int32),))
+        self.metrics.record_request_done(req)
+
+    def _loop(self) -> None:
+        while self._running.is_set():
+            # JOIN: fill free slots from the queue between decode steps —
+            # block only when the whole batch is idle
+            while self._free:
+                req = self.queue.get(
+                    timeout=0 if self._active else 0.05)
+                if req is None:
+                    break
+                self._admit_one(req)
+            if not self._active:
+                continue
+            t0 = time.monotonic()
+            try:
+                toks = np.asarray(self.engine.step())
+            except Exception as e:  # noqa: BLE001 - fail the batch, keep serving
+                err = ServingError(f"decode step failed: {e}")
+                logger.exception("serving %s: decode step failed", self.name)
+                for slot, req in list(self._active.items()):
+                    req.fail(err)
+                    self.metrics.record_request_done(req, failed=True)
+                    self._retire_slot_only(slot)
+                continue
+            device_s = time.monotonic() - t0
+            self.queue.observe_service_time(device_s)
+            self.metrics.record_decode_step(len(self._active),
+                                            self.engine.slots, device_s)
+            for slot, req in list(self._active.items()):
+                tok = int(toks[slot])
+                req.tokens.append(tok)
+                req.metrics["device_time_s"] = \
+                    req.metrics.get("device_time_s", 0.0) + device_s
+                if self._finished(req, tok):
+                    # RETIRE early: the slot frees this step, not at the
+                    # end of the longest sequence in the batch
+                    self._retire(slot, req,
+                                 early=len(req.tokens) < req.steps)
+
+    def _retire_slot_only(self, slot: int) -> None:
+        self._active.pop(slot, None)
+        release = getattr(self.engine, "release", None)
+        if release is not None:
+            release(slot)
+        self._free.append(slot)

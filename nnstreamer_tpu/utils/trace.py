@@ -40,6 +40,11 @@ class Tracer:
         """Called after a pad push completed; elapsed covers the downstream
         element's chain work (inline dataflow)."""
 
+    def serving_event(self, kind: str, name: str, start_s: float,
+                      dur_s: float, meta: dict) -> None:
+        """Called per serving-scheduler batch/step (serving/scheduler.py)
+        so coalesced device batches show up next to element spans."""
+
     def results(self) -> dict:
         return {}
 
@@ -192,6 +197,23 @@ class ChromeTraceTracer(Tracer):
             "tid": threading.get_ident(),
         })
 
+    def serving_event(self, kind: str, name: str, start_s: float,
+                      dur_s: float, meta: dict) -> None:
+        if self._saved or len(self._events) >= self.MAX_EVENTS:
+            return
+        self._events.append({
+            "name": f"{kind}:{name}",
+            "cat": "serving",
+            "ph": "X",
+            # emitted immediately after the batch completes: now - dur
+            # places the span on the same timeline as element spans
+            "ts": (time.perf_counter() - self._t0 - dur_s) * 1e6,
+            "dur": dur_s * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": meta,
+        })
+
     def save(self) -> Optional[str]:
         if self._saved or not self._events:
             return None
@@ -277,6 +299,18 @@ def notify_flow(pad, buf, elapsed_s: float) -> None:
         try:
             t.buffer_flow(pad, buf, elapsed_s)
         except Exception:  # noqa: BLE001 - tracers must never kill dataflow
+            pass
+
+
+def notify_serving(kind: str, name: str, start_s: float, dur_s: float,
+                   meta: dict) -> None:
+    """Serving-scheduler fan-out (only called when ACTIVE): batch/step
+    spans from serving/scheduler.py reach the same tracer set as pad
+    flows."""
+    for t in _tracers:
+        try:
+            t.serving_event(kind, name, start_s, dur_s, meta)
+        except Exception:  # noqa: BLE001 - tracers must never kill serving
             pass
 
 

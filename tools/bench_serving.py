@@ -1,0 +1,157 @@
+"""Serving-scheduler microbench: offered-load sweep, coalesced vs
+sequential batch-1.
+
+The subsystem's reason to exist (docs/serving.md): N clients each
+sending batch-1 requests should NOT execute as N batch-1 device calls.
+This sweeps offered load (closed-loop concurrent submitters) through a
+continuous-batching :class:`~nnstreamer_tpu.serving.Scheduler` and
+prints throughput / p50 / p99 / shed-rate per load point, plus the
+headline ratio vs one client submitting batch-1 requests back-to-back.
+
+Usage: JAX_PLATFORMS=cpu python tools/bench_serving.py [n_requests]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from nnstreamer_tpu.serving import AdmissionError, Scheduler  # noqa: E402
+
+DIM = 256          # model width: stacked tanh matmuls, enough work
+LAYERS = 4         # that a batch is compute, not pure dispatch overhead
+BUCKETS = (1, 2, 4, 8)
+MAX_WAIT_S = 0.002
+DEADLINE_S = 2.0   # generous budget; sheds appear only under overload
+
+# a closed-loop swarm of pure-Python submitters starves the scheduler
+# loop of the GIL for whole 5ms scheduling quanta (default
+# sys.getswitchinterval) — tighten it so batch formation isn't gated on
+# worker-thread timeslices. Bench-process only; servers embedding the
+# scheduler run few Python threads per process.
+sys.setswitchinterval(0.001)
+
+
+def make_model():
+    rng = np.random.default_rng(0)
+    ws = [rng.standard_normal((DIM, DIM)).astype(np.float32) / np.sqrt(DIM)
+          for _ in range(LAYERS)]
+
+    def fn(x):
+        for w in ws:
+            x = jax.numpy.tanh(x @ w)
+        return (x,)
+    return fn
+
+
+def make_sched(name: str, buckets=BUCKETS) -> Scheduler:
+    sched = Scheduler(make_model(), bucket_sizes=buckets,
+                      max_wait_s=MAX_WAIT_S, max_depth=1024, name=name)
+    # warm every bucket signature so the sweep times serving, not XLA
+    for b in buckets:
+        sched.submit((np.zeros((b, DIM), np.float32),)).result(120)
+    return sched
+
+
+def run_load(sched: Scheduler, concurrency: int, n_requests: int):
+    """Closed-loop: ``concurrency`` submitters, each waiting for its
+    result before sending the next batch-1 request."""
+    per_worker = n_requests // concurrency
+    latencies: list = [[] for _ in range(concurrency)]
+    shed = [0] * concurrency
+
+    def worker(w: int) -> None:
+        x = np.ones((1, DIM), np.float32)
+        for _ in range(per_worker):
+            t0 = time.perf_counter()
+            try:
+                sched.submit((x,), deadline_s=DEADLINE_S).result(120)
+            except AdmissionError:
+                shed[w] += 1
+                continue
+            latencies[w].append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    done = sorted(lat for per in latencies for lat in per)
+    n_shed = sum(shed)
+
+    def pct(q):
+        if not done:
+            return 0.0
+        return done[min(len(done) - 1,
+                        int(round(q / 100.0 * (len(done) - 1))))] * 1e3
+    return {
+        "throughput": len(done) / wall,
+        "p50_ms": pct(50),
+        "p99_ms": pct(99),
+        "shed_rate": n_shed / (len(done) + n_shed) if n_shed else 0.0,
+    }
+
+
+PASSES = 2  # best-of-N per point: filters OS-scheduler hiccups, which
+            # at ~100ms per point otherwise dominate a whole load level
+
+
+def best_of(sched_factory, concurrency: int, n_requests: int):
+    best = None
+    for _ in range(PASSES):
+        sched = sched_factory()
+        r = run_load(sched, concurrency, n_requests)
+        r["snapshot"] = sched.metrics_snapshot()
+        sched.close()
+        if best is None or r["throughput"] > best["throughput"]:
+            best = r
+    return best
+
+
+def main() -> None:
+    n_requests = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    print(f"model: {LAYERS}x tanh({DIM}x{DIM}) matmul | buckets="
+          f"{','.join(map(str, BUCKETS))} max_wait={MAX_WAIT_S * 1e3:g}ms "
+          f"| {n_requests} batch-1 requests per point, best of {PASSES}")
+
+    # baseline: ONE client, batch-1, back-to-back through the same
+    # serving path (bucket 1 only — nothing to coalesce with)
+    seq = best_of(lambda: make_sched("bench-seq", buckets=(1,)),
+                  concurrency=1, n_requests=n_requests)
+    print(f"\nsequential batch-1 baseline: {seq['throughput']:8.1f} req/s  "
+          f"p50 {seq['p50_ms']:6.2f}ms  p99 {seq['p99_ms']:6.2f}ms")
+
+    print(f"\n{'offered':>8} {'req/s':>9} {'p50 ms':>8} {'p99 ms':>8} "
+          f"{'shed %':>7} {'occup':>6} {'batches':>8} {'vs seq':>7}")
+    best = 0.0
+    for concurrency in (1, 2, 4, 8, 16):
+        r = best_of(lambda: make_sched(f"bench-c{concurrency}"),
+                    concurrency, n_requests)
+        snap = r["snapshot"]
+        ratio = r["throughput"] / seq["throughput"]
+        if concurrency >= max(BUCKETS):
+            best = max(best, ratio)
+        print(f"{concurrency:>8} {r['throughput']:>9.1f} {r['p50_ms']:>8.2f} "
+              f"{r['p99_ms']:>8.2f} {r['shed_rate'] * 100:>7.2f} "
+              f"{snap['batch_occupancy']:>6.2f} {snap['batches']:>8} "
+              f"{ratio:>6.2f}x")
+
+    print(f"\ncoalesced vs sequential at offered load >= bucket "
+          f"{max(BUCKETS)}: {best:.2f}x"
+          + ("  [OK >= 2x]" if best >= 2.0 else "  [BELOW 2x TARGET]"))
+
+
+if __name__ == "__main__":
+    main()
