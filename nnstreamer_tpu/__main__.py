@@ -11,6 +11,8 @@ launch conversion), ``tools/development/nnstreamerCodeGenCustomFilter.py``
     python -m nnstreamer_tpu convert pipe.json      # description -> launch
     python -m nnstreamer_tpu convert "a ! b"        # launch -> description
     python -m nnstreamer_tpu codegen filter my_filter.py
+    python -m nnstreamer_tpu lint "a ! b"           # static pipeline lint
+    python -m nnstreamer_tpu lint --strict nnstreamer_tpu/  # source lint
 """
 from __future__ import annotations
 
@@ -65,9 +67,9 @@ def _cmd_inspect(args) -> int:
         print(f"    sink  {t.name_template}: {t.caps}")
     for t in cls.SRC_TEMPLATES:
         print(f"    src   {t.name_template}: {t.caps}")
-    merged = {}
-    for klass in reversed(cls.__mro__):  # same merge as Element.__init__
-        merged.update(getattr(klass, "PROPERTIES", {}) or {})
+    from .registry.elements import merged_properties
+
+    merged = merged_properties(cls)
     if merged:
         print("  properties:")
         for k, p in merged.items():
@@ -211,6 +213,13 @@ def main(argv=None) -> int:
     p.add_argument("kind", choices=sorted(_SKELETONS))
     p.add_argument("output", help="output .py path")
     p.set_defaults(fn=_cmd_codegen)
+
+    p = sub.add_parser("lint", help="static pipeline-graph / source lint "
+                                    "(see docs/lint.md)")
+    from .analysis.cli import add_lint_args, run_lint
+
+    add_lint_args(p)
+    p.set_defaults(fn=run_lint)
 
     args = ap.parse_args(argv)
     return args.fn(args)

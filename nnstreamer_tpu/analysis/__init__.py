@@ -1,0 +1,36 @@
+"""Static analysis: pipeline-graph validation + source hot-path linting (L7).
+
+Own design (no reference analog — the reference validates pipelines only at
+runtime, during caps negotiation). Two passes share one diagnostic model:
+
+* **graph lint** (`lint_pipeline` / `lint_launch` / `lint_pbtxt`, rules
+  ``NNL0xx``): validates a parsed-but-not-started :class:`Pipeline` —
+  abstract caps/shape/dtype propagation over every pad link, topology
+  checks (cycles, dangling pads, unreachable elements, tee/mux arity),
+  registry cross-checks (unknown elements/properties with did-you-mean),
+  and perf-hazard rules (flexible streams feeding a jitted
+  ``tensor_filter``, serving bucket sets that can't cover declared input
+  rows, device→host→device round-trips);
+* **source lint** (`lint_source`, rules ``NNL1xx``): AST checks over our
+  own tree — host syncs and scalar pulls in element/scheduler hot loops,
+  bare/silent excepts in chain paths, blocking calls in batch-formation
+  sections, Python branching on tracer parameters in jitted functions.
+
+CLI: ``python -m nnstreamer_tpu lint <pbtxt | launch-string | pkg>``
+(also ``tools/nnlint.py`` — the self-lint CI gate). Intentional findings
+are suppressed in-source with ``# nnlint: disable=NNL1xx`` pragmas.
+See docs/lint.md for the rule catalog.
+"""
+from .diagnostics import RULES, Diagnostic, Severity  # noqa: F401
+from .graph_lint import lint_launch, lint_pbtxt, lint_pipeline  # noqa: F401
+from .source_lint import lint_source  # noqa: F401
+
+__all__ = [
+    "RULES",
+    "Diagnostic",
+    "Severity",
+    "lint_launch",
+    "lint_pbtxt",
+    "lint_pipeline",
+    "lint_source",
+]

@@ -1,0 +1,105 @@
+"""`nnlint` CLI: ``python -m nnstreamer_tpu lint <pbtxt | launch | pkg>``.
+
+Target dispatch (per positional argument):
+
+* a directory or ``.py`` file → source lint (pass 2);
+* ``*.pbtxt``          → pbtxt topology → graph lint;
+* ``*.launch``         → launch text file → graph lint;
+* ``*.json``           → pipeline description file → graph lint;
+* anything else        → treated as a launch string → graph lint.
+
+Exit code: 0 clean (or warnings without ``--strict``); 1 when errors are
+found — or, under ``--strict``, when anything at all is found. The
+self-lint CI gate is ``python tools/nnlint.py`` (strict over our tree).
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from .diagnostics import RULES, Diagnostic
+
+
+def add_lint_args(parser) -> None:
+    parser.add_argument(
+        "targets", nargs="*",
+        help="launch string, .pbtxt/.launch/.json file, .py file, or "
+             "package directory (none = strict self-lint of the "
+             "nnstreamer_tpu tree, the CI gate)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit non-zero on ANY finding (CI gate); "
+                             "default fails only on errors")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON array")
+    parser.add_argument("--rules", action="store_true", dest="list_rules",
+                        help="print the rule catalog and exit")
+
+
+def _lint_target(target: str) -> List[Diagnostic]:
+    from .graph_lint import lint_launch, lint_pbtxt
+    from .source_lint import lint_source
+
+    from .diagnostics import make
+
+    p = Path(target)
+    if p.is_dir() or p.suffix == ".py":
+        return lint_source([p], root=str(p.parent))
+    if p.suffix in (".pbtxt", ".launch", ".json"):
+        try:
+            text = p.read_text()
+        except OSError as e:
+            return [make("NNL012", f"cannot read '{target}': {e}",
+                         location=target)]
+        if p.suffix == ".pbtxt":
+            return lint_pbtxt(text)
+        if p.suffix == ".json":
+            from ..runtime.describe import description_to_launch
+
+            try:
+                return lint_launch(description_to_launch(json.loads(text)))
+            except (ValueError, KeyError, TypeError, AttributeError) as e:
+                return [make("NNL012", f"bad pipeline description "
+                             f"'{target}': {e}", location=target)]
+        return lint_launch(text.strip())
+    return lint_launch(target)
+
+
+def run_lint(args) -> int:
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id}  {rule.severity.value:7s} {rule.title}")
+            print(f"    {rule.rationale}")
+        return 0
+    if not args.targets:
+        # no target = the self-lint gate: strict source lint of our tree
+        pkg = Path(__file__).resolve().parent.parent
+        args.targets = [str(pkg)]
+        args.strict = True
+    diags: List[Diagnostic] = []
+    for target in args.targets:
+        diags.extend(_lint_target(target))
+    if args.as_json:
+        print(json.dumps([d.to_dict() for d in diags], indent=2))
+    else:
+        for d in diags:
+            print(d.format())
+        n_err = sum(1 for d in diags if d.is_error)
+        n_warn = len(diags) - n_err
+        print(f"lint: {n_err} error(s), {n_warn} warning(s)")
+    if any(d.is_error for d in diags):
+        return 1
+    if args.strict and diags:
+        return 1
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Standalone entry (tools/nnlint.py)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="nnlint", description="nnstreamer_tpu static analyzer")
+    add_lint_args(ap)
+    return run_lint(ap.parse_args(argv))

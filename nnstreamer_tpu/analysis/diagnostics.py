@@ -1,0 +1,160 @@
+"""Diagnostic model and rule catalog shared by both lint passes.
+
+Every finding is a :class:`Diagnostic` carrying a stable rule ID
+(``NNL0xx`` graph rules, ``NNL1xx`` source rules), a severity, a
+human-readable message, and a location (element/pad name for graph
+findings, ``file:line:col`` span for source findings). The catalog in
+:data:`RULES` is the single source of truth — docs/lint.md and the CLI's
+``--rules`` listing are generated from it.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+class Severity(enum.Enum):
+    ERROR = "error"      # the pipeline cannot work / the code is wrong
+    WARNING = "warning"  # works, but a perf or robustness hazard
+
+    def __str__(self) -> str:
+        return self.value
+
+
+@dataclass(frozen=True)
+class Rule:
+    """Catalog entry for one lint rule."""
+
+    id: str
+    severity: Severity
+    title: str
+    rationale: str
+
+
+# ---------------------------------------------------------------------------
+# Rule catalog. IDs are STABLE — tests, pragmas, and CI gates reference
+# them; never renumber, only append.
+# ---------------------------------------------------------------------------
+_RULES = (
+    # -- graph lint (pass 1) ------------------------------------------------
+    Rule("NNL001", Severity.ERROR, "unknown element",
+         "the launch string names an element factory the registry does not "
+         "know; the message carries a did-you-mean suggestion"),
+    Rule("NNL002", Severity.ERROR, "unknown property",
+         "a property key is not declared by the element class (checked "
+         "against the MRO-merged PROPERTIES table and PROP_ALIASES)"),
+    Rule("NNL003", Severity.ERROR, "caps mismatch",
+         "abstract caps propagation found a pad link whose upstream "
+         "shape/dtype/media estimate cannot intersect the downstream "
+         "constraint — runtime negotiation would fail after devices are "
+         "grabbed and jit has compiled"),
+    Rule("NNL004", Severity.WARNING, "dangling pad",
+         "an always-present pad is unlinked: a sink pad that will never "
+         "receive data, or a src pad whose buffers are silently dropped"),
+    Rule("NNL005", Severity.ERROR, "graph cycle",
+         "the element graph contains a directed cycle; data flow would "
+         "recurse forever (feedback loops belong in tensor_repo pairs)"),
+    Rule("NNL006", Severity.WARNING, "unreachable element",
+         "no path from any source element reaches this element — it will "
+         "never see a buffer"),
+    Rule("NNL007", Severity.WARNING, "fan arity",
+         "a tee with fewer than two branches or an N-input combiner "
+         "(mux/merge) with fewer than two linked inputs is a no-op or a "
+         "stalled graph"),
+    Rule("NNL008", Severity.WARNING, "recompile storm",
+         "a flexible-shaped (dynamic) stream feeds a jitted tensor_filter "
+         "without invoke-dynamic: every new shape forces an XLA recompile "
+         "in the hot loop"),
+    Rule("NNL009", Severity.WARNING, "bucket coverage",
+         "a tensor_serving element's bucket set cannot cover the declared "
+         "input rows — every buffer overflows the largest bucket and pads "
+         "to a multiple of it"),
+    Rule("NNL010", Severity.WARNING, "host round-trip",
+         "a host-only element sits between device elements: buffers leave "
+         "the accelerator, are processed on host, and are shipped back — "
+         "a device→host→device sync in the steady-state path"),
+    Rule("NNL011", Severity.WARNING, "incomplete pipeline",
+         "the pipeline has no source or no sink element; it can play but "
+         "will never produce or consume data"),
+    Rule("NNL012", Severity.ERROR, "parse/construction failure",
+         "the launch string does not parse, or an element constructor "
+         "rejected its configuration"),
+    # -- source lint (pass 2) -----------------------------------------------
+    Rule("NNL100", Severity.ERROR, "unlintable source file",
+         "a file handed to the source lint cannot be read or parsed "
+         "(syntax error, missing file) — nothing in it was checked"),
+    Rule("NNL101", Severity.WARNING, "host sync in hot path",
+         "an explicit device→host synchronization (block_until_ready, "
+         "jax.device_get, np.asarray in scheduler loops) inside an "
+         "element/scheduler hot function stalls the dispatch pipeline"),
+    Rule("NNL102", Severity.WARNING, "scalar pull in hot path",
+         "float()/int()/bool() on a non-constant value inside a "
+         "device-affinity element's hot function forces a blocking "
+         "device→host transfer of one scalar per call"),
+    Rule("NNL103", Severity.ERROR, "bare except",
+         "a bare `except:` catches SystemExit/KeyboardInterrupt and hides "
+         "the error type; catch a concrete exception class"),
+    Rule("NNL104", Severity.WARNING, "silent exception swallow",
+         "a broad `except Exception` whose handler is only pass/continue "
+         "inside a hot function drops errors on the floor — the stream "
+         "corrupts silently instead of posting a pipeline ERROR"),
+    Rule("NNL105", Severity.WARNING, "blocking call in batch formation",
+         "blocking I/O, time.sleep, or lock acquisition inside a serving "
+         "batch-formation section adds tail latency to every request in "
+         "the forming batch"),
+    Rule("NNL106", Severity.WARNING, "python branch on tracer",
+         "a function handed to jax.jit branches (if/while) on a parameter "
+         "value: under trace the parameter is a tracer and the branch "
+         "either fails or silently bakes in one path"),
+)
+
+RULES: Dict[str, Rule] = {r.id: r for r in _RULES}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One lint finding."""
+
+    rule: str
+    severity: Severity
+    message: str
+    location: str = ""            # element/pad name or file path
+    line: Optional[int] = None    # 1-based source line (source lint)
+    col: Optional[int] = None     # 0-based column (source lint)
+    hint: str = ""                # optional fix suggestion
+
+    @property
+    def is_error(self) -> bool:
+        return self.severity is Severity.ERROR
+
+    def span(self) -> str:
+        if self.line is None:
+            return self.location
+        col = f":{self.col}" if self.col is not None else ""
+        return f"{self.location}:{self.line}{col}"
+
+    def format(self) -> str:
+        loc = self.span()
+        hint = f" ({self.hint})" if self.hint else ""
+        where = f" [{loc}]" if loc else ""
+        return f"{self.rule} {self.severity}: {self.message}{hint}{where}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": str(self.severity),
+            "message": self.message,
+            "location": self.location,
+            "line": self.line,
+            "col": self.col,
+            "hint": self.hint,
+        }
+
+
+def make(rule_id: str, message: str, *, location: str = "",
+         line: Optional[int] = None, col: Optional[int] = None,
+         hint: str = "") -> Diagnostic:
+    """Build a Diagnostic with the catalog's severity for ``rule_id``."""
+    return Diagnostic(rule_id, RULES[rule_id].severity, message,
+                      location=location, line=line, col=col, hint=hint)

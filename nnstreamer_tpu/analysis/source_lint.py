@@ -1,0 +1,504 @@
+"""Pass 2: AST-based hot-path linting of our own tree (rules NNL1xx).
+
+The checks encode the perf discipline the rest of the codebase is built
+around: element ``chain``/``transform`` bodies and the serving
+scheduler's batch loop are THE steady-state hot paths — a stray
+``block_until_ready`` or a silent ``except`` there costs every buffer of
+every stream. Scoping is structural, not name-matching on the whole
+tree:
+
+* files under ``elements/`` (and the runtime pad/element substrate) get
+  the element hot set (``chain``/``transform``/``render``/``create``);
+* files under ``serving/`` get the scheduler hot set (``_loop``/
+  ``_execute``/``step``/``take_ready``/...);
+* helpers *called from* a hot function in the same module are hot too
+  (one level — e.g. ``_block_ready`` called from ``Scheduler._execute``).
+
+Intentional sites (a sampled latency probe, the decode loop's one
+designed host pull) are annotated in-source with
+``# nnlint: disable=NNL1xx`` pragmas on the offending line (or the line
+above), which keeps the self-lint gate at zero findings without blinding
+the rule.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .diagnostics import Diagnostic, make
+
+# hot function names per scope (see module docstring)
+ELEMENT_HOT = {"chain", "transform", "render", "create", "_task",
+               "_chain_guarded", "push"}
+SERVING_HOT = {"_loop", "_execute", "_admit_one", "step", "take_ready",
+               "add", "_form", "next_flush_in"}
+
+# NNL101 — calls that synchronize device → host
+_SYNC_METHODS = {"block_until_ready"}
+_SYNC_DOTTED = {"jax.block_until_ready", "jax.device_get"}
+# additionally flagged inside serving/runtime hot paths, where arrays in
+# flight are device-resident by design
+_SYNC_DOTTED_SERVING = {"np.asarray", "np.array", "numpy.asarray",
+                        "numpy.array"}
+
+# NNL105 — blocking calls that don't belong in batch formation
+_BLOCKING_DOTTED = {"time.sleep", "subprocess.run", "subprocess.Popen",
+                    "subprocess.check_output", "requests.get",
+                    "requests.post", "socket.socket"}
+_BLOCKING_NAMES = {"open", "print", "input"}
+_BLOCKING_METHODS = {"acquire"}
+
+_PRAGMA_RE = re.compile(r"#\s*nnlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+def lint_source(paths: Sequence, *, root: Optional[str] = None
+                ) -> List[Diagnostic]:
+    """Lint Python sources: each path is a file or a directory walked
+    recursively. ``root`` (default: common parent) only affects how
+    locations are displayed."""
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts))
+        else:
+            files.append(p)
+    diags: List[Diagnostic] = []
+    for f in files:
+        diags.extend(_lint_file(f, root=root))
+    return diags
+
+
+def _lint_file(path: Path, root: Optional[str] = None) -> List[Diagnostic]:
+    try:
+        text = path.read_text()
+        tree = ast.parse(text, filename=str(path))
+    except (OSError, SyntaxError, ValueError) as e:
+        return [make("NNL100", f"cannot lint {path}: {e}",
+                     location=str(path))]
+    display = str(path)
+    if root:
+        try:
+            display = str(path.relative_to(root))
+        except ValueError:
+            pass
+    pragmas, comments = _collect_pragmas(text)
+    scope = _file_scope(path)
+    finder = _FunctionIndex(tree)
+    hot = finder.hot_functions(scope)
+    device_classes = finder.device_affinity_classes()
+
+    raw: List[Diagnostic] = []
+    raw += _check_bare_except(tree, display)
+    for fn, fscope, cls in hot:
+        raw += _check_host_sync(fn, fscope, display)
+        raw += _check_scalar_pull(fn, fscope, cls, device_classes, display)
+        raw += _check_silent_swallow(fn, display)
+        if fscope == "serving":
+            raw += _check_blocking(fn, display)
+    raw += _check_tracer_branch(tree, display)
+    return [d for d in raw if not _suppressed(d, pragmas, comments)]
+
+
+# ---------------------------------------------------------------------------
+# scoping machinery
+# ---------------------------------------------------------------------------
+
+def _file_scope(path: Path) -> Optional[str]:
+    parts = set(path.parts)
+    if "serving" in parts:
+        return "serving"
+    if "elements" in parts:
+        return "element"
+    if "runtime" in parts and path.name in ("pad.py", "element.py",
+                                            "queue.py"):
+        return "element"
+    return None
+
+
+class _FunctionIndex:
+    """All function defs in a module, with enough structure to resolve
+    one level of intra-module calls (self.helper() / module helper())."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.module_funcs: Dict[str, ast.FunctionDef] = {}
+        self.methods: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        self.classes: List[ast.ClassDef] = []
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_funcs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.methods[(node.name, sub.name)] = sub
+
+    def device_affinity_classes(self) -> Set[str]:
+        """Class names declaring DEVICE_AFFINITY = \"device\" (visible to
+        the AST — no import needed)."""
+        out: Set[str] = set()
+        for cls in self.classes:
+            for node in cls.body:
+                if (isinstance(node, ast.Assign)
+                        and any(isinstance(t, ast.Name)
+                                and t.id == "DEVICE_AFFINITY"
+                                for t in node.targets)
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value == "device"):
+                    out.add(cls.name)
+        return out
+
+    def hot_functions(self, scope: Optional[str]
+                      ) -> List[Tuple[ast.FunctionDef, str, Optional[str]]]:
+        """(function, scope, class name) for every hot function, with one
+        level of same-module call expansion."""
+        if scope is None:
+            return []
+        names = ELEMENT_HOT if scope == "element" else SERVING_HOT
+        roots: List[Tuple[ast.FunctionDef, Optional[str]]] = []
+        for (cls, fname), fn in self.methods.items():
+            if fname in names:
+                roots.append((fn, cls))
+        for fname, fn in self.module_funcs.items():
+            if fname in names:
+                roots.append((fn, None))
+        seen = {id(fn) for fn, _ in roots}
+        expanded = list(roots)
+        for fn, cls in roots:
+            for callee, ccls in self._callees(fn, cls):
+                if id(callee) not in seen:
+                    seen.add(id(callee))
+                    expanded.append((callee, ccls))
+        return [(fn, scope, cls) for fn, cls in expanded]
+
+    def _callees(self, fn: ast.FunctionDef, cls: Optional[str]
+                 ) -> Iterable[Tuple[ast.FunctionDef, Optional[str]]]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self" and cls is not None):
+                target = self.methods.get((cls, f.attr))
+                if target is not None:
+                    yield target, cls
+            elif isinstance(f, ast.Name):
+                target = self.module_funcs.get(f.id)
+                if target is not None:
+                    yield target, None
+
+
+def _collect_pragmas(text: str) -> Tuple[Dict[int, Set[str]], Set[int]]:
+    """(pragma rules per line, comment-only line numbers). A pragma
+    applies to its own line, or — when written as a standalone comment —
+    to the next code line, looking up through a contiguous comment block
+    (multi-line pragma comments are common)."""
+    pragmas: Dict[int, Set[str]] = {}
+    comments: Set[int] = set()
+    for i, line in enumerate(text.splitlines(), start=1):
+        if line.lstrip().startswith("#"):
+            comments.add(i)
+        m = _PRAGMA_RE.search(line)
+        if m:
+            pragmas[i] = {r.strip() for r in m.group(1).split(",")
+                          if r.strip()}
+    return pragmas, comments
+
+
+def _suppressed(d: Diagnostic, pragmas: Dict[int, Set[str]],
+                comments: Set[int]) -> bool:
+    if d.line is None:
+        return False
+
+    def match(ln: int) -> bool:
+        rules = pragmas.get(ln)
+        return bool(rules and (d.rule in rules or "all" in rules))
+
+    if match(d.line):
+        return True
+    ln = d.line - 1
+    while ln in comments:
+        if match(ln):
+            return True
+        ln -= 1
+    return False
+
+
+# ---------------------------------------------------------------------------
+# call-shape helpers
+# ---------------------------------------------------------------------------
+
+def _dotted(func: ast.expr) -> str:
+    """'jax.block_until_ready' for Attribute chains rooted at a Name;
+    '.method' for attribute calls on arbitrary expressions; 'name' for
+    bare calls."""
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts:
+        return "." + parts[-len(parts)]
+    return ""
+
+
+def _method_name(func: ast.expr) -> Optional[str]:
+    return func.attr if isinstance(func, ast.Attribute) else None
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _check_host_sync(fn: ast.FunctionDef, scope: str, display: str
+                     ) -> List[Diagnostic]:
+    diags = []
+    sync_dotted = set(_SYNC_DOTTED)
+    if scope == "serving":
+        sync_dotted |= _SYNC_DOTTED_SERVING
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        method = _method_name(node.func)
+        if dotted in sync_dotted or method in _SYNC_METHODS:
+            what = dotted or f".{method}()"
+            diags.append(make(
+                "NNL101",
+                f"'{what}' in hot function '{fn.name}' forces a "
+                "device→host sync per call", location=display,
+                line=node.lineno, col=node.col_offset,
+                hint="keep values device-resident; sample or batch the "
+                     "sync, or pragma if intentional"))
+    return diags
+
+
+def _check_scalar_pull(fn: ast.FunctionDef, scope: str, cls: Optional[str],
+                       device_classes: Set[str], display: str
+                       ) -> List[Diagnostic]:
+    # only meaningful where the values flowing through are device arrays:
+    # methods of a DEVICE_AFFINITY="device" element class
+    if scope != "element" or cls is None or cls not in device_classes:
+        return []
+    diags = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")):
+            continue
+        if len(node.args) != 1 or isinstance(node.args[0], ast.Constant):
+            continue
+        diags.append(make(
+            "NNL102",
+            f"{node.func.id}() on a runtime value in hot function "
+            f"'{fn.name}' of device element '{cls}' blocks on a "
+            "device→host scalar transfer", location=display,
+            line=node.lineno, col=node.col_offset,
+            hint="keep the comparison on device (jnp) or pull once per "
+                 "batch, not per scalar"))
+    return diags
+
+
+def _check_bare_except(tree: ast.Module, display: str) -> List[Diagnostic]:
+    diags = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            diags.append(make(
+                "NNL103", "bare 'except:' hides the error type and "
+                "catches KeyboardInterrupt/SystemExit", location=display,
+                line=node.lineno, col=node.col_offset,
+                hint="catch Exception (or a concrete class) instead"))
+    return diags
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    return (isinstance(t, ast.Name)
+            and t.id in ("Exception", "BaseException"))
+
+
+def _check_silent_swallow(fn: ast.FunctionDef, display: str
+                          ) -> List[Diagnostic]:
+    diags = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is not None and not _is_broad(node):
+            continue
+        body_ok = all(
+            isinstance(s, (ast.Pass, ast.Continue, ast.Break))
+            or (isinstance(s, ast.Expr)
+                and isinstance(s.value, ast.Constant))
+            for s in node.body)
+        if body_ok:
+            diags.append(make(
+                "NNL104",
+                f"broad except in hot function '{fn.name}' swallows the "
+                "error silently — the stream corrupts without a pipeline "
+                "ERROR", location=display, line=node.lineno,
+                col=node.col_offset,
+                hint="log it, post_error(), or narrow the exception type"))
+    return diags
+
+
+def _check_blocking(fn: ast.FunctionDef, display: str) -> List[Diagnostic]:
+    diags = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        method = _method_name(node.func)
+        bare = node.func.id if isinstance(node.func, ast.Name) else None
+        if (dotted in _BLOCKING_DOTTED or bare in _BLOCKING_NAMES
+                or method in _BLOCKING_METHODS):
+            what = dotted or bare or f".{method}()"
+            diags.append(make(
+                "NNL105",
+                f"blocking call '{what}' in batch-formation function "
+                f"'{fn.name}' adds tail latency to every queued request",
+                location=display, line=node.lineno, col=node.col_offset,
+                hint="move I/O off the scheduler thread"))
+    return diags
+
+
+def _static_param_names(call: Optional[ast.Call], fn) -> Optional[Set[str]]:
+    """Param names declared static via static_argnums/static_argnames on
+    a jit call node (branching on those is legal). None = unresolvable
+    (non-constant declaration): skip the function entirely."""
+    if call is None:
+        return set()
+    pos = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+            else [kw.value]
+        for v in vals:
+            if not isinstance(v, ast.Constant):
+                return None
+            if kw.arg == "static_argnames":
+                names.add(str(v.value))
+            elif isinstance(v.value, int) and 0 <= v.value < len(pos):
+                names.add(pos[v.value])
+            else:
+                return None
+    return names
+
+
+def _jit_wrapped_functions(tree: ast.Module
+                           ) -> List[Tuple[ast.AST, Set[str]]]:
+    """(function, static param names) for functions handed to jax.jit:
+    decorator form (@jax.jit / @jit / @partial(jax.jit, ...)) and call
+    form (jax.jit(fn) where fn is defined in the same module)."""
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    out: List[Tuple[ast.AST, Set[str]]] = []
+    seen: Set[int] = set()
+
+    def record(fn, call: Optional[ast.Call]) -> None:
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        static = _static_param_names(call, fn)
+        if static is not None:
+            out.append((fn, static))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = _dotted(dec.func if isinstance(dec, ast.Call) else dec)
+                if d in ("jax.jit", "jit"):
+                    record(node, dec if isinstance(dec, ast.Call) else None)
+                elif (isinstance(dec, ast.Call)
+                        and d in ("partial", "functools.partial")
+                        and dec.args
+                        and _dotted(dec.args[0]) in ("jax.jit", "jit")):
+                    record(node, dec)
+        elif isinstance(node, ast.Call):
+            if _dotted(node.func) in ("jax.jit", "jit") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    record(defs[arg.id], node)
+                elif isinstance(arg, ast.Lambda):
+                    record(arg, node)
+    return out
+
+
+def _param_names(fn) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+# metadata attributes that are static python values at trace time —
+# branching on them is shape-polymorphism, not tracer leakage
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_SAFE_CALLS = {"isinstance", "len", "getattr", "hasattr", "type", "callable"}
+
+
+def _tracer_names_in(test: ast.expr, params: Set[str]) -> List[ast.Name]:
+    hits: List[ast.Name] = []
+
+    def scan(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return  # `x is None` — identity check, legal on a tracer
+        if isinstance(node, ast.Subscript):
+            # x.shape[0] style — the Subscript wraps the Attribute
+            if (isinstance(node.value, ast.Attribute)
+                    and node.value.attr in _STATIC_ATTRS):
+                return
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in _SAFE_CALLS:
+                return
+        if isinstance(node, ast.Name) and node.id in params:
+            hits.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child)
+
+    scan(test)
+    return hits
+
+
+def _check_tracer_branch(tree: ast.Module, display: str) -> List[Diagnostic]:
+    diags = []
+    for fn, static in _jit_wrapped_functions(tree):
+        params = _param_names(fn) - static
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for name in _tracer_names_in(node.test, params):
+                fname = getattr(fn, "name", "<lambda>")
+                diags.append(make(
+                    "NNL106",
+                    f"jitted function '{fname}' branches on parameter "
+                    f"'{name.id}' — a tracer at trace time",
+                    location=display, line=node.lineno,
+                    col=node.col_offset,
+                    hint="use jnp.where / lax.cond, or hoist the value "
+                         "to a static argument"))
+                break  # one finding per branch statement
+    return diags

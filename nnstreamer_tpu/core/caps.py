@@ -261,6 +261,24 @@ def tensors_info_from_caps(caps: Caps) -> TensorsInfo:
     return TensorsInfo.from_fields(s.as_dict())
 
 
+def caps_tensor_format(caps: Caps):
+    """The TensorFormat a tensor caps declares, or None for non-tensor /
+    format-unconstrained caps (used by negotiation-adjacent consumers
+    like the static linter's flexible-stream checks)."""
+    if caps.is_empty:
+        return None
+    s = caps.first
+    if s.media_type != TENSORS_MIME:
+        return None
+    fmt = s.get("format")
+    if fmt is None or not isinstance(fmt, str):
+        return None
+    try:
+        return TensorFormat(fmt)
+    except ValueError:
+        return None
+
+
 def tensors_any_caps() -> Caps:
     """Template caps accepting any tensor stream."""
     return Caps.any_of(

@@ -36,6 +36,7 @@ class TensorCrop(Element):
         PadTemplate("info", PadDirection.SINK, Caps.new("other/tensors")),
     )
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    DEVICE_AFFINITY = "host"  # per-region slicing runs on host arrays
     PROPERTIES = {
         # reference gsttensor_crop.c lateness (ms): tolerated pts distance
         # between the raw frame and its crop-info frame; -1 = pair blindly

@@ -59,6 +59,7 @@ class TensorDecoder(TransformElement):
     ELEMENT_NAME = "tensor_decoder"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, any_media_caps()),)
+    DEVICE_AFFINITY = "host"  # media rendering happens on host memory
     PROPERTIES = _option_props()
 
     READONLY_PROPS = ("sub-plugins",)
@@ -145,6 +146,8 @@ class TensorDecoder(TransformElement):
             import jax
 
             self._track_signature(buf)
+            # nnlint: disable=NNL101 — THE designed single pull: one jitted
+            # reduction, one small device→host transfer for the whole batch
             reduced = jax.device_get(reduce_fn(list(buf.tensors)))
             for f in range(fi):
                 out = self.decoder.decode_reduced(

@@ -89,6 +89,7 @@ class TensorFilter(TransformElement):
     ELEMENT_NAME = "tensor_filter"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    DEVICE_AFFINITY = "device"  # jitted invoke; outputs stay device-resident
     PROPERTIES = {
         "framework": Prop("auto", str, "backend name or 'auto' (detect from model ext)"),
         "model": Prop("", str, "model path / builtin:// URI / module:attr"),
@@ -437,6 +438,8 @@ class TensorFilter(TransformElement):
         if sample_device:
             for o in outputs:
                 if hasattr(o, "block_until_ready"):
+                    # nnlint: disable=NNL101 — sampled latency probe: blocks
+                    # every Nth frame only (latency_sampling), by contract
                     o.block_until_ready()
             self.stats.record_device(clock_now() - t0)
         # 5. output combination: i<N> passthrough of inputs, o<N>/int = outputs

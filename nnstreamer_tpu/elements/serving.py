@@ -57,6 +57,7 @@ class TensorServing(TransformElement):
     ELEMENT_NAME = "tensor_serving"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
+    DEVICE_AFFINITY = "device"  # batches execute under one jit compile cache
     PROPERTIES = {
         "framework": Prop("jax", str,
                           "backend executing the batches (jax only: the "

@@ -34,6 +34,7 @@ class TensorSparseEnc(TransformElement):
     ELEMENT_NAME = "tensor_sparse_enc"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _STATIC_CAPS),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _SPARSE_CAPS),)
+    DEVICE_AFFINITY = "host"  # COO packing runs on host arrays
 
     def transform_caps(self, src_pad: Pad) -> Caps:
         return caps_from_tensors_info(TensorsInfo((), TensorFormat.SPARSE))
@@ -57,6 +58,7 @@ class TensorSparseDec(TransformElement):
     ELEMENT_NAME = "tensor_sparse_dec"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _SPARSE_CAPS),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _STATIC_CAPS),)
+    DEVICE_AFFINITY = "host"  # COO unpacking runs on host arrays
 
     def transform_caps(self, src_pad: Pad) -> Caps:
         # dense shape rides in per-buffer meta; stream stays flexible

@@ -118,6 +118,10 @@ class TensorSrc(_PacedSource):
     def get_src_caps(self) -> Caps:
         return caps_from_tensors_info(self._info)
 
+    def device_affinity(self) -> str:
+        # device=true streams are device-resident from birth
+        return "device" if self.props["device"] else "neutral"
+
     def _device_create(self, idx: int):
         """One jitted dispatch generates every tensor of the frame on the
         default device; dispatch is async, so generation of frame N+1

@@ -31,6 +31,7 @@ class TensorTransform(TransformElement):
     ELEMENT_NAME = "tensor_transform"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
+    DEVICE_AFFINITY = "device"  # always-jitted elementwise transform
     # reference read-only constant (gsttensor_transform.c
     # transpose-rank-limit): max rank the transpose option string addresses
     TRANSPOSE_RANK_LIMIT = 4

@@ -8,8 +8,9 @@ element modules (the reference's single ``plugin_init``).
 """
 from __future__ import annotations
 
+import difflib
 import importlib
-from typing import Dict, List, Type
+from typing import Dict, List, Optional, Type
 
 from ..runtime.element import Element
 
@@ -102,12 +103,36 @@ def _allowed(factory_name: str) -> bool:
     return factory_name in {e.strip() for e in allow.split(",") if e.strip()}
 
 
+def suggest_element(factory_name: str) -> Optional[str]:
+    """Closest registered factory name for a typo, or None (did-you-mean
+    helper shared by make_element/get_factory errors and the linter's
+    NNL001 unknown-element rule)."""
+    load_standard_elements()
+    matches = difflib.get_close_matches(
+        factory_name, list(_FACTORIES), n=1, cutoff=0.55)
+    return matches[0] if matches else None
+
+
+def _unknown_element_msg(factory_name: str) -> str:
+    hint = suggest_element(factory_name)
+    dym = f" — did you mean '{hint}'?" if hint else ""
+    return f"no such element '{factory_name}'{dym} (known: {sorted(_FACTORIES)})"
+
+
+def merged_properties(cls: Type[Element]) -> Dict[str, object]:
+    """The PROPERTIES table merged across the MRO — the same merge
+    ``Element.__init__`` performs (used by inspect, pbtxt emission, and
+    the linter's NNL002 unknown-property rule)."""
+    merged: Dict[str, object] = {}
+    for klass in reversed(cls.__mro__):
+        merged.update(getattr(klass, "PROPERTIES", {}) or {})
+    return merged
+
+
 def make_element(factory_name: str, name=None, **props) -> Element:
     load_standard_elements()
     if factory_name not in _FACTORIES:
-        raise ValueError(
-            f"no such element '{factory_name}' (known: {sorted(_FACTORIES)})"
-        )
+        raise ValueError(_unknown_element_msg(factory_name))
     if not _allowed(factory_name):
         raise PermissionError(
             f"element '{factory_name}' is not in the configured "
@@ -125,7 +150,5 @@ def get_factory(factory_name: str) -> Type[Element]:
     """The element class for a factory name (no instantiation)."""
     load_standard_elements()
     if factory_name not in _FACTORIES:
-        raise ValueError(
-            f"no such element '{factory_name}' (known: {sorted(_FACTORIES)})"
-        )
+        raise ValueError(_unknown_element_msg(factory_name))
     return _FACTORIES[factory_name]

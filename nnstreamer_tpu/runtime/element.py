@@ -57,6 +57,14 @@ class Element:
     # media shims' downstream capsfilter search (elements/media.py
     # downstream_filter_caps) can look through them
     CAPS_TRANSPARENT: bool = False
+    # where this element's steady-state compute runs — the static
+    # analyzer's NNL010 rule uses it to spot device→host→device
+    # round-trips. "device": runs jitted XLA compute and keeps buffers
+    # device-resident (tensor_filter/tensor_serving/tensor_transform);
+    # "host": must pull buffers to host memory to do its work
+    # (decoders, media converters, sparse codecs); "neutral": works on
+    # whatever arrives without forcing a transfer (queues, tees, sinks)
+    DEVICE_AFFINITY: str = "neutral"
     # alternate property spellings (reference/GStreamer names) mapped to
     # the canonical key, applied after dash→underscore normalization
     PROP_ALIASES: Dict[str, str] = {}
@@ -175,6 +183,12 @@ class Element:
     # SubpluginKind; the reference's read-only ``sub-plugins`` property
     # (registered subplugin names) is then served here for all of them
     SUBPLUGIN_KIND = None
+
+    def device_affinity(self) -> str:
+        """Effective device affinity of THIS instance (classes whose
+        affinity depends on configuration — e.g. tensor_src device=true —
+        override; everyone else reports DEVICE_AFFINITY)."""
+        return self.DEVICE_AFFINITY
 
     def get_property(self, key: str) -> Any:
         key_n = key.replace("-", "_")

@@ -81,11 +81,15 @@ def _pad_links(text: str) -> str:
 Entry = tuple
 
 
-def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
-    """Build a Pipeline from a launch string (elements linked, not started)."""
-    from ..registry.elements import make_element
+def launch_chains(description: str) -> List[List[List[str]]]:
+    """Tokenize a launch description into chains of entry token lists.
 
-    pipe = pipeline or Pipeline()
+    This is the pure grammar stage shared by :func:`parse_launch` and the
+    static linter's dry checks (``analysis.graph_lint``) — no elements are
+    constructed. Each chain is a list of entries; each entry is the token
+    list of one element / caps filter / name reference (``["tee",
+    "name=t"]``, ``["video/raw,format=RGB"]``, ``["t."]``).
+    """
     tokens = shlex.split(_pad_links(description))
     # gst-launch tolerates spaces around '=' in properties and caps
     # fields ("tee name =t", "format = RGB", "width= 100" — all appear in
@@ -169,6 +173,20 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
         raise ValueError("launch string ends with '!'")
     if not tokens:
         raise ValueError("empty launch string")
+    return chains
+
+
+def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
+    """Build a Pipeline from a launch string (elements linked, not started).
+
+    Unknown element names raise with a did-you-mean suggestion from the
+    registry (``registry.elements.suggest_element`` — the same helper the
+    linter's NNL001 rule uses).
+    """
+    from ..registry.elements import make_element
+
+    pipe = pipeline or Pipeline()
+    chains = launch_chains(description)
 
     links: List[Tuple[Entry, Entry]] = []
     for chain in chains:

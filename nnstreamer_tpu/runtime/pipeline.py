@@ -47,8 +47,14 @@ class Bus:
 class Pipeline:
     """A runnable graph of elements."""
 
-    def __init__(self, name: str = "pipeline"):
+    def __init__(self, name: str = "pipeline", validate: bool = False):
         self.name = name
+        # opt-in static validation at play(): the graph linter
+        # (analysis.lint_pipeline) runs before data flows and logs its
+        # findings as warnings — runtime and static checks share one
+        # diagnostic path, but validation never blocks a play() the
+        # caller asked for (warn-only; use the lint CLI to gate hard)
+        self.validate = validate
         self.elements: Dict[str, Element] = {}
         self.bus = Bus()
         # running-time anchor, set at each play() (GStreamer base_time analog)
@@ -89,6 +95,8 @@ class Pipeline:
 
         trace.install_from_env()   # NNS_TRACERS (GST_TRACERS analog)
         trace.dump_dot(self)       # NNS_DOT_DIR (GST_DEBUG_DUMP_DOT_DIR)
+        if self.validate:
+            self._run_static_validation()
         self._validate_links()
         self._playing = True
         self.play_t0_mono = time.monotonic()
@@ -160,6 +168,19 @@ class Pipeline:
             "per_element": per_element,
             "per_sink": per_sink,
         }
+
+    def _run_static_validation(self) -> None:
+        """Warn-only graph lint at play() (validate=True): every finding
+        becomes a log warning, never an exception — see docs/lint.md."""
+        from ..analysis import lint_pipeline
+
+        try:
+            diags = lint_pipeline(self)
+        except Exception:  # noqa: BLE001 - validation must not block play
+            logger.exception("%s: static validation failed to run", self.name)
+            return
+        for d in diags:
+            logger.warning("%s: %s", self.name, d.format())
 
     def _validate_links(self) -> None:
         for el in self.elements.values():

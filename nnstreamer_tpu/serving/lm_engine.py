@@ -123,6 +123,8 @@ class ContinuousLMEngine:
         jnp = self._jnp
         tok_dev, self._cache = self._step(
             jnp.asarray(self._tok), jnp.asarray(self._pos), self._cache)
+        # nnlint: disable=NNL101 — one (slots,) pull per decode step: the
+        # scheduler needs host ints to append/retire (documented contract)
         tok = np.asarray(tok_dev)[:, 0]
         self._pos = self._pos + self._mask.astype(np.int32)
         self._tok[self._mask, 0] = tok[self._mask]

@@ -1,0 +1,467 @@
+"""nnlint static analyzer: graph rules (NNL0xx), source rules (NNL1xx),
+CLI, pipeline-startup validation, and the self-lint regression gate."""
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from nnstreamer_tpu.analysis import (
+    RULES,
+    Severity,
+    lint_launch,
+    lint_pbtxt,
+    lint_pipeline,
+    lint_source,
+)
+from nnstreamer_tpu.analysis.cli import main as lint_main
+from nnstreamer_tpu.registry.elements import make_element, suggest_element
+from nnstreamer_tpu.runtime.parse import parse_launch
+from nnstreamer_tpu.runtime.pipeline import Pipeline
+
+MODEL = "builtin://scaler?factor=2"
+
+
+def rules_of(diags):
+    return {d.rule for d in diags}
+
+
+# ---------------------------------------------------------------------------
+# graph rules: each triggers on a bad fixture, stays silent on a good one
+# ---------------------------------------------------------------------------
+
+class TestGraphRules:
+    def test_nnl001_unknown_element(self):
+        diags = lint_launch("tensor_sr num-buffers=1 ! tensor_sink")
+        (d,) = [d for d in diags if d.rule == "NNL001"]
+        assert d.severity is Severity.ERROR
+        assert "tensor_src" in d.hint  # did-you-mean
+        assert "NNL001" not in rules_of(
+            lint_launch("tensor_src num-buffers=1 ! tensor_sink"))
+
+    def test_nnl002_unknown_property(self):
+        diags = lint_launch("tensor_src bogus=1 ! tensor_sink")
+        assert "NNL002" in rules_of(diags)
+        assert "NNL002" not in rules_of(
+            lint_launch("tensor_src dimensions=2 ! tensor_sink"))
+
+    def test_nnl002_respects_aliases(self):
+        # reference spelling input= maps to input_dims via PROP_ALIASES
+        diags = lint_launch(
+            f"tensor_src ! tensor_filter framework=jax model={MODEL} "
+            "input=2 inputtype=float32 ! tensor_sink")
+        assert "NNL002" not in rules_of(diags)
+
+    def test_nnl003_caps_mismatch(self):
+        bad = lint_launch("tensor_src dimensions=2 num-buffers=1 "
+                          "! other/tensors,dimensions=3 ! tensor_sink")
+        assert "NNL003" in rules_of(bad)
+        good = lint_launch("tensor_src dimensions=2 num-buffers=1 "
+                           "! other/tensors,dimensions=2 ! tensor_sink")
+        assert "NNL003" not in rules_of(good)
+
+    def test_nnl003_dtype_mismatch(self):
+        bad = lint_launch("tensor_src dimensions=2 types=uint8 "
+                          "! other/tensors,types=float32 ! tensor_sink")
+        assert "NNL003" in rules_of(bad)
+
+    def test_nnl004_isolated_source_still_flagged(self):
+        # a fully unlinked SOURCE is never "unreachable" (it seeds
+        # reachability), so its dangling src pad must be reported
+        pipe = parse_launch("tensor_src num-buffers=1 ! tensor_sink")
+        pipe.add(make_element("tensor_src"))
+        diags = lint_pipeline(pipe)
+        assert "NNL004" in rules_of(diags)
+
+    def test_nnl004_dangling_pad(self):
+        pipe = parse_launch("tensor_src num-buffers=1 ! tensor_sink")
+        q = make_element("queue")
+        s = make_element("tensor_sink")
+        pipe.add(q, s)
+        q.link(s)  # q's sink pad stays unlinked
+        diags = lint_pipeline(pipe)
+        assert any(d.rule == "NNL004" and ".sink" in d.message
+                   for d in diags)
+        clean = parse_launch("tensor_src num-buffers=1 ! tensor_sink")
+        assert "NNL004" not in rules_of(lint_pipeline(clean))
+
+    def test_nnl005_cycle(self):
+        q1, q2 = make_element("queue"), make_element("queue")
+        p = Pipeline()
+        p.add(q1, q2)
+        q1.link(q2)
+        q2.link(q1)
+        diags = lint_pipeline(p)
+        (d,) = [d for d in diags if d.rule == "NNL005"]
+        assert d.severity is Severity.ERROR
+        acyclic = parse_launch("tensor_src num-buffers=1 ! queue ! tensor_sink")
+        assert "NNL005" not in rules_of(lint_pipeline(acyclic))
+
+    def test_nnl006_unreachable(self):
+        pipe = parse_launch("tensor_src num-buffers=1 ! tensor_sink")
+        q = make_element("queue")
+        s = make_element("tensor_sink")
+        pipe.add(q, s)
+        q.link(s)
+        diags = lint_pipeline(pipe)
+        unreached = {d.location for d in diags if d.rule == "NNL006"}
+        assert q.name in unreached and s.name in unreached
+        clean = parse_launch("tensor_src num-buffers=1 ! tensor_sink")
+        assert "NNL006" not in rules_of(lint_pipeline(clean))
+
+    def test_nnl007_tee_arity(self):
+        bad = lint_launch(
+            "tensor_src num-buffers=1 ! tee name=t t. ! tensor_sink")
+        assert "NNL007" in rules_of(bad)
+        good = lint_launch("tensor_src num-buffers=1 ! tee name=t "
+                           "t. ! tensor_sink t. ! tensor_sink")
+        assert "NNL007" not in rules_of(good)
+
+    def test_nnl007_mux_arity(self):
+        bad = lint_launch("tensor_src num-buffers=1 ! tensor_mux name=m "
+                          "! tensor_sink")
+        assert "NNL007" in rules_of(bad)
+        good = lint_launch(
+            "tensor_src num-buffers=1 ! tensor_mux name=m ! tensor_sink "
+            "tensor_src num-buffers=1 ! m.")
+        assert "NNL007" not in rules_of(good)
+
+    def test_nnl008_recompile_storm(self):
+        bad = lint_launch(
+            "appsrc caps=other/tensors,format=flexible "
+            f"! tensor_filter framework=jax model={MODEL} ! tensor_sink")
+        assert "NNL008" in rules_of(bad)
+        # declared dynamic: the backend expects per-invoke shapes
+        dyn = lint_launch(
+            "appsrc caps=other/tensors,format=flexible "
+            f"! tensor_filter framework=jax model={MODEL} "
+            "invoke-dynamic=true ! tensor_sink")
+        assert "NNL008" not in rules_of(dyn)
+        static = lint_launch(
+            "tensor_src dimensions=2 "
+            f"! tensor_filter framework=jax model={MODEL} ! tensor_sink")
+        assert "NNL008" not in rules_of(static)
+
+    def test_nnl009_bucket_coverage(self):
+        bad = lint_launch(
+            "tensor_src dimensions=3:8:8:16 num-buffers=1 "
+            f"! tensor_serving model={MODEL} bucket-sizes=1,2,4,8 "
+            "! tensor_sink")
+        assert "NNL009" in rules_of(bad)
+        good = lint_launch(
+            "tensor_src dimensions=3:8:8:4 num-buffers=1 "
+            f"! tensor_serving model={MODEL} bucket-sizes=1,2,4,8 "
+            "! tensor_sink")
+        assert "NNL009" not in rules_of(good)
+
+    def test_nnl010_host_roundtrip(self):
+        bad = lint_launch(
+            "tensor_src dimensions=4 num-buffers=1 "
+            f"! tensor_filter framework=jax model={MODEL} "
+            "! tensor_sparse_enc ! tensor_sparse_dec "
+            "! tensor_transform mode=typecast option=float32 ! tensor_sink")
+        assert "NNL010" in rules_of(bad)
+        # same host stages AFTER the last device stage: no round trip
+        good = lint_launch(
+            "tensor_src dimensions=4 num-buffers=1 "
+            f"! tensor_filter framework=jax model={MODEL} "
+            "! tensor_sparse_enc ! tensor_sparse_dec ! tensor_sink")
+        assert "NNL010" not in rules_of(good)
+
+    def test_nnl011_incomplete(self):
+        assert "NNL011" in rules_of(
+            lint_launch("tensor_src num-buffers=1 ! queue"))
+        assert "NNL011" not in rules_of(
+            lint_launch("tensor_src num-buffers=1 ! tensor_sink"))
+
+    def test_nnl012_construction_failure(self):
+        # tensor_decoder requires mode=
+        diags = lint_launch("tensor_src ! tensor_decoder ! tensor_sink")
+        (d,) = [d for d in diags if d.rule == "NNL012"]
+        assert d.severity is Severity.ERROR
+        assert "NNL012" not in rules_of(
+            lint_launch("tensor_src num-buffers=1 ! tensor_sink"))
+
+    def test_pbtxt_path(self):
+        from nnstreamer_tpu.runtime.pbtxt import to_pbtxt
+
+        pb = to_pbtxt(parse_launch(
+            "tensor_src num-buffers=2 ! tensor_transform mode=typecast "
+            "option=float32 ! tensor_sink"))
+        assert lint_pbtxt(pb) == []
+        assert "NNL012" in rules_of(lint_pbtxt("node { garbage"))
+
+
+# ---------------------------------------------------------------------------
+# source rules on synthetic snippets
+# ---------------------------------------------------------------------------
+
+def _lint_snippet(tmp_path, subdir, code):
+    d = tmp_path / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "mod.py"
+    f.write_text(textwrap.dedent(code))
+    return lint_source([f], root=str(tmp_path))
+
+
+class TestSourceRules:
+    def test_nnl100_unparsable_file(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "elements", "def broken(:\n")
+        (d,) = [d for d in bad if d.rule == "NNL100"]
+        assert d.severity is Severity.ERROR
+
+    def test_nnl101_sync_in_element_hot_path(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "elements", """
+            class El:
+                def chain(self, pad, buf):
+                    out = self.fn(buf)
+                    out.block_until_ready()
+        """)
+        assert "NNL101" in rules_of(bad)
+        good = _lint_snippet(tmp_path, "elements", """
+            class El:
+                def debug_probe(self, buf):  # not a hot function
+                    buf.block_until_ready()
+        """)
+        assert "NNL101" not in rules_of(good)
+
+    def test_nnl101_helper_called_from_hot_path(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "serving", """
+            import numpy as np
+
+            def _pull(x):
+                return np.asarray(x)
+
+            class S:
+                def _loop(self):
+                    while True:
+                        _pull(self.engine.step())
+        """)
+        assert "NNL101" in rules_of(bad)
+
+    def test_nnl101_pragma_suppresses(self, tmp_path):
+        clean = _lint_snippet(tmp_path, "elements", """
+            class El:
+                def chain(self, pad, buf):
+                    # nnlint: disable=NNL101 — sampled probe
+                    buf.block_until_ready()
+        """)
+        assert "NNL101" not in rules_of(clean)
+
+    def test_nnl102_scalar_pull_in_device_element(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "elements", """
+            class El:
+                DEVICE_AFFINITY = "device"
+                def transform(self, buf):
+                    return float(buf.tensors[0])
+        """)
+        assert "NNL102" in rules_of(bad)
+        # host-affinity element: float() on host arrays is fine
+        good = _lint_snippet(tmp_path, "elements", """
+            class El:
+                DEVICE_AFFINITY = "host"
+                def transform(self, buf):
+                    return float(buf.tensors[0])
+        """)
+        assert "NNL102" not in rules_of(good)
+
+    def test_nnl103_bare_except(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "elements", """
+            class El:
+                def chain(self, pad, buf):
+                    try:
+                        self.push(buf)
+                    except:
+                        pass
+        """)
+        errs = [d for d in bad if d.rule == "NNL103"]
+        assert errs and errs[0].severity is Severity.ERROR
+        good = _lint_snippet(tmp_path, "elements", """
+            class El:
+                def chain(self, pad, buf):
+                    try:
+                        self.push(buf)
+                    except ValueError:
+                        pass
+        """)
+        assert "NNL103" not in rules_of(good)
+
+    def test_nnl104_silent_swallow(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "elements", """
+            class El:
+                def chain(self, pad, buf):
+                    try:
+                        self.push(buf)
+                    except Exception:
+                        pass
+        """)
+        assert "NNL104" in rules_of(bad)
+        good = _lint_snippet(tmp_path, "elements", """
+            class El:
+                def chain(self, pad, buf):
+                    try:
+                        self.push(buf)
+                    except Exception as e:
+                        self.post_error(str(e))
+        """)
+        assert "NNL104" not in rules_of(good)
+
+    def test_nnl105_blocking_in_batch_formation(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "serving", """
+            import time
+
+            class Former:
+                def take_ready(self, force=False):
+                    time.sleep(0.01)
+                    return []
+        """)
+        assert "NNL105" in rules_of(bad)
+        good = _lint_snippet(tmp_path, "serving", """
+            import time
+
+            class Former:
+                def take_ready(self, force=False):
+                    now = time.monotonic()
+                    return []
+        """)
+        assert "NNL105" not in rules_of(good)
+
+    def test_nnl106_tracer_branch(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "ops", """
+            import jax
+
+            def fn(x):
+                if x > 0:
+                    return x
+                return -x
+
+            jitted = jax.jit(fn)
+        """)
+        assert "NNL106" in rules_of(bad)
+
+    def test_nnl106_static_args_and_closures_ok(self, tmp_path):
+        good = _lint_snippet(tmp_path, "ops", """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def fn(x, n):
+                if n > 3:        # static arg: fine
+                    return x * n
+                return x
+
+            def make(mode):
+                def gen(key):
+                    if mode == "zeros":   # closure: fine
+                        return key
+                    if key is None:       # identity check: fine
+                        return key
+                    if key.shape[0] > 1:  # shape: static at trace: fine
+                        return key
+                    return key
+                return jax.jit(gen)
+        """)
+        assert "NNL106" not in rules_of(good)
+
+
+# ---------------------------------------------------------------------------
+# CLI + wiring
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_strict_fails_on_error(self, capsys):
+        assert lint_main(["--strict", "tensor_sr ! tensor_sink"]) == 1
+        assert lint_main(["tensor_src num-buffers=1 ! tensor_sink"]) == 0
+        capsys.readouterr()
+
+    def test_warning_gates_only_under_strict(self, capsys):
+        pipe = "tensor_src num-buffers=1 ! tee name=t t. ! tensor_sink"
+        assert lint_main([pipe]) == 0
+        assert lint_main(["--strict", pipe]) == 1
+        capsys.readouterr()
+
+    def test_json_output(self, capsys):
+        import json
+
+        assert lint_main(["--json", "tensor_sr ! tensor_sink"]) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data[0]["rule"] == "NNL001"
+
+    def test_json_target_with_non_dict_top_level(self, tmp_path, capsys):
+        f = tmp_path / "bad.json"
+        f.write_text("[1, 2, 3]")
+        assert lint_main([str(f)]) == 1  # NNL012 diagnostic, no traceback
+        assert "NNL012" in capsys.readouterr().out
+
+    def test_rules_listing(self, capsys):
+        assert lint_main(["--rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in RULES:
+            assert rule_id in out
+
+    def test_module_entrypoint(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "nnstreamer_tpu", "lint",
+             "tensor_src num-buffers=1 ! tensor_sink"],
+            capture_output=True, text=True,
+            env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                 "HOME": "/tmp"})
+        assert proc.returncode == 0, proc.stderr
+
+
+class TestWiring:
+    def test_parse_did_you_mean(self):
+        with pytest.raises(ValueError, match="did you mean 'tensor_src'"):
+            parse_launch("tensor_sr ! tensor_sink")
+
+    def test_suggest_element(self):
+        assert suggest_element("tensor_filtr") == "tensor_filter"
+        assert suggest_element("zzzqqqxxx") is None
+
+    def test_pipeline_validate_warn_only(self, caplog):
+        import logging
+
+        p = Pipeline(validate=True)
+        parse_launch(
+            "tensor_src num-buffers=2 ! tee name=t t. ! tensor_sink",
+            pipeline=p)
+        with caplog.at_level(logging.WARNING, logger="nnstreamer_tpu"):
+            msg = p.run(timeout=30)
+        assert msg.type.name == "EOS"  # warn-only: pipeline still ran
+        assert any("NNL007" in r.message for r in caplog.records)
+
+    def test_pipeline_validate_off_by_default(self, caplog):
+        import logging
+
+        p = Pipeline()
+        parse_launch(
+            "tensor_src num-buffers=2 ! tee name=t t. ! tensor_sink",
+            pipeline=p)
+        with caplog.at_level(logging.WARNING, logger="nnstreamer_tpu"):
+            p.run(timeout=30)
+        assert not any("NNL007" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# the self-lint regression gate (tier-1 safe: CPU-only, no network)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+class TestSelfLint:
+    def test_tree_has_zero_findings(self):
+        from pathlib import Path
+
+        import nnstreamer_tpu
+
+        pkg = Path(nnstreamer_tpu.__file__).parent
+        diags = lint_source([pkg], root=str(pkg.parent))
+        assert [d.format() for d in diags] == []
+
+    def test_strict_cli_gate_passes(self, capsys):
+        from pathlib import Path
+
+        import nnstreamer_tpu
+
+        pkg = Path(nnstreamer_tpu.__file__).parent
+        assert lint_main(["--strict", str(pkg)]) == 0
+        capsys.readouterr()

@@ -44,6 +44,12 @@ def main() -> None:
         "Auto-generated from the element registry "
         "(`python tools/gen_element_docs.py`; "
         "`python -m nnstreamer_tpu inspect <name>` shows the same live).",
+        "",
+        "Pipelines built from these elements can be validated *before* "
+        "execution with the static linter — `python -m nnstreamer_tpu "
+        "lint \"<launch string>\"` cross-checks element names, "
+        "properties, caps compatibility, and perf hazards against this "
+        "registry; see [lint.md](lint.md) for the rule catalog.",
     ]
     for name in element_factories():
         cls = get_factory(name)
@@ -59,12 +65,9 @@ def main() -> None:
         srcs = ", ".join(f"`{t.name_template}`"
                          for t in cls.SRC_TEMPLATES) or "—"
         lines.append(f"- sink pads: {sinks}; src pads: {srcs}")
-        # merge PROPERTIES across the MRO exactly like Element.__init__
-        # does at runtime — getattr alone drops inherited props (filesrc's
-        # required `location` lives on a base class)
-        props = {}
-        for klass in reversed(cls.__mro__):
-            props.update(getattr(klass, "PROPERTIES", {}) or {})
+        from nnstreamer_tpu.registry.elements import merged_properties
+
+        props = merged_properties(cls)
         if props:
             lines.append("- properties:")
             for key, prop in props.items():

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""nnlint entry point + the self-lint CI gate.
+
+With no arguments, runs the STRICT source lint over our own tree (the
+regression gate tests/test_lint.py also enforces; any intentional
+hot-path sync must carry an in-source ``# nnlint: disable=NNL1xx``
+pragma). With arguments, behaves exactly like
+``python -m nnstreamer_tpu lint ...``.
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from nnstreamer_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    # no-target invocation is the strict self-lint gate (cli.py default)
+    sys.exit(main(sys.argv[1:]))
