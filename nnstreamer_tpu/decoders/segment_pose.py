@@ -31,9 +31,17 @@ class ImageSegment(Decoder):
 
     MODE = "image_segment"
 
+    FORMATS = ("tflite-deeplab", "snpe-deeplab", "snpe-depth")
+
     def init(self, options):
         super().init(options)
         self.fmt = self.option(1, "tflite-deeplab")
+        # reference tensordec-imagesegment.c: an unknown option1 scheme is
+        # a hard init error (expectFail corpus), not a silent deeplab
+        if self.fmt not in self.FORMATS:
+            raise ValueError(
+                f"image_segment: unknown option1 format '{self.fmt}' "
+                f"(accepted: {', '.join(self.FORMATS)})")
         # option2 = max class labels except background (reference
         # tensordec-imagesegment.c option2, default 20/Pascal); palette
         # gets one color per class + background
@@ -187,6 +195,12 @@ class PoseEstimation(Decoder):
             self.in_width, self.in_height = int(iwh[0]), int(iwh[1])
         else:
             self.in_width, self.in_height = self.width, self.height
+        if self.mode not in ("heatmap-only", "heatmap-offset", "coords"):
+            # reference tensordec-pose.c rejects unknown mode strings at
+            # init (expectFail corpus); legacy aliases normalized above
+            raise ValueError(
+                f"pose_estimation: unknown mode '{self.mode}' (accepted: "
+                "heatmap-only, heatmap-offset, coords)")
         self.labels = [n for n, _ in _POSE_DEFAULT]
         self.connections = {i: c for i, (_, c) in enumerate(_POSE_DEFAULT)}
         path = self.option(3)

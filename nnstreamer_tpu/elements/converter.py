@@ -94,6 +94,26 @@ class TensorConverter(TransformElement):
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
+        # reference expectFail corpus: a malformed or zero dimension in
+        # input-dim / an unknown input-type is rejected at property-set
+        # time (gst_tensor_converter set_property), not at the first buffer
+        dim = self.props["input_dim"]
+        if dim is not None:
+            try:
+                spec = TensorSpec.from_dim_string(dim,
+                                                  self.props["input_type"])
+            except Exception as e:
+                raise ElementError(
+                    f"{self.describe()}: bad input-dim='{dim}' "
+                    f"input-type='{self.props['input_type']}': {e}")
+            if any(d <= 0 for d in spec.shape):
+                raise ElementError(
+                    f"{self.describe()}: input-dim='{dim}' has a "
+                    "non-positive dimension")
+        if self.props["frames_per_tensor"] < 1:
+            raise ElementError(
+                f"{self.describe()}: frames-per-tensor="
+                f"{self.props['frames_per_tensor']} must be >= 1")
         self._mode: Optional[str] = None
         self._out_info: Optional[TensorsInfo] = None
         self._pending: List[Buffer] = []

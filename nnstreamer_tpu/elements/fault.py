@@ -23,7 +23,7 @@ import numpy as np
 from ..core import Buffer
 from ..core.caps import any_media_caps
 from ..registry.elements import register_element
-from ..runtime.element import Element, Prop
+from ..runtime.element import Element, Prop, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 
 
@@ -41,18 +41,37 @@ class TensorFault(Element):
         "delay_prob": Prop(0.0, float, "probability a buffer is delayed"),
         "delay_ms": Prop(0.0, float, "max delay (uniform 0..delay-ms)"),
         "seed": Prop(0, int, "rng seed — identical runs inject identical faults"),
+        # deterministic element-crash injection (supervised-restart chaos
+        # tests): raise on the Nth buffer of a run. One-shot by default —
+        # the crash DISARMS across reset_flow, so a supervisor replaying
+        # the same pipeline recovers; crash-repeat=true re-arms every run
+        # (circuit-breaker tests)
+        "crash_at_buffer": Prop(-1, int,
+                                "raise on this 0-based buffer index "
+                                "(-1 = never)"),
+        "crash_repeat": Prop(False, prop_bool,
+                             "re-arm the crash on every (re)start instead "
+                             "of one-shot"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._rng = np.random.default_rng(self.props["seed"])
         self.stats = {"passed": 0, "dropped": 0, "duplicated": 0,
-                      "corrupted": 0, "delayed": 0}
+                      "corrupted": 0, "delayed": 0, "crashed": 0}
+        self._buf_index = 0
+        self._crash_armed = self.props["crash_at_buffer"] >= 0
 
     def reset_flow(self) -> None:
         super().reset_flow()
         self._rng = np.random.default_rng(self.props["seed"])
+        crashed = self.stats.get("crashed", 0)
         self.stats = {k: 0 for k in self.stats}
+        self._buf_index = 0
+        if self.props["crash_repeat"]:
+            self._crash_armed = self.props["crash_at_buffer"] >= 0
+        elif crashed:
+            self._crash_armed = False  # one-shot: stays disarmed on replay
 
     def _corrupt(self, buf: Buffer) -> Buffer:
         tensors = []
@@ -69,6 +88,15 @@ class TensorFault(Element):
         return out
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
+        idx = self._buf_index
+        self._buf_index += 1
+        if self._crash_armed and idx == self.props["crash_at_buffer"]:
+            self.stats["crashed"] += 1
+            if not self.props["crash_repeat"]:
+                self._crash_armed = False
+            raise RuntimeError(
+                f"injected crash at buffer {idx} (tensor_fault "
+                "crash-at-buffer)")
         r = self._rng.random(4)
         if r[0] < self.props["drop_prob"]:
             self.stats["dropped"] += 1

@@ -15,6 +15,7 @@ steady-state chain (§3.2) runs: validate → input-combination → invoke (time
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import List, Optional
@@ -185,6 +186,27 @@ class TensorFilter(TransformElement):
         self._backend_lock = threading.Lock()  # suspend/resume vs invoke
         self._suspend_thread: Optional[threading.Thread] = None
         self._suspend_stop = threading.Event()
+        self._validate_model_ref()
+
+    # model-file extensions whose absence is a hard CONSTRUCTION error: the
+    # reference's negative launch lines (runTest.sh expectFail cases for
+    # tflite/tflite2/pytorch/deepview-rt and jax .py scripts) name missing
+    # or bogus model files and must fail before play, not construct quietly
+    _MODEL_FILE_EXTS = (".tflite", ".pt", ".pth", ".pb", ".circle", ".so",
+                        ".rtm", ".onnx", ".caffemodel", ".py", ".mlir",
+                        ".stablehlo")
+
+    def _validate_model_ref(self) -> None:
+        model = self.props.get("model")
+        if not model:
+            return  # model may arrive later (set_property / config-file)
+        if "://" in model:
+            return  # builtin:// fixtures, registry:// URIs resolve at open
+        if not model.lower().endswith(self._MODEL_FILE_EXTS):
+            return  # module:attr, custom-easy names, SavedModel dirs, ...
+        if not os.path.exists(model):
+            raise ElementError(
+                f"{self.describe()}: model file '{model}' does not exist")
 
     READONLY_PROPS = ("sub-plugins", "inputranks", "outputranks")
     SUBPLUGIN_KIND = SubpluginKind.FILTER  # read-only sub-plugins prop
@@ -506,6 +528,56 @@ class TensorFilter(TransformElement):
         """The device mesh the opened backend shards over
         (``custom=mesh:...`` jax backends; None = single-device)."""
         return getattr(self.backend, "mesh", None)
+
+    # -- staged hot swap (service control plane) ----------------------------
+    # reload_model() below swaps in place: the old model is gone before the
+    # new one proved it can serve. The service layer's zero-downtime rollout
+    # (service/models.py) needs prepare → warmup → flip → retire instead,
+    # with the OLD backend serving traffic until the flip.
+
+    def prepare_model(self, new_model: str) -> FilterBackend:
+        """Open a backend for ``new_model`` WITHOUT touching the live one
+        (same resolution path as _open_backend: registry:// URIs, framework
+        detect, aliases). Caller warms it up, then either commit_model()s
+        it in or releases it (rollback)."""
+        if not self.props["is_updatable"]:
+            raise ElementError(
+                f"{self.describe()}: model swap refused (is-updatable=false)")
+        from ..registry.models import resolve
+
+        model_path, hint = resolve(new_model)
+        fw = self._detect_framework(model_path, hint)
+        fprops = FilterProperties(
+            model=model_path,
+            custom=self._custom_with_config_file(),
+            accelerator=Accelerator(self.props["accelerator"]),
+        )
+        backend = acquire_backend(fw, fprops, "")  # never shared: private
+        # until commit, so a failed warmup can't poison a share-key entry
+        if self._model_view_info is not None:
+            backend.set_input_info(self._model_view_info)
+        return backend
+
+    def commit_model(self, backend: FilterBackend,
+                     new_model: str) -> Optional[FilterBackend]:
+        """Atomically flip the live backend to a prepared one; returns the
+        RETIRED backend (caller releases it after in-flight work drains —
+        release_prepared() does that)."""
+        with self._backend_lock:
+            old = self.backend
+            self.backend = backend
+            self.props["model"] = new_model
+        return old
+
+    def release_prepared(self, backend: Optional[FilterBackend]) -> None:
+        """Release a backend from prepare_model (rollback) or commit_model
+        (retire-old)."""
+        if backend is None:
+            return
+        # a retired backend may be the one _open_backend acquired under
+        # the element's share key; release under that key so refcounts
+        # balance (prepare_model never uses a share key)
+        release_backend(backend, self.props["shared_tensor_filter_key"])
 
     def reload_model(self, new_model: Optional[str] = None) -> None:
         """Hot model swap without pipeline restart (reference ``is-updatable``

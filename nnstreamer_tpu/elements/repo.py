@@ -21,8 +21,23 @@ from ..core import (
     tensors_info_from_caps,
 )
 from ..registry.elements import register_element
-from ..runtime.element import Prop, SinkElement, SourceElement, prop_bool
+from ..runtime.element import (
+    ElementError,
+    Prop,
+    SinkElement,
+    SourceElement,
+    prop_bool,
+)
 from ..runtime.pad import PadDirection, PadTemplate
+
+
+def _check_slot_index(el) -> None:
+    # reference gst_tensor_repo negative corpus: a negative slot id is a
+    # hard error at construction, not a silently-created slot
+    if el.props["slot_index"] < 0:
+        raise ElementError(
+            f"{el.describe()}: slot-index={el.props['slot_index']} "
+            "must be >= 0")
 
 
 class _Slot:
@@ -82,6 +97,10 @@ class TensorRepoSink(SinkElement):
                             "(0 = every buffer)"),
     }
 
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        _check_slot_index(self)
+
     def reset_flow(self) -> None:
         super().reset_flow()
         # replayed pipelines restart pts at 0: a stale throttle epoch
@@ -121,6 +140,7 @@ class TensorRepoSrc(SourceElement):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._primed = False
+        _check_slot_index(self)
 
     def reset_flow(self) -> None:
         super().reset_flow()

@@ -189,7 +189,20 @@ def parse_transform_options(mode: str, option: str):
                             int(ch) if ch else None))
         return make_arithmetic(ops, out_dtype, per_channel_dim=pc_dim)
     if mode == "transpose":
-        return make_transpose([int(p) for p in option.split(":")])
+        try:
+            axes = [int(p) for p in option.split(":")]
+        except ValueError:
+            raise ValueError(f"transpose option '{option}' is not a "
+                             "':'-separated axis list")
+        # the reference rejects non-permutation axis lists at property-set
+        # time (gsttensor_transform.c mode option parse, expectFail corpus
+        # lines); accepting them here only defers the crash into the jitted
+        # call with a worse message
+        if sorted(axes) != list(range(len(axes))) or len(axes) < 2:
+            raise ValueError(
+                f"transpose option '{option}' must be a permutation of "
+                f"0..{max(len(axes) - 1, 1)}")
+        return make_transpose(axes)
     if mode == "dimchg":
         frm, _, to = option.partition(":")
         return make_dimchg(int(frm), int(to))

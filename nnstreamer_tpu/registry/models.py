@@ -21,11 +21,36 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Optional, Tuple
+import threading
+from typing import Dict, Optional, Tuple
 
 from .config import get_config
 
 SCHEME = "registry://"
+
+# -- process-local registry overlay (service control plane) ------------------
+# The service layer's versioned model slots (service/models.py) publish here
+# so a launch line can say ``model=registry://myslot`` with no registry FILE
+# on disk; local entries shadow same-named file entries. Entries use the
+# identical {"versions": ..., "active": ...} schema as the JSON file.
+_local: Dict[str, dict] = {}
+_local_lock = threading.Lock()
+
+
+def register_local_model(name: str, entry: dict) -> None:
+    """Publish/replace an in-process registry entry (file-schema dict)."""
+    with _local_lock:
+        _local[name] = entry
+
+
+def unregister_local_model(name: str) -> None:
+    with _local_lock:
+        _local.pop(name, None)
+
+
+def local_model_names() -> Tuple[str, ...]:
+    with _local_lock:
+        return tuple(sorted(_local))
 
 
 def registry_path() -> str:
@@ -52,18 +77,23 @@ def resolve(model: str) -> Tuple[str, Optional[str]]:
         return model, None
     ref = model[len(SCHEME):]
     name, _, version = ref.partition("@")
-    path = registry_path()
-    if not os.path.exists(path):
-        raise FileNotFoundError(
-            f"model registry {path} not found (set NNS_TPU_MODEL_REGISTRY "
-            "or [common] model_registry)"
-        )
-    with open(path) as fh:
-        reg = json.load(fh)
-    if name not in reg:
-        raise KeyError(f"model '{name}' not in registry {path} "
-                       f"(known: {sorted(reg)})")
-    entry = reg[name]
+    with _local_lock:
+        local_entry = _local.get(name)
+    if local_entry is not None:
+        entry = local_entry
+    else:
+        path = registry_path()
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"model registry {path} not found (set NNS_TPU_MODEL_REGISTRY "
+                "or [common] model_registry)"
+            )
+        with open(path) as fh:
+            reg = json.load(fh)
+        if name not in reg:
+            raise KeyError(f"model '{name}' not in registry {path} "
+                           f"(known: {sorted(reg)})")
+        entry = reg[name]
     if isinstance(entry, str):  # shorthand: "name": "/path/to/model"
         entry = {"path": entry}
     if not isinstance(entry, dict):

@@ -279,7 +279,7 @@ class Element:
         logger.error("%s: %s", self.describe(), error)
         self.post_message(MessageType.ERROR, error=error)
         if self.pipeline is not None:
-            self.pipeline._element_error(self)
+            self.pipeline._element_error(self, error)
 
     # -- data flow ----------------------------------------------------------
     def _chain_guarded(self, pad: Pad, buf: Buffer) -> None:
@@ -472,6 +472,11 @@ class SinkElement(Element):
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
         self.render(buf)
+        # rendered-buffer progress: the service watchdog's liveness signal
+        # (counted only AFTER a successful render, so a crashing sink
+        # never reads as progress)
+        if self.pipeline is not None:
+            self.pipeline.sink_buffer_count += 1
 
     def render(self, buf: Buffer) -> None:
         raise NotImplementedError

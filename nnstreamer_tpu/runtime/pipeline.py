@@ -9,7 +9,7 @@ from __future__ import annotations
 import queue as _queue
 import threading
 import time
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Callable, Dict, Iterable, List, Optional, Set
 
 from ..core import Message, MessageType
 from ..utils.log import logger
@@ -62,6 +62,17 @@ class Pipeline:
         self._playing = False
         self._eos_sinks: Set[str] = set()
         self._lock = threading.Lock()
+        # -- control-plane hooks (service layer) -----------------------------
+        # buffers rendered at ANY sink since the last play(); the service
+        # health watchdog reads this as "is data still making it through"
+        # (a plain int: += under the GIL is close enough for a watchdog,
+        # and the render path must stay lock-free)
+        self.sink_buffer_count = 0
+        # out-of-band state listeners: cb(kind, source, data) with kind in
+        # {"playing", "stopped", "eos", "error"}. Unlike the Bus (a queue
+        # one consumer drains), listeners fan out — the supervisor can
+        # watch a pipeline whose bus the application owns.
+        self._state_listeners: List[Callable[[str, str, dict], None]] = []
 
     # -- construction -------------------------------------------------------
     def add(self, *elements: Element) -> "Pipeline":
@@ -78,6 +89,35 @@ class Pipeline:
     def link(self, *chain: Element) -> None:
         for up, down in zip(chain, chain[1:]):
             up.link(down)
+
+    def add_state_listener(self, cb: Callable[[str, str, dict], None]) -> None:
+        """Subscribe to out-of-band lifecycle notifications (see __init__).
+        Listeners run on the notifying thread and must not block."""
+        self._state_listeners.append(cb)
+
+    def remove_state_listener(self, cb) -> None:
+        if cb in self._state_listeners:
+            self._state_listeners.remove(cb)
+
+    def _notify_state(self, kind: str, source: str, data: dict) -> None:
+        for cb in list(self._state_listeners):
+            try:
+                cb(kind, source, data)
+            except Exception:  # noqa: BLE001 - a listener must not kill flow
+                logger.exception("state listener failed for %s", kind)
+
+    def element_stats(self) -> Dict[str, dict]:
+        """Per-element runtime counters for every element exposing a
+        ``.stats`` dict (queues: drop/level counters; tensor_fault:
+        injection counters). The service health snapshot surfaces this."""
+        out: Dict[str, dict] = {}
+        for el in self.elements.values():
+            stats = getattr(el, "stats", None)
+            if isinstance(stats, dict) and stats:
+                out[el.name] = dict(stats)
+            elif hasattr(stats, "snapshot"):  # InvokeStats (tensor_filter)
+                out[el.name] = stats.snapshot()
+        return out
 
     @property
     def sinks(self) -> List[SinkElement]:
@@ -100,6 +140,7 @@ class Pipeline:
         self._validate_links()
         self._playing = True
         self.play_t0_mono = time.monotonic()
+        self.sink_buffer_count = 0
         self._eos_sinks.clear()
         for el in self.elements.values():
             el.reset_flow()
@@ -110,6 +151,7 @@ class Pipeline:
         for el in self.sources:
             el.start()
         self.bus.post(Message(MessageType.STATE_CHANGED, self.name, {"state": "playing"}))
+        self._notify_state("playing", self.name, {})
         return self
 
     def stop(self) -> "Pipeline":
@@ -122,6 +164,7 @@ class Pipeline:
             if not isinstance(el, SourceElement):
                 el.stop()
         self.bus.post(Message(MessageType.STATE_CHANGED, self.name, {"state": "stopped"}))
+        self._notify_state("stopped", self.name, {})
         return self
 
     @property
@@ -189,7 +232,7 @@ class Pipeline:
                     logger.warning("%s: unlinked sink pad %s", self.name, pad.full_name)
 
     # -- EOS / error flow ----------------------------------------------------
-    def _element_error(self, element: Element) -> None:
+    def _element_error(self, element: Element, error: str = "") -> None:
         """Fatal element error: halt sources so the graph drains instead of
         spinning (GStreamer: apps stop the pipeline on a bus ERROR; we stop
         producing immediately, the app still owns final stop())."""
@@ -197,6 +240,8 @@ class Pipeline:
             return
         threading.Thread(target=self._halt_sources, daemon=True,
                          name=f"{self.name}:error-halt").start()
+        self._notify_state("error", element.name,
+                           {"element": element.name, "error": error})
 
     def _halt_sources(self) -> None:
         for el in self.sources:
@@ -211,6 +256,7 @@ class Pipeline:
             done = len(self._eos_sinks) >= len(self.sinks)
         if done:
             self.bus.post(Message(MessageType.EOS, self.name, {}))
+            self._notify_state("eos", self.name, {})
 
     def wait(self, timeout: float = 30.0) -> Message:
         """Run until EOS or ERROR; returns the terminating message."""

@@ -35,17 +35,24 @@ class _Channel:
         self._cond = threading.Condition()
         self._closed = False
         self._n_bufs = 0  # buffers in _dq (events excluded), O(1) hot path
+        # leaky-mode loss accounting: upstream = incoming buffer refused,
+        # downstream = oldest queued buffer evicted. Silent drops make
+        # buffer loss invisible to the service health snapshot.
+        self.dropped_upstream = 0
+        self.dropped_downstream = 0
 
     def put_buf(self, buf: Buffer) -> None:
         with self._cond:
             if self.capacity > 0 and self._n_bufs >= self.capacity:
                 if self.leaky == "upstream":
+                    self.dropped_upstream += 1
                     return  # drop the incoming (newest) buffer
                 if self.leaky == "downstream":
                     for i, (kind, _) in enumerate(self._dq):
                         if kind == "buf":
                             del self._dq[i]  # drop the oldest buffer
                             self._n_bufs -= 1
+                            self.dropped_downstream += 1
                             break
                 else:
                     while not self._closed and self._n_bufs >= self.capacity:
@@ -102,6 +109,24 @@ class QueueElement(Element):
         self._ch = _Channel(self.props["max_size_buffers"], self.props["leaky"])
         self._thread: Optional[threading.Thread] = None
         self._running = threading.Event()
+
+    @property
+    def stats(self) -> dict:
+        """Loss/occupancy counters (picked up by Pipeline.element_stats and
+        the service health snapshot): leaky drops are counted, not silent."""
+        ch = self._ch
+        return {
+            "level": ch._n_bufs,
+            "capacity": ch.capacity,
+            "leaky": ch.leaky,
+            "dropped_upstream": ch.dropped_upstream,
+            "dropped_downstream": ch.dropped_downstream,
+        }
+
+    def reset_flow(self) -> None:
+        super().reset_flow()
+        self._ch.dropped_upstream = 0
+        self._ch.dropped_downstream = 0
 
     # -- producer side ------------------------------------------------------
     def chain(self, pad: Pad, buf: Buffer) -> None:

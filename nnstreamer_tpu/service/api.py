@@ -1,0 +1,285 @@
+"""Service control-plane surface: HTTP JSON endpoint + client (L7).
+
+Reference analog: the ML-Service C API's out-of-process control calls
+(``ml_service_*``, reached over D-Bus on the reference platform). TPU
+redesign: a stdlib ``http.server`` JSON endpoint — no daemon framework,
+no dependency — that exposes the :class:`~.manager.ServiceManager` verbs,
+plus a matching ``urllib`` client the CLI uses, so ``python -m
+nnstreamer_tpu service <verb>`` works against any running ``serve``
+process.
+
+Routes (all JSON):
+
+    GET    /healthz                       liveness of the control plane
+    GET    /services                      list (name/state/ready/restarts)
+    GET    /services/<name>               full health snapshot
+    POST   /services                      register {name, launch, ...}
+    POST   /services/<name>/start         {"wait": bool}
+    POST   /services/<name>/stop
+    POST   /services/<name>/drain         {"timeout_s": float}
+    DELETE /services/<name>               unregister (stops first)
+    GET    /models                        slot table
+    POST   /models/<slot>/swap            {"version": v}
+    POST   /models/<slot>/canary          {"version": v, "fraction": f}
+    POST   /models/<slot>/promote
+    POST   /models/<slot>/cancel
+
+Errors return ``{"error": "..."}`` with 4xx/5xx.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils.log import logger
+from .manager import AdmissionRejected, ServiceError, ServiceManager
+from .models import SwapError
+from .supervisor import RestartPolicy
+
+
+# -- server ------------------------------------------------------------------
+
+class ControlServer:
+    """Threaded HTTP control endpoint bound to a manager."""
+
+    def __init__(self, manager: ServiceManager, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.manager = manager
+        handler = _make_handler(manager)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ControlServer":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name=f"svc-http:{self.port}",
+                                        daemon=True)
+        self._thread.start()
+        logger.info("service control endpoint listening on %s",
+                    self.endpoint)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def _make_handler(manager: ServiceManager):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through our logger
+            logger.debug("control-http: " + fmt, *args)
+
+        # -- plumbing --------------------------------------------------------
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length") or 0)
+            if n == 0:
+                return {}
+            return json.loads(self.rfile.read(n).decode() or "{}")
+
+        def _dispatch(self, method: str) -> None:
+            try:
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                handled = self._route(method, parts)
+            except (ServiceError, SwapError, KeyError, ValueError) as e:
+                # typed mapping (message text only breaks the 404 tie for
+                # lookup-style ServiceErrors): bad input 400, rejected
+                # registration 422, missing thing 404, bad state 409
+                if isinstance(e, AdmissionRejected):
+                    code = 422
+                elif isinstance(e, ValueError):
+                    code = 400
+                elif isinstance(e, KeyError) or (
+                        isinstance(e, ServiceError)
+                        and not isinstance(e, SwapError)
+                        and "unknown" in str(e).lower()):
+                    code = 404
+                else:
+                    code = 409
+                self._reply(code, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 - endpoint must answer
+                logger.exception("control-http: %s %s failed", method,
+                                 self.path)
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            if handled is None:
+                self._reply(404, {"error": f"no route {method} {self.path}"})
+            else:
+                self._reply(200, handled)
+
+        # -- routing ---------------------------------------------------------
+        def _route(self, method: str, parts) -> Optional[dict]:
+            m = manager
+            if parts == ["healthz"] and method == "GET":
+                return {"ok": True, "services": len(m.services())}
+            if parts == ["services"]:
+                if method == "GET":
+                    return {"services": m.list()}
+                if method == "POST":
+                    return self._register(self._body())
+            if len(parts) == 2 and parts[0] == "services":
+                name = parts[1]
+                if method == "GET":
+                    return m.status(name)
+                if method == "DELETE":
+                    m.unregister(name)
+                    return {"unregistered": name}
+            if len(parts) == 3 and parts[0] == "services":
+                name, verb = parts[1], parts[2]
+                if method == "POST" and verb == "start":
+                    svc = m.start(name, wait=bool(
+                        self._body().get("wait", True)))
+                    return {"name": name, "state": svc.state.value}
+                if method == "POST" and verb == "stop":
+                    return {"name": name, "state": m.stop(name).state.value}
+                if method == "POST" and verb == "drain":
+                    timeout = float(self._body().get("timeout_s", 30.0))
+                    svc = m.drain(name, timeout_s=timeout)
+                    return {"name": name, "state": svc.state.value}
+            if parts == ["models"] and method == "GET":
+                return {"slots": {n: m.models.info(n)
+                                  for n in m.models.names()}}
+            if len(parts) == 3 and parts[0] == "models" and method == "POST":
+                slot, verb = parts[1], parts[2]
+                body = self._body()
+                if verb == "swap":
+                    return m.models.swap(slot, str(body["version"]))
+                if verb == "canary":
+                    return m.models.canary(slot, str(body["version"]),
+                                           float(body["fraction"]))
+                if verb == "promote":
+                    return m.models.promote_canary(slot)
+                if verb == "cancel":
+                    return m.models.cancel_canary(slot)
+            return None
+
+        def _register(self, body: dict) -> dict:
+            policy = None
+            if "restart" in body:
+                policy = RestartPolicy.from_config(body["restart"])
+            svc = manager.register(
+                body["name"], body.get("launch"),
+                pbtxt=body.get("pbtxt"),
+                restart=policy,
+                watchdog_s=float(body.get("watchdog_s", 0.0)),
+                warmup=body.get("warmup", "first-buffer"),
+                warmup_timeout_s=float(body.get("warmup_timeout_s", 30.0)),
+                lint=body.get("lint", "error"),
+                description=body.get("description", ""),
+                autostart=bool(body.get("autostart", False)))
+            return {"name": svc.name, "state": svc.state.value}
+
+        def do_GET(self):     # noqa: N802 - BaseHTTPRequestHandler API
+            self._dispatch("GET")
+
+        def do_POST(self):    # noqa: N802
+            self._dispatch("POST")
+
+        def do_DELETE(self):  # noqa: N802
+            self._dispatch("DELETE")
+
+    return Handler
+
+
+# -- client ------------------------------------------------------------------
+
+class ControlClient:
+    """Thin urllib client for the endpoint (used by the CLI verbs)."""
+
+    def __init__(self, endpoint: str, timeout: float = 60.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None,
+              timeout: Optional[float] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.endpoint + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode() or "{}")
+            except Exception:  # noqa: BLE001
+                payload = {}
+            raise ServiceError(
+                payload.get("error", f"HTTP {e.code} from {path}")) from e
+        except (urllib.error.URLError, OSError) as e:
+            # connection refused / socket timeout: typed, so the CLI
+            # reports it instead of dying with a traceback
+            raise ServiceError(
+                f"control endpoint unreachable ({method} {path}): "
+                f"{getattr(e, 'reason', e)}") from e
+
+    # verbs
+    def healthz(self) -> dict:
+        return self._call("GET", "/healthz")
+
+    def list(self) -> dict:
+        return self._call("GET", "/services")
+
+    def status(self, name: str) -> dict:
+        return self._call("GET", f"/services/{name}")
+
+    def register(self, **body) -> dict:
+        return self._call("POST", "/services", body)
+
+    def start(self, name: str, wait: bool = True) -> dict:
+        return self._call("POST", f"/services/{name}/start", {"wait": wait})
+
+    def stop(self, name: str) -> dict:
+        return self._call("POST", f"/services/{name}/stop", {})
+
+    def drain(self, name: str, timeout_s: float = 30.0) -> dict:
+        # the server blocks until the drain finishes — the HTTP read must
+        # outlive the server-side timeout it asked for
+        return self._call("POST", f"/services/{name}/drain",
+                          {"timeout_s": timeout_s},
+                          timeout=max(self.timeout, timeout_s + 15.0))
+
+    def unregister(self, name: str) -> dict:
+        return self._call("DELETE", f"/services/{name}")
+
+    def models(self) -> dict:
+        return self._call("GET", "/models")
+
+    def swap(self, slot: str, version: str) -> dict:
+        return self._call("POST", f"/models/{slot}/swap",
+                          {"version": version})
+
+    def canary(self, slot: str, version: str, fraction: float) -> dict:
+        return self._call("POST", f"/models/{slot}/canary",
+                          {"version": version, "fraction": fraction})
+
+    def promote(self, slot: str) -> dict:
+        return self._call("POST", f"/models/{slot}/promote", {})
+
+    def cancel_canary(self, slot: str) -> dict:
+        return self._call("POST", f"/models/{slot}/cancel", {})

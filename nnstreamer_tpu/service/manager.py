@@ -1,0 +1,485 @@
+"""Named, supervised pipeline services: the control plane core (L7).
+
+Reference analog: the ML-Service C API (the sibling-repo layer SURVEY §1
+L6 rows point at) — pipelines registered by NAME, launched as managed
+services, kept alive independently of any caller. Here that layer sits on
+the in-process runtime: a :class:`ServiceManager` owns a table of
+:class:`Service` objects, each wrapping one Pipeline with
+
+* admission control — launch lines are statically linted
+  (``analysis.lint_launch``) at registration; error findings reject;
+* a supervised lifecycle —
+
+      REGISTERED → STARTING → READY ⇄ DEGRADED
+                        ↑         ↘ DRAINING → STOPPED
+                        └── supervisor restart  ↘ FAILED
+
+  readiness = caps negotiated AND one warmup inference completed
+  end-to-end (first buffer rendered at a sink);
+* crash supervision (:mod:`.supervisor`) and health probes + stall
+  watchdog (:mod:`.health`);
+* hot model rollout through versioned slots (:mod:`.models`).
+
+The HTTP/CLI surface lives in :mod:`.api`; this module is the
+programmatic API.
+"""
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.log import logger
+from .health import HealthMonitor, service_snapshot
+from .models import ModelSlots
+from .supervisor import RestartPolicy, Supervisor
+
+
+class ServiceError(RuntimeError):
+    pass
+
+
+class AdmissionRejected(ServiceError):
+    """Registration refused: the static lint found error-severity
+    findings (the diagnostics ride on the exception)."""
+
+    def __init__(self, name: str, diagnostics):
+        self.diagnostics = list(diagnostics)
+        lines = "; ".join(d.format() for d in self.diagnostics)
+        super().__init__(f"service '{name}' rejected by admission lint: "
+                         f"{lines}")
+
+
+class ServiceState(enum.Enum):
+    REGISTERED = "registered"
+    STARTING = "starting"
+    READY = "ready"
+    DEGRADED = "degraded"
+    DRAINING = "draining"
+    STOPPED = "stopped"
+    FAILED = "failed"      # policy 'never' fired or circuit breaker open
+
+
+# states in which a pipeline is (supposed to be) running
+_ACTIVE = (ServiceState.STARTING, ServiceState.READY, ServiceState.DEGRADED)
+
+
+@dataclass
+class ServiceSpec:
+    """Everything needed to (re)launch one service."""
+
+    name: str
+    launch: str
+    restart: RestartPolicy = field(default_factory=RestartPolicy)
+    watchdog_s: float = 0.0          # 0 = stall watchdog off
+    warmup: str = "first-buffer"     # first-buffer | none
+    warmup_timeout_s: float = 30.0   # start() blocks at most this long
+    description: str = ""
+
+    def __post_init__(self):
+        if self.warmup not in ("first-buffer", "none"):
+            raise ValueError(
+                f"warmup '{self.warmup}' must be first-buffer|none")
+
+
+class Service:
+    """One named, supervised pipeline service."""
+
+    def __init__(self, manager: "ServiceManager", spec: ServiceSpec,
+                 jitter_seed: Optional[int] = None):
+        self.manager = manager
+        self.spec = spec
+        self.state = ServiceState.REGISTERED
+        self.state_reason = "registered"
+        self.pipeline = None
+        self.supervisor = Supervisor(self, spec.restart, jitter_seed)
+        self.generation = 0           # play() count (restarts increment)
+        self.registered_at = time.time()
+        self.started_at: Optional[float] = None
+        self._monitor: Optional[HealthMonitor] = None
+        self._query_server = None
+        self._eos_seen = False
+        self._ready_evt = threading.Event()
+        self._drained_evt = threading.Event()
+        self._lock = threading.RLock()
+        self._history: List[tuple] = [(time.time(), "registered", "")]
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    # -- state bookkeeping ---------------------------------------------------
+    def _set_state(self, new: ServiceState, reason: str = "") -> None:
+        with self._lock:
+            if self.state is new:
+                return
+            logger.info("service %s: %s -> %s%s", self.name,
+                        self.state.value, new.value,
+                        f" ({reason})" if reason else "")
+            self.state = new
+            self.state_reason = reason
+            self._history.append((time.time(), new.value, reason))
+            del self._history[:-32]
+            if new is ServiceState.READY:
+                self._ready_evt.set()
+            else:
+                self._ready_evt.clear()
+
+    def history(self) -> List[tuple]:
+        with self._lock:
+            return list(self._history)
+
+    # -- probes --------------------------------------------------------------
+    def liveness(self) -> bool:
+        """Is the service where its state says it should be? (playing when
+        active, parked when stopped)."""
+        with self._lock:
+            if self.state in _ACTIVE:
+                return self.pipeline is not None and self.pipeline.playing
+            return self.state is not ServiceState.FAILED
+
+    def readiness(self) -> bool:
+        return self.state is ServiceState.READY
+
+    def uptime_s(self) -> float:
+        with self._lock:
+            if self.started_at is None or self.state not in _ACTIVE:
+                return 0.0
+            return time.time() - self.started_at
+
+    # -- lifecycle -----------------------------------------------------------
+    def _build(self) -> None:
+        from ..runtime.parse import parse_launch
+
+        self.pipeline = parse_launch(self.spec.launch)
+        self.pipeline.name = f"svc:{self.name}"
+        self.pipeline.add_state_listener(self._on_pipeline_event)
+
+    def start(self, wait: bool = True) -> "Service":
+        """REGISTERED/STOPPED → STARTING → READY. Blocks (``wait``) until
+        READY or ``warmup_timeout_s``; a service that misses the window
+        stays STARTING and is promoted by the monitor when warmup lands."""
+        with self._lock:
+            if self.state in _ACTIVE:
+                return self
+            if self.state is ServiceState.DRAINING:
+                raise ServiceError(f"service '{self.name}' is draining")
+            self.supervisor.reset()  # fresh supervision epoch: breaker and
+            # crash window forget previous runs on an operator start
+            self._set_state(ServiceState.STARTING, "start requested")
+            self._eos_seen = False
+            self._drained_evt.clear()
+            if self.pipeline is None:
+                self._build()
+            self.started_at = time.time()
+            self.pipeline.play()
+            # AFTER play(): play resets sink_buffer_count, and the monitor
+            # only trusts a progress reading taken under the new generation
+            # — a stale pre-restart count can never satisfy warmup
+            self.generation += 1
+            if self._monitor is None:
+                self._monitor = HealthMonitor(self)
+                self._monitor.start()
+            self._monitor.reset_watchdog()
+        if self.spec.warmup == "none":
+            self._mark_ready()
+        elif wait:
+            self._ready_evt.wait(self.spec.warmup_timeout_s)
+        return self
+
+    def _mark_ready(self, generation: Optional[int] = None) -> None:
+        with self._lock:
+            if self.state is not ServiceState.STARTING:
+                return
+            if generation is not None and generation != self.generation:
+                return  # promotion decided against a previous run's counter
+            self._set_state(ServiceState.READY,
+                            "caps negotiated + warmup inference done"
+                            if self.spec.warmup == "first-buffer"
+                            else "warmup=none")
+        self.supervisor.note_healthy()
+
+    def _mark_degraded(self, reason: str) -> None:
+        """Watchdog verdict: still playing, no longer serving. The
+        supervisor decides whether DEGRADED becomes a restart."""
+        with self._lock:
+            if self.state is not ServiceState.READY:
+                return
+            self._set_state(ServiceState.DEGRADED, reason)
+        self.supervisor.notify_crash("stall", reason)
+
+    def stop(self) -> "Service":
+        """Hard stop: no drain, in-flight buffers are dropped."""
+        with self._lock:
+            self.supervisor.cancel()
+            if self.pipeline is not None and self.pipeline.playing:
+                self.pipeline.stop()
+            self._stop_query_server()
+            if self.state is not ServiceState.FAILED:
+                self._set_state(ServiceState.STOPPED, "stop requested")
+        return self
+
+    def drain(self, timeout_s: float = 30.0) -> "Service":
+        """Graceful shutdown: sources stop producing and send EOS, queued
+        work flushes through the sinks, then the pipeline stops."""
+        with self._lock:
+            if self.state not in _ACTIVE:
+                return self.stop()
+            self.supervisor.cancel()
+            self._set_state(ServiceState.DRAINING, "drain requested")
+            pipe = self.pipeline
+        for src in pipe.sources:
+            try:
+                src.stop()
+                src.send_eos()
+            except Exception:  # noqa: BLE001 - drain every source regardless
+                logger.exception("service %s: draining %s failed",
+                                 self.name, src.name)
+        if not self._drained_evt.wait(timeout_s):
+            logger.warning("service %s: drain timed out after %.1fs, "
+                           "stopping anyway", self.name, timeout_s)
+        with self._lock:
+            pipe.stop()
+            self._stop_query_server()
+            self._set_state(ServiceState.STOPPED, "drained")
+        return self
+
+    def _stop_query_server(self) -> None:
+        if self._query_server is not None:
+            try:
+                self._query_server.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            self._query_server = None
+
+    def shutdown(self) -> None:
+        """stop() + monitor teardown (service is being unregistered)."""
+        self.stop()
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
+
+    # -- pipeline events -----------------------------------------------------
+    def _on_pipeline_event(self, kind: str, source: str, data: dict) -> None:
+        if kind == "error":
+            with self._lock:
+                if self.state is ServiceState.DRAINING:
+                    self._drained_evt.set()  # died mid-drain: unblock
+                    return
+                if self.state not in _ACTIVE:
+                    return
+            self.supervisor.notify_crash(
+                "error", str(data.get("error", data)), source)
+        elif kind == "eos":
+            self._eos_seen = True
+            with self._lock:
+                if self.state is ServiceState.DRAINING:
+                    self._drained_evt.set()
+                    return
+                if self.state not in _ACTIVE:
+                    return
+            self.supervisor.notify_eos()
+
+    # -- supervisor callbacks ------------------------------------------------
+    def _supervised_restart(self) -> None:
+        with self._lock:
+            if self.state not in _ACTIVE:
+                return  # user stopped/drained/failed meanwhile
+            logger.info("service %s: supervised restart (#%d)",
+                        self.name, self.supervisor.restarts)
+            self._set_state(ServiceState.STARTING,
+                            f"supervised restart #{self.supervisor.restarts}")
+            self._eos_seen = False
+            pipe = self.pipeline
+            pipe.stop()
+            self.started_at = time.time()
+            pipe.play()
+            self.generation += 1  # after play(): see start()
+            if self._monitor is not None:
+                self._monitor.reset_watchdog()
+
+    def _supervised_give_up(self, why: str) -> None:
+        with self._lock:
+            if self.pipeline is not None and self.pipeline.playing:
+                self.pipeline.stop()
+            self._set_state(ServiceState.FAILED, why)
+
+    def _supervised_complete(self) -> None:
+        """Clean EOS under a non-restarting policy: the stream is over."""
+        with self._lock:
+            if self.state not in _ACTIVE:
+                return
+            self.pipeline.stop()
+            self._set_state(ServiceState.STOPPED, "stream completed (eos)")
+
+    # -- integration ---------------------------------------------------------
+    def attach_query_server(self, host: str = "127.0.0.1", port: int = 0,
+                            priority: int = 0,
+                            deadline_s: Optional[float] = None):
+        """Expose the service's ``tensor_serving`` scheduler to TCP
+        tensor-query clients: N clients coalesce into the service's device
+        batch (query/server.py attach_scheduler). Returns the QueryServer
+        (``.port`` for clients); stopped with the service."""
+        from ..query.server import QueryServer
+
+        el = self._find_serving_element()
+        server = QueryServer(host, port)
+        server.attach_scheduler(el._ensure_scheduler(), priority=priority,
+                                deadline_s=deadline_s)
+        self._query_server = server
+        return server
+
+    def _find_serving_element(self):
+        from ..elements.serving import TensorServing
+
+        if self.pipeline is None:
+            raise ServiceError(
+                f"service '{self.name}' is not built yet (start it first)")
+        for el in self.pipeline.elements.values():
+            if isinstance(el, TensorServing):
+                return el
+        raise ServiceError(
+            f"service '{self.name}' has no tensor_serving element to "
+            "attach a query server to")
+
+    def model_bindings(self) -> dict:
+        """{slot: version info} for every slot this service references."""
+        out = {}
+        if self.pipeline is None:
+            return out
+        slots = self.manager.models
+        for slot in slots.names():
+            for svc, _el in slots.bound_filters(slot):
+                if svc is self:
+                    out[slot] = slots.info(slot)
+                    break
+        return out
+
+    def status(self) -> dict:
+        return service_snapshot(self)
+
+
+class ServiceManager:
+    """The named-service table + model slots (one per deployment)."""
+
+    def __init__(self, jitter_seed: Optional[int] = None):
+        self._services: Dict[str, Service] = {}
+        self._lock = threading.Lock()
+        self._jitter_seed = jitter_seed
+        self.models = ModelSlots(self)
+
+    # -- registration --------------------------------------------------------
+    def register(self, name: str, launch: Optional[str] = None, *,
+                 pbtxt: Optional[str] = None,
+                 restart: Optional[RestartPolicy] = None,
+                 watchdog_s: float = 0.0,
+                 warmup: str = "first-buffer",
+                 warmup_timeout_s: float = 30.0,
+                 lint: str = "error",
+                 description: str = "",
+                 autostart: bool = False) -> Service:
+        """Admit a named service from a launch line or pbtxt graph.
+
+        ``lint``: ``error`` (default — error findings reject), ``warn``
+        (everything logs, nothing rejects), ``off`` (skip the linter).
+        """
+        if (launch is None) == (pbtxt is None):
+            raise ValueError("pass exactly one of launch= or pbtxt=")
+        if lint not in ("error", "warn", "off"):
+            raise ValueError(f"lint '{lint}' must be error|warn|off")
+        if pbtxt is not None:
+            from ..runtime.pbtxt import from_pbtxt
+
+            launch = from_pbtxt(pbtxt)
+        with self._lock:
+            if name in self._services:
+                raise ServiceError(f"service '{name}' already registered")
+        if lint != "off":
+            self._admission_lint(name, launch, strict=(lint == "error"))
+        spec = ServiceSpec(name=name, launch=launch,
+                           restart=restart or RestartPolicy(),
+                           watchdog_s=watchdog_s, warmup=warmup,
+                           warmup_timeout_s=warmup_timeout_s,
+                           description=description)
+        svc = Service(self, spec, jitter_seed=self._jitter_seed)
+        with self._lock:
+            if name in self._services:
+                raise ServiceError(f"service '{name}' already registered")
+            self._services[name] = svc
+        logger.info("service %s: registered (%s)", name,
+                    launch[:120])
+        if autostart:
+            svc.start()
+        return svc
+
+    @staticmethod
+    def _admission_lint(name: str, launch: str, strict: bool) -> None:
+        from ..analysis import lint_launch
+
+        try:
+            diags = lint_launch(launch)
+        except Exception:  # noqa: BLE001 - the linter must not block ops
+            logger.exception("service %s: admission lint failed to run",
+                             name)
+            return
+        errors = [d for d in diags if d.is_error]
+        for d in diags:
+            if d not in errors or not strict:
+                logger.warning("service %s admission lint: %s", name,
+                               d.format())
+        if strict and errors:
+            raise AdmissionRejected(name, errors)
+
+    # -- table ---------------------------------------------------------------
+    def get(self, name: str) -> Service:
+        with self._lock:
+            svc = self._services.get(name)
+        if svc is None:
+            raise ServiceError(f"unknown service '{name}' "
+                               f"(have: {sorted(self._services)})")
+        return svc
+
+    def services(self) -> List[Service]:
+        with self._lock:
+            return list(self._services.values())
+
+    def list(self) -> List[dict]:
+        return [{"name": s.name, "state": s.state.value,
+                 "ready": s.readiness(), "restarts": s.supervisor.restarts,
+                 "description": s.spec.description}
+                for s in self.services()]
+
+    def unregister(self, name: str) -> None:
+        svc = self.get(name)
+        svc.shutdown()
+        with self._lock:
+            self._services.pop(name, None)
+
+    # -- verbs (CLI/HTTP surface) -------------------------------------------
+    def start(self, name: str, wait: bool = True) -> Service:
+        return self.get(name).start(wait=wait)
+
+    def stop(self, name: str) -> Service:
+        return self.get(name).stop()
+
+    def drain(self, name: str, timeout_s: float = 30.0) -> Service:
+        return self.get(name).drain(timeout_s)
+
+    def status(self, name: str) -> dict:
+        return self.get(name).status()
+
+    def swap(self, slot: str, version: str) -> dict:
+        return self.models.swap(slot, version)
+
+    def shutdown(self) -> None:
+        """Stop everything, tear down monitors, unpublish model slots."""
+        for svc in self.services():
+            try:
+                svc.shutdown()
+            except Exception:  # noqa: BLE001 - shut the rest down regardless
+                logger.exception("service %s: shutdown failed", svc.name)
+        self.models.unpublish_all()
+        with self._lock:
+            self._services.clear()

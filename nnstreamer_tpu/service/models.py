@@ -1,0 +1,327 @@
+"""Versioned model slots: zero-downtime rollout for running services (L7).
+
+Reference analog: the ML-Agent model database (``mlagent://`` URIs with
+registered versions + activate semantics) — but where the reference
+resolves a version once at pipeline build, a service slot stays LIVE:
+launch lines reference ``registry://<slot>`` (resolved through the
+process-local registry overlay, :mod:`..registry.models`), and
+:meth:`ModelSlots.swap` rolls every bound, running ``tensor_filter`` to a
+new version without stopping the pipeline:
+
+    prepare-new  — open a second backend for the new version (the old one
+                   keeps serving every frame meanwhile);
+    warmup       — invoke the new backend once on zeros shaped like the
+                   negotiated input (a model that cannot serve must fail
+                   HERE, not on live traffic);
+    atomic flip  — swap the element's backend pointer under its invoke
+                   lock (one pointer store: no frame ever sees a
+                   half-swapped model);
+    retire-old   — release the previous backend after the flip.
+
+Warmup failure rolls back: prepared backends are released, the active
+version and every live element are untouched, and :class:`SwapError`
+carries the cause. Fractional **canary** routing wraps the live backend
+in a deterministic splitter that sends ``fraction`` of invokes to the
+candidate version — promote installs it for 100%, rollback discards it.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..registry.models import register_local_model, unregister_local_model
+from ..utils.log import logger
+
+
+class SwapError(RuntimeError):
+    """A hot swap failed and was rolled back (old version still serving)."""
+
+
+class _CanaryBackend:
+    """Deterministic fractional router between the live backend and a
+    candidate. Invoke ``i`` routes to the canary when the running product
+    ``floor((i+1)*f) > floor(i*f)`` — exact long-run fraction, no rng.
+    Everything except ``invoke`` proxies to the primary (negotiation,
+    model info, events)."""
+
+    def __init__(self, primary, canary, fraction: float):
+        self.primary = primary
+        self.canary = canary
+        self.fraction = float(fraction)
+        self._n = 0
+        self._lock = threading.Lock()
+        self.primary_invokes = 0
+        self.canary_invokes = 0
+
+    def _pick_canary(self) -> bool:
+        with self._lock:
+            n = self._n
+            self._n += 1
+            hit = int((n + 1) * self.fraction) > int(n * self.fraction)
+            if hit:
+                self.canary_invokes += 1
+            else:
+                self.primary_invokes += 1
+            return hit
+
+    def invoke(self, inputs):
+        target = self.canary if self._pick_canary() else self.primary
+        return target.invoke(inputs)
+
+    def routing_stats(self) -> dict:
+        with self._lock:
+            return {"fraction": self.fraction,
+                    "primary_invokes": self.primary_invokes,
+                    "canary_invokes": self.canary_invokes}
+
+    def __getattr__(self, name):
+        return getattr(self.primary, name)
+
+
+class ModelSlots:
+    """The manager's named, versioned model slots."""
+
+    def __init__(self, manager):
+        self._manager = manager
+        self._slots: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    # -- definition ----------------------------------------------------------
+    def define(self, name: str, versions: Dict[str, str],
+               active: str) -> None:
+        """Create/replace a slot: ``versions`` maps version → model URI
+        (any form tensor_filter accepts). Publishes ``registry://name``."""
+        if active not in versions:
+            raise KeyError(f"slot '{name}': active version '{active}' not "
+                           f"in {sorted(versions)}")
+        with self._lock:
+            self._slots[name] = {"versions": dict(versions),
+                                 "active": active, "canary": None}
+        self._publish(name)
+
+    def add_version(self, name: str, version: str, uri: str) -> None:
+        with self._lock:
+            self._slot(name)["versions"][version] = uri
+        self._publish(name)
+
+    def _slot(self, name: str) -> dict:
+        if name not in self._slots:
+            raise KeyError(f"unknown model slot '{name}' "
+                           f"(have: {sorted(self._slots)})")
+        return self._slots[name]
+
+    def _publish(self, name: str) -> None:
+        """Mirror the slot into the process-local registry overlay so
+        ``model=registry://name`` resolves with no registry file."""
+        with self._lock:
+            slot = self._slot(name)
+            entry = {"versions": dict(slot["versions"]),
+                     "active": slot["active"]}
+        register_local_model(name, entry)
+
+    def unpublish_all(self) -> None:
+        with self._lock:
+            names = list(self._slots)
+        for n in names:
+            unregister_local_model(n)
+
+    def info(self, name: str) -> dict:
+        with self._lock:
+            slot = self._slot(name)
+            out = {"versions": dict(slot["versions"]),
+                   "active": slot["active"]}
+            canary = slot["canary"]
+        if canary is not None:
+            version, router = canary
+            out["canary"] = {"version": version, **router.routing_stats()}
+        return out
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._slots)
+
+    def uri(self, name: str, version: Optional[str] = None) -> str:
+        with self._lock:
+            slot = self._slot(name)
+            ver = version or slot["active"]
+            if ver not in slot["versions"]:
+                raise KeyError(f"slot '{name}' has no version '{ver}' "
+                               f"(have: {sorted(slot['versions'])})")
+            return slot["versions"][ver]
+
+    # -- live bindings -------------------------------------------------------
+    def bound_filters(self, name: str) -> List[Tuple[object, object]]:
+        """(service, tensor_filter element) pairs whose ``model`` property
+        references this slot un-pinned (``registry://name``; an ``@ver``
+        pin opts the element out of rollouts, same as the reference)."""
+        from ..elements.filter import TensorFilter
+
+        ref = f"registry://{name}"
+        out = []
+        for svc in self._manager.services():
+            pipe = svc.pipeline
+            if pipe is None:
+                continue
+            for el in pipe.elements.values():
+                if isinstance(el, TensorFilter) and el.props.get("model") == ref:
+                    out.append((svc, el))
+        return out
+
+    # -- hot swap ------------------------------------------------------------
+    def swap(self, name: str, version: str) -> dict:
+        """Roll every bound running filter to ``version`` (prepare → warmup
+        → flip → retire), then activate it for future starts. Rollback on
+        any warmup failure. Returns {"slot","version","flipped": N}."""
+        uri = self.uri(name, version)  # validates slot + version
+        with self._lock:
+            has_canary = self._slot(name)["canary"] is not None
+        if has_canary:
+            # a live canary router would be retired as 'old' by the flip,
+            # leaking its candidate backend — unwind it first so the flip
+            # retires a plain backend
+            self.cancel_canary(name)
+        bound = self.bound_filters(name)
+        prepared = self._prepare_all(bound, uri, name, version,
+                                     what=f"swap to '{version}'")
+        # phase 2: atomic flips (pointer store under each element's invoke
+        # lock) + retire the old backends. The element's model PROPERTY
+        # keeps the stable registry:// slot reference — a suspend/resume
+        # reopen resolves it against the new active version below
+        for el, backend in prepared:
+            old = el.commit_model(backend, f"registry://{name}")
+            el.release_prepared(old)
+        with self._lock:
+            self._slot(name)["active"] = version
+            self._slot(name)["canary"] = None
+        self._publish(name)
+        logger.info("slot %s: swapped to version %s (%d live filters "
+                    "flipped)", name, version, len(prepared))
+        return {"slot": name, "version": version, "flipped": len(prepared)}
+
+    def _prepare_all(self, bound, uri: str, name: str, version: str,
+                     what: str) -> List[Tuple[object, object]]:
+        """Phase 1 of any rollout: prepare + warmup EVERY bound element
+        before touching ANY live backend — all-or-nothing, with prepared
+        backends closed on the first failure."""
+        prepared: List[Tuple[object, object]] = []  # (element, new backend)
+        try:
+            for _svc, el in bound:
+                backend = el.prepare_model(uri)
+                self._warmup(el, backend, name, version)
+                prepared.append((el, backend))
+        except Exception as e:
+            for _el, backend in prepared:
+                try:
+                    backend.close()
+                except Exception:  # noqa: BLE001 - rollback is best-effort
+                    pass
+            raise SwapError(
+                f"slot '{name}' {what} rolled back: {e}") from e
+        return prepared
+
+    @staticmethod
+    def _warmup(el, backend, name: str, version: str) -> None:
+        """One inference on zeros shaped like the element's negotiated
+        input. No negotiated caps yet (service not started) ⇒ nothing to
+        warm against — the regular start-time warmup covers it."""
+        info = getattr(el, "_in_info", None)
+        if info is None or not info.specs:
+            return
+        zeros = [np.zeros(tuple(s.shape), dtype=s.dtype.np_dtype)
+                 for s in info.specs]
+        out = backend.invoke(zeros)
+        if not out:
+            raise SwapError(
+                f"slot '{name}' version '{version}': warmup inference "
+                "returned no outputs")
+
+    # -- canary --------------------------------------------------------------
+    def canary(self, name: str, version: str, fraction: float) -> dict:
+        """Route ``fraction`` of each bound filter's invokes to ``version``
+        (prepared + warmed like a swap), keeping the active version on the
+        rest. One canary per slot.
+
+        A canary is a LIVE-TRAFFIC experiment, not durable state: it lasts
+        until promoted or canceled. Stopping/restarting a bound service
+        (or a ``suspend=`` idle unload) reopens the filter at the slot's
+        ACTIVE version — end the experiment first; ``promote_canary``
+        refuses when no live router remains.
+        """
+        if not 0.0 < fraction < 1.0:
+            raise ValueError(f"canary fraction {fraction} must be in (0,1)")
+        uri = self.uri(name, version)
+        with self._lock:
+            if self._slot(name)["canary"] is not None:
+                raise SwapError(f"slot '{name}' already has a canary "
+                                "(promote or cancel it first)")
+        bound = self.bound_filters(name)
+        if not bound:
+            raise SwapError(f"slot '{name}': no running filter bound — "
+                            "canary needs live traffic to split")
+        routers = []
+        prepared = self._prepare_all(bound, uri, name, version,
+                                     what=f"canary '{version}'")
+        for el, backend in prepared:
+            router = _CanaryBackend(el.backend, backend, fraction)
+            el.commit_model(router, el.props["model"])  # model ref unchanged
+            routers.append(router)
+        with self._lock:
+            self._slot(name)["canary"] = (version, routers[0])
+        logger.info("slot %s: canary %s at %.0f%% across %d filters",
+                    name, version, fraction * 100, len(routers))
+        return {"slot": name, "canary": version, "fraction": fraction,
+                "filters": len(routers)}
+
+    def promote_canary(self, name: str) -> dict:
+        """Canary graduates: its backend becomes the active one everywhere,
+        the old primary retires, and the slot's active version advances."""
+        with self._lock:
+            canary = self._slot(name)["canary"]
+        if canary is None:
+            raise SwapError(f"slot '{name}' has no canary to promote")
+        version, _router = canary
+        flipped = 0
+        for _svc, el in self.bound_filters(name):
+            router = el.backend
+            if isinstance(router, _CanaryBackend):
+                el.commit_model(router.canary, el.props["model"])
+                el.release_prepared(router.primary)
+                flipped += 1
+        if flipped == 0:
+            # the routers are gone (service restarted / filter reopened at
+            # the active version): promoting would claim a version no live
+            # element is serving
+            with self._lock:
+                self._slot(name)["canary"] = None
+            raise SwapError(
+                f"slot '{name}': canary '{version}' no longer live (bound "
+                "services restarted?) — canary cleared, active version "
+                "unchanged; rerun canary() or swap()")
+        with self._lock:
+            self._slot(name)["active"] = version
+            self._slot(name)["canary"] = None
+        self._publish(name)
+        return {"slot": name, "version": version, "promoted": True,
+                "flipped": flipped}
+
+    def cancel_canary(self, name: str) -> dict:
+        """Abort the canary: candidate backends close, the primary keeps
+        serving 100% again."""
+        with self._lock:
+            canary = self._slot(name)["canary"]
+        if canary is None:
+            raise SwapError(f"slot '{name}' has no canary to cancel")
+        version, _router = canary
+        for _svc, el in self.bound_filters(name):
+            router = el.backend
+            if isinstance(router, _CanaryBackend):
+                el.commit_model(router.primary, el.props["model"])
+                try:
+                    router.canary.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        with self._lock:
+            self._slot(name)["canary"] = None
+        return {"slot": name, "canceled": version}

