@@ -43,15 +43,87 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # globally with NNS_TEST_TIMEOUT (0 disables).
 import signal
 import threading
+import time
 
 import pytest
 
 _DEFAULT_TEST_TIMEOUT = float(os.environ.get("NNS_TEST_TIMEOUT", "180"))
 
+# ---------------------------------------------------------------------------
+# tsan-lite: NNS_TSAN=1 runs the whole session with the runtime lock-order
+# sanitizer enabled (CI runs the chaos/service/serving suites this way).
+# Enabling happens at conftest import — BEFORE test modules construct any
+# package object — so every named lock created during the session is
+# instrumented. Each test then asserts no lock-order violation was
+# observed during ITS span (see _tsan_check below).
+# ---------------------------------------------------------------------------
+_TSAN = os.environ.get("NNS_TSAN", "") == "1"
+if _TSAN:
+    from nnstreamer_tpu.analysis import sanitizer as _sanitizer
+
+    _sanitizer.enable(
+        hold_warn_s=float(os.environ.get("NNS_TSAN_HOLD_S", "5")))
+
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "timeout_s(n): per-test watchdog seconds (default 180)")
+    config.addinivalue_line(
+        "markers", "thread_leak_ok: opt out of the per-test leaked-thread "
+                   "check (intentionally long-lived fixture threads)")
+
+
+@pytest.fixture(autouse=True)
+def _tsan_check(request):
+    """Under NNS_TSAN=1: fail any test during which the sanitizer observed
+    a lock-order violation (the observed acquisition graph went cyclic)."""
+    if not _TSAN:
+        yield
+        return
+    before = len(_sanitizer.violations())
+    yield
+    fresh = _sanitizer.violations()[before:]
+    assert not fresh, (
+        f"tsan-lite: {len(fresh)} lock-order violation(s) observed during "
+        f"this test: {fresh}")
+
+
+# thread names owned by the control plane / serving layers — all of them
+# have an explicit stop+join path now, so a survivor is a real leak
+_JOINED_THREAD_PREFIXES = (
+    "svc:", "svc-http:", "serving:", "queue:", "src:", "qserver:",
+    "mqtt-broker:", "broker:",
+)
+
+
+@pytest.fixture(autouse=True)
+def thread_leak_check(request):
+    """Snapshot live threads per test; fail on leaked non-daemon threads
+    and on leaked control-plane threads (which must be joined on stop).
+    Opt out with @pytest.mark.thread_leak_ok."""
+    if request.node.get_closest_marker("thread_leak_ok"):
+        yield
+        return
+    before = set(threading.enumerate())
+    yield
+
+    def leaked():
+        return [
+            t for t in threading.enumerate()
+            if t not in before and t.is_alive()
+            and (not t.daemon or t.name.startswith(_JOINED_THREAD_PREFIXES))
+        ]
+
+    # grace: teardown-time stops may still be joining
+    deadline = time.monotonic() + 2.0
+    rest = leaked()
+    while rest and time.monotonic() < deadline:
+        time.sleep(0.05)
+        rest = leaked()
+    assert not rest, (
+        "leaked threads (not joined by the test's teardown): "
+        + ", ".join(f"{t.name}{'' if t.daemon else ' [non-daemon]'}"
+                    for t in rest))
 
 
 @pytest.fixture(autouse=True)

@@ -14,13 +14,26 @@ runtime, during caps negotiation). Two passes share one diagnostic model:
 * **source lint** (`lint_source`, rules ``NNL1xx``): AST checks over our
   own tree — host syncs and scalar pulls in element/scheduler hot loops,
   bare/silent excepts in chain paths, blocking calls in batch-formation
-  sections, Python branching on tracer parameters in jitted functions.
+  sections, Python branching on tracer parameters in jitted functions;
+* **concurrency lint** (`lint_concurrency`, rules ``NNL2xx``): lock-order
+  inversions over an interprocedural lock-order graph, unguarded shared
+  state (``# guarded-by:`` contracts), blocking calls under locks,
+  ``Condition.wait`` without a predicate loop, threads without a join
+  path — see docs/concurrency.md for the locking model it checks.
+
+The static pass is paired with a runtime "tsan-lite" sanitizer
+(:mod:`.sanitizer`): the control plane creates its locks through
+``sanitizer.named_lock``-style factories, which return raw ``threading``
+primitives when disabled (zero overhead) and order-recording wrappers
+when enabled (``NNS_TSAN=1`` in the test suite).
 
 CLI: ``python -m nnstreamer_tpu lint <pbtxt | launch-string | pkg>``
-(also ``tools/nnlint.py`` — the self-lint CI gate). Intentional findings
-are suppressed in-source with ``# nnlint: disable=NNL1xx`` pragmas.
+(also ``tools/nnlint.py`` — the self-lint CI gate; ``--rules NNL2xx``
+restricts to one rule family). Intentional findings are suppressed
+in-source with ``# nnlint: disable=NNL1xx`` pragmas.
 See docs/lint.md for the rule catalog.
 """
+from .concurrency_lint import lint_concurrency  # noqa: F401
 from .diagnostics import RULES, Diagnostic, Severity  # noqa: F401
 from .graph_lint import lint_launch, lint_pbtxt, lint_pipeline  # noqa: F401
 from .source_lint import lint_source  # noqa: F401
@@ -29,6 +42,7 @@ __all__ = [
     "RULES",
     "Diagnostic",
     "Severity",
+    "lint_concurrency",
     "lint_launch",
     "lint_pbtxt",
     "lint_pipeline",

@@ -33,11 +33,16 @@ def add_lint_args(parser) -> None:
                              "default fails only on errors")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit findings as a JSON array")
-    parser.add_argument("--rules", action="store_true", dest="list_rules",
-                        help="print the rule catalog and exit")
+    parser.add_argument("--rules", nargs="?", const="list", default=None,
+                        dest="rules", metavar="FILTER",
+                        help="no value: print the rule catalog and exit; "
+                             "with a value: only report matching rules — "
+                             "comma-separated IDs or families "
+                             "(e.g. NNL201 or NNL2xx)")
 
 
 def _lint_target(target: str) -> List[Diagnostic]:
+    from .concurrency_lint import lint_concurrency
     from .graph_lint import lint_launch, lint_pbtxt
     from .source_lint import lint_source
 
@@ -45,7 +50,8 @@ def _lint_target(target: str) -> List[Diagnostic]:
 
     p = Path(target)
     if p.is_dir() or p.suffix == ".py":
-        return lint_source([p], root=str(p.parent))
+        root = str(p.parent)
+        return lint_source([p], root=root) + lint_concurrency([p], root=root)
     if p.suffix in (".pbtxt", ".launch", ".json"):
         try:
             text = p.read_text()
@@ -66,8 +72,21 @@ def _lint_target(target: str) -> List[Diagnostic]:
     return lint_launch(target)
 
 
+def _rule_filter(spec: str):
+    """Predicate for a ``--rules`` FILTER: comma-separated exact IDs or
+    ``xx``-suffixed family patterns (``NNL2xx`` = every NNL2 rule)."""
+    tokens = [t.strip() for t in spec.split(",") if t.strip()]
+    exact = {t for t in tokens if not t.lower().endswith("xx")}
+    prefixes = tuple(t[:-2] for t in tokens if t.lower().endswith("xx"))
+
+    def match(rule_id: str) -> bool:
+        return rule_id in exact or (bool(prefixes)
+                                    and rule_id.startswith(prefixes))
+    return match
+
+
 def run_lint(args) -> int:
-    if args.list_rules:
+    if args.rules == "list":
         for rule in RULES.values():
             print(f"{rule.id}  {rule.severity.value:7s} {rule.title}")
             print(f"    {rule.rationale}")
@@ -80,6 +99,9 @@ def run_lint(args) -> int:
     diags: List[Diagnostic] = []
     for target in args.targets:
         diags.extend(_lint_target(target))
+    if args.rules:
+        match = _rule_filter(args.rules)
+        diags = [d for d in diags if match(d.rule)]
     if args.as_json:
         print(json.dumps([d.to_dict() for d in diags], indent=2))
     else:

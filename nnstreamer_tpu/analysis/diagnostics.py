@@ -107,6 +107,27 @@ _RULES = (
          "a function handed to jax.jit branches (if/while) on a parameter "
          "value: under trace the parameter is a tracer and the branch "
          "either fails or silently bakes in one path"),
+    # -- concurrency lint (pass 3) -------------------------------------------
+    Rule("NNL201", Severity.ERROR, "lock-order inversion",
+         "two locks are acquired in opposite nesting orders on different "
+         "code paths — two threads interleaving those paths deadlock; "
+         "every path must acquire locks in one global order"),
+    Rule("NNL202", Severity.WARNING, "unguarded shared state",
+         "an attribute declared '# guarded-by: <lock>' (or written under a "
+         "lock elsewhere in the class) is also written with no lock held — "
+         "a concurrent reader can observe torn/stale state"),
+    Rule("NNL203", Severity.WARNING, "blocking call while holding a lock",
+         "a lock is held across a blocking operation (sleep, subprocess, "
+         "socket I/O, indefinite get()/wait()/join(), block_until_ready) — "
+         "every thread contending the lock stalls for the full call"),
+    Rule("NNL204", Severity.WARNING, "Condition.wait without predicate loop",
+         "a Condition.wait outside a while-loop re-check: spurious wakeups "
+         "and stolen notifications make the waiter proceed on a false "
+         "predicate — wrap the wait in 'while not predicate:'"),
+    Rule("NNL205", Severity.WARNING, "thread without join/stop path",
+         "a thread is started with no reachable join in its owning class "
+         "(or fire-and-forget): shutdown leaks it, and a daemon thread "
+         "dying mid-operation can corrupt shared state"),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in _RULES}

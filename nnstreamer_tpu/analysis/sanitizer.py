@@ -1,0 +1,345 @@
+"""tsan-lite: opt-in runtime lock-order sanitizer for the control plane.
+
+The static concurrency pass (:mod:`.concurrency_lint`) reasons about
+lock nesting it can SEE; this module records the nesting that actually
+HAPPENS. The package's threaded layers (service manager/supervisor,
+serving queue, runtime pipeline/queue, the filter invoke lock) create
+their locks through the named factories here:
+
+    from ..analysis.sanitizer import named_lock
+    self._lock = named_lock("Service._lock")
+
+**Disabled (the default), the factories return raw ``threading``
+primitives** — no wrapper object, no extra frame, zero steady-state
+overhead; the only cost is one function call at construction
+(``tools/bench_service.py --smoke`` asserts this bypass). Enabled
+(:func:`enable`, or ``NNS_TSAN=1`` under pytest — see conftest.py),
+they return instrumented wrappers that
+
+* record each thread's lock-acquisition nesting into a global
+  lock-order graph (edge ``A → B`` = ``B`` acquired while ``A`` held);
+* assert the observed graph stays **acyclic** — a cycle means two
+  threads have taken the same locks in opposite orders, i.e. a
+  deadlock waiting for the right interleaving (recorded as a
+  violation, surfaced by the test fixture);
+* flag holds longer than ``hold_warn_s`` (a lock held across a slow
+  call starves every contender);
+* expose everything via :func:`report` / :func:`violations`.
+
+Enable/disable affects locks created AFTERWARDS — wrappers already
+handed out keep recording (harmless; :func:`reset` clears the tables).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_state = threading.Lock()   # guards the module tables below
+_enabled = False
+_hold_warn_s = 1.0
+_edges: Dict[Tuple[str, str], dict] = {}   # (a, b) -> {count, sites, threads}
+_violations: List[dict] = []
+_long_holds: List[dict] = []
+_acquire_counts: Dict[str, int] = {}
+_tls = threading.local()
+
+
+# ---------------------------------------------------------------------------
+# control surface
+# ---------------------------------------------------------------------------
+
+def enable(hold_warn_s: float = 1.0) -> None:
+    """Instrument locks created from now on; also resets the tables."""
+    global _enabled, _hold_warn_s
+    reset()
+    with _state:
+        _enabled = True
+        _hold_warn_s = float(hold_warn_s)
+
+
+def disable() -> None:
+    global _enabled
+    with _state:
+        _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Clear every recorded edge/violation/hold (between test phases)."""
+    with _state:
+        _edges.clear()
+        _violations.clear()
+        _long_holds.clear()
+        _acquire_counts.clear()
+
+
+def violations() -> List[dict]:
+    with _state:
+        return list(_violations)
+
+
+def report() -> dict:
+    """Everything observed so far (JSON-friendly)."""
+    with _state:
+        return {
+            "enabled": _enabled,
+            "hold_warn_s": _hold_warn_s,
+            "locks": dict(_acquire_counts),
+            "edges": [
+                {"from": a, "to": b, **info}
+                for (a, b), info in sorted(_edges.items())
+            ],
+            "violations": list(_violations),
+            "long_holds": list(_long_holds),
+        }
+
+
+# ---------------------------------------------------------------------------
+# factories — the ONLY public way the package creates named locks
+# ---------------------------------------------------------------------------
+
+def named_lock(name: str):
+    """A ``threading.Lock`` (disabled) or an order-recording wrapper."""
+    if not _enabled:
+        return threading.Lock()
+    return _TsanLock(name, threading.Lock())
+
+
+def named_rlock(name: str):
+    if not _enabled:
+        return threading.RLock()
+    return _TsanLock(name, threading.RLock(), reentrant=True)
+
+
+def named_condition(name: str, lock=None):
+    """A Condition over ``lock`` (a lock returned by :func:`named_lock`,
+    or None for a private one). Waiting releases the lock — the wrapper
+    keeps the held-stack bookkeeping consistent across the wait."""
+    if not _enabled:
+        if isinstance(lock, _TsanLock):  # created while enabled, mixed use
+            return _TsanCondition(name, lock)
+        return threading.Condition(lock)
+    if lock is None:
+        lock = _TsanLock(name + ".lock", threading.Lock())
+    elif not isinstance(lock, _TsanLock):
+        lock = _TsanLock(name + ".lock", lock)
+    return _TsanCondition(name, lock)
+
+
+# ---------------------------------------------------------------------------
+# bookkeeping
+# ---------------------------------------------------------------------------
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def _depths() -> dict:
+    d = getattr(_tls, "depths", None)
+    if d is None:
+        d = _tls.depths = {}
+    return d
+
+
+def _site(skip: int = 2) -> str:
+    """First caller frame OUTSIDE this module (the user-code acquire)."""
+    try:
+        f = sys._getframe(skip)
+        while f is not None and f.f_code.co_filename == __file__:
+            f = f.f_back
+        if f is None:
+            return "?"
+        return f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+    except (ValueError, AttributeError):
+        return "?"
+
+
+def _note_acquire(lock: "_TsanLock") -> None:
+    depths = _depths()
+    d = depths.get(id(lock), 0)
+    depths[id(lock)] = d + 1
+    if d:
+        return  # reentrant re-acquire: no new node on the stack
+    stack = _stack()
+    site = _site(2)
+    if stack:
+        _record_edge(stack[-1][0].name, lock.name, site)
+    with _state:
+        _acquire_counts[lock.name] = _acquire_counts.get(lock.name, 0) + 1
+    stack.append((lock, time.monotonic(), site))
+
+
+def _note_release(lock: "_TsanLock") -> None:
+    depths = _depths()
+    d = depths.get(id(lock), 0)
+    if d > 1:
+        depths[id(lock)] = d - 1
+        return
+    depths.pop(id(lock), None)
+    stack = _stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] is lock:
+            _, t0, site = stack.pop(i)
+            held = time.monotonic() - t0
+            if held > _hold_warn_s:
+                with _state:
+                    _long_holds.append({
+                        "lock": lock.name, "held_s": round(held, 3),
+                        "acquired_at": site,
+                        "thread": threading.current_thread().name})
+            return
+
+
+def _record_edge(a: str, b: str, site: str) -> None:
+    tname = threading.current_thread().name
+    with _state:
+        info = _edges.get((a, b))
+        fresh = info is None
+        if fresh:
+            info = _edges[(a, b)] = {"count": 0, "sites": [], "threads": []}
+        info["count"] += 1
+        if len(info["sites"]) < 4 and site not in info["sites"]:
+            info["sites"].append(site)
+        if tname not in info["threads"]:
+            info["threads"].append(tname)
+        if not fresh:
+            return
+        if a == b:
+            # two INSTANCES sharing a name nested (same-object recursion
+            # on a plain Lock would have deadlocked before reaching us).
+            # One consistent nesting is not a deadlock — recorded as an
+            # edge for visibility, excluded from cycle detection (give
+            # the locks per-instance names to order instances)
+            return
+        cycle = _find_path_locked(b, a)
+        if cycle is not None:
+            _violations.append({
+                "type": "lock-order",
+                "edge": [a, b],
+                "cycle": [a] + cycle,
+                "site": site,
+                "thread": tname,
+            })
+
+
+def _find_path_locked(src: str, dst: str) -> Optional[List[str]]:
+    """Path src → … → dst over the observed edges, self-edges excluded
+    (caller holds _state)."""
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in _edges:
+        if a != b:
+            adj.setdefault(a, []).append(b)
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, p = stack.pop()
+        for nxt in adj.get(node, ()):
+            if nxt == dst:
+                return p + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, p + [nxt]))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+class _TsanLock:
+    """Order-recording proxy over a Lock/RLock."""
+
+    __slots__ = ("name", "_inner", "_reentrant")
+
+    def __init__(self, name: str, inner, reentrant: bool = False):
+        self.name = name
+        self._inner = inner
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        _note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _TsanCondition:
+    """Condition proxy sharing a :class:`_TsanLock`'s bookkeeping: the
+    wait path records the implicit release/re-acquire so the per-thread
+    held stack stays truthful across the block."""
+
+    __slots__ = ("name", "_lockw", "_inner")
+
+    def __init__(self, name: str, lockw: _TsanLock):
+        self.name = name
+        self._lockw = lockw
+        self._inner = threading.Condition(lockw._inner)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._lockw.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._lockw.release()
+
+    def __enter__(self):
+        self._lockw.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lockw.release()
+        return False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        _note_release(self._lockw)
+        try:
+            # nnlint: disable=NNL204 — pass-through proxy: the predicate
+            # loop is the CALLER's contract (this frame has no predicate
+            # to check), same as threading.Condition.wait itself
+            return self._inner.wait(timeout)
+        finally:
+            _note_acquire(self._lockw)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()

@@ -40,6 +40,7 @@ from ..core import (
     clock_now,
     tensors_info_from_caps,
 )
+from ..analysis.sanitizer import named_lock
 from ..registry.config import get_config
 from ..registry.elements import register_element
 from ..registry.subplugin import SubpluginKind, names as subplugin_names
@@ -180,10 +181,15 @@ class TensorFilter(TransformElement):
         self._in_info: Optional[TensorsInfo] = None
         self._out_info: Optional[TensorsInfo] = None
         self._throttle_delay_s = 0.0
-        self._last_invoke_ts = 0.0  # last completed invoke (suspend idle clock)
         self._last_accept_ts = 0.0  # last accepted frame (QoS throttle gate)
         self._model_view_info: Optional[TensorsInfo] = None
-        self._backend_lock = threading.Lock()  # suspend/resume vs invoke
+        # THE invoke lock: suspend/resume unloads and hot-swap commit_model
+        # flips race steady-state invokes through it (per-instance name —
+        # pipelines run many filters)
+        self._backend_lock = named_lock(
+            f"TensorFilter._backend_lock:{self.name}")
+        # last completed invoke (suspend idle clock)
+        self._last_invoke_ts = 0.0  # guarded-by: _backend_lock
         self._suspend_thread: Optional[threading.Thread] = None
         self._suspend_stop = threading.Event()
         self._validate_model_ref()
@@ -379,7 +385,8 @@ class TensorFilter(TransformElement):
         if self.props["suspend"] > 0 and self._suspend_thread is None:
             # baseline the idle clock: 0.0 would read as hours idle and
             # unload the just-opened backend on the first tick
-            self._last_invoke_ts = clock_now()
+            with self._backend_lock:
+                self._last_invoke_ts = clock_now()
             self._suspend_stop.clear()
             self._suspend_thread = threading.Thread(
                 target=self._suspend_watch, name=f"{self.name}:suspend",

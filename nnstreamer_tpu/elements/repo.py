@@ -9,6 +9,7 @@ gsttensor_repo.h:44-62).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Deque, Dict, Optional
 
@@ -52,9 +53,16 @@ class _Slot:
             self.cond.notify_all()
 
     def pop(self, timeout: float) -> Optional[Buffer]:
+        deadline = time.monotonic() + timeout
         with self.cond:
-            if not self.q and not self.eos:
-                self.cond.wait(timeout)
+            # predicate loop: a spurious wakeup (or a notify consumed by
+            # another waiter) must re-wait the REMAINING budget, not
+            # return an early None
+            while not self.q and not self.eos:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self.cond.wait(remaining)
             return self.q.popleft() if self.q else None
 
     def set_eos(self) -> None:

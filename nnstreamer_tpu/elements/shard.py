@@ -92,8 +92,9 @@ class TensorUnshard(Element):
 
     def reset_flow(self) -> None:
         super().reset_flow()
-        self._heap = []
-        self._next = 0
+        with self._join_lock:  # vs branch threads still chaining at stop
+            self._heap = []
+            self._next = 0
 
     def maybe_negotiate(self) -> None:
         linked = [p for p in self.sink_pads if p.is_linked and p.caps is not None]

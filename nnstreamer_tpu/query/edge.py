@@ -16,7 +16,9 @@ from typing import Dict, List, Optional, Tuple
 from ..core import Buffer, Caps, parse_caps_string
 from ..core.serialize import pack_tensors, unpack_tensors
 from ..utils.log import logger
+from ..utils.threads import ThreadRegistry
 from .protocol import MsgType, recv_msg, send_msg
+from .server import _shutdown_close
 
 
 class PubSubBroker:
@@ -34,6 +36,10 @@ class PubSubBroker:
         self._running = threading.Event()
         self._running.set()
         self.refcount = 1
+        # per-connection handshake threads: stop() shuts each conn down
+        # (a handshake parked in recv only wakes on shutdown) then joins
+        # — promoted subscriber sockets just get closed twice
+        self._conn_reg = ThreadRegistry()
         self._thread = threading.Thread(target=self._accept_loop,
                                         name=f"broker:{self.port}", daemon=True)
         self._thread.start()
@@ -62,8 +68,16 @@ class PubSubBroker:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            threading.Thread(target=self._handshake, args=(conn,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._handshake, args=(conn,),
+                                 name=f"broker:{self.port}:handshake",
+                                 daemon=True)
+            t.start()
+            self._conn_reg.track(
+                t, closer=lambda c=conn: _shutdown_close(c))
+            if not self._running.is_set():
+                # stop() may have drained the registry between accept
+                # and track — wake the worker ourselves
+                _shutdown_close(conn)
 
     def _handshake(self, conn: socket.socket) -> None:
         try:
@@ -94,8 +108,6 @@ class PubSubBroker:
             pass
 
     def stop(self) -> None:
-        from .server import _shutdown_close
-
         self._running.clear()
         _shutdown_close(self._sock)
         with self._lock:
@@ -107,6 +119,9 @@ class PubSubBroker:
             except OSError:
                 pass
             _shutdown_close(s)
+        self._thread.join(timeout=2.0)
+        # closers wake handshakes parked in recv, then they join
+        self._conn_reg.drain(timeout_per=1.0)
 
 
 class Subscriber:
@@ -149,7 +164,9 @@ class Subscriber:
         from .server import _shutdown_close
 
         self._running.clear()
-        _shutdown_close(self._sock)
+        _shutdown_close(self._sock)  # wakes the read loop
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=2.0)
 
 
 # broker registry: edgesinks on the same (host,port) share one broker

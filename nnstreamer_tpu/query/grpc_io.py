@@ -439,14 +439,17 @@ class GrpcTensorClient:
                 except Exception as e:  # noqa: BLE001 — surfaced below
                     box.put(("err", e))
 
-            threading.Thread(target=_first, daemon=True).start()
+            first_thread = threading.Thread(target=_first, daemon=True)
+            first_thread.start()
             try:
                 kind, val = box.get(timeout=self._timeout)
             except _queue.Empty:
-                stream.cancel()
+                stream.cancel()  # unblocks next(stream) in the helper
+                first_thread.join(timeout=1.0)
                 raise ConnectionError(
                     f"grpc ext Recv: no frame within {self._timeout}s "
                     "(remote negotiated but never published?)")
+            first_thread.join(timeout=1.0)
             if kind == "err":
                 raise ConnectionError(
                     f"grpc ext Recv stream ended before the first frame: {val}")

@@ -23,6 +23,20 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.log import logger
+from ..utils.threads import ThreadRegistry
+
+
+def _closer(conn: socket.socket):
+    """Idempotent wake+close for a socket a worker thread is recv-ing on
+    (plain close() does not reliably wake a blocked recv; shutdown does)."""
+    def close() -> None:
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        conn.close()
+    return close
+
 
 # packet types (high nibble of the fixed header)
 CONNECT, CONNACK = 1, 2
@@ -82,6 +96,10 @@ def _read_packet(sock: socket.socket) -> Optional[Tuple[int, int, bytes]]:
 
 def _send_packet(sock: socket.socket, ptype: int, payload: bytes,
                  flags: int = 0) -> None:
+    # nnlint: disable=NNL203 — deliberate: callers hold their write lock
+    # ACROSS this sendall precisely so concurrent publishers cannot
+    # interleave partial MQTT frames on the shared socket; the lock's
+    # whole job is to serialize the blocking write
     sock.sendall(bytes([ptype << 4 | flags]) + _encode_len(len(payload)) + payload)
 
 
@@ -125,11 +143,13 @@ class MqttClient:
         self._sock.settimeout(None)
         self._running = threading.Event()
         self._running.set()
+        self._stop_evt = threading.Event()  # wakes the pinger immediately
         self._thread = threading.Thread(target=self._read_loop,
                                         name="mqtt-client", daemon=True)
         self._thread.start()
         self._keep_alive = keep_alive
-        self._pinger = threading.Thread(target=self._ping_loop, daemon=True)
+        self._pinger = threading.Thread(target=self._ping_loop,
+                                        name="mqtt-pinger", daemon=True)
         self._pinger.start()
 
     # -- api ----------------------------------------------------------------
@@ -153,6 +173,7 @@ class MqttClient:
 
     def close(self) -> None:
         self._running.clear()
+        self._stop_evt.set()
         try:
             with self._write_lock:
                 _send_packet(self._sock, DISCONNECT, b"")
@@ -163,12 +184,16 @@ class MqttClient:
         except OSError:
             pass
         self._sock.close()
+        # the socket shutdown wakes the read loop; the stop event wakes
+        # the pinger out of its keep-alive sleep — both join promptly
+        for t in (self._thread, self._pinger):
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
 
     # -- internals ----------------------------------------------------------
     def _ping_loop(self) -> None:
         interval = max(self._keep_alive - 5, 5)
-        while self._running.is_set():
-            time.sleep(interval)
+        while not self._stop_evt.wait(interval):
             if not self._running.is_set():
                 return
             try:
@@ -224,6 +249,11 @@ class MiniBroker:
         self._running = threading.Event()
         self._running.set()
         self.refcount = 1
+        # per-connection serve threads: stop() must CLOSE each conn (a
+        # publish-only client's _serve thread is parked in a blocking
+        # recv that only a shutdown wakes) before joining — the registry
+        # carries the closer alongside the thread
+        self._conn_reg = ThreadRegistry()
         self._thread = threading.Thread(target=self._accept_loop,
                                         name=f"mqtt-broker:{self.port}",
                                         daemon=True)
@@ -235,7 +265,15 @@ class MiniBroker:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 name=f"mqtt-broker:{self.port}:conn",
+                                 daemon=True)
+            t.start()
+            self._conn_reg.track(t, closer=_closer(conn))
+            if not self._running.is_set():
+                # stop() may have drained the registry between accept and
+                # track — close the conn ourselves so the worker exits
+                _closer(conn)()
 
     def _serve(self, conn: socket.socket) -> None:
         write_lock = threading.Lock()
@@ -319,6 +357,9 @@ class MiniBroker:
                 c.close()
             except OSError:
                 pass
+        self._thread.join(timeout=2.0)
+        # closers wake _serve threads parked in recv, then they join
+        self._conn_reg.drain(timeout_per=1.0)
 
 
 # shared in-process brokers keyed by port (mqttsrc/sink with broker="embedded")

@@ -17,6 +17,7 @@ from typing import Callable, Dict, Optional
 from ..core import Buffer, Caps, parse_caps_string
 from ..core.serialize import pack_tensors, unpack_tensors
 from ..utils.log import logger
+from ..utils.threads import ThreadRegistry
 from .protocol import MsgType, recv_msg, send_msg
 
 
@@ -63,6 +64,7 @@ class QueryServer:
         self._running = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._serve_thread: Optional[threading.Thread] = None
+        self._client_threads = ThreadRegistry()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "QueryServer":
@@ -83,6 +85,8 @@ class QueryServer:
             self._clients.clear()
         for c in clients:
             _shutdown_close(c)
+        # client sockets just closed above: the loops exit promptly
+        self._client_threads.drain(timeout_per=1.0)
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=2.0)
             self._accept_thread = None
@@ -167,10 +171,17 @@ class QueryServer:
                 client_id = self._next_id
                 self._next_id += 1
                 self._clients[client_id] = conn
-            threading.Thread(
+            t = threading.Thread(
                 target=self._client_loop, args=(client_id, conn),
                 name=f"qserver:{self.port}:c{client_id}", daemon=True
-            ).start()
+            )
+            t.start()
+            self._client_threads.track(
+                t, closer=lambda c=conn: _shutdown_close(c))
+            if not self._running.is_set():
+                # stop() may have snapshotted _clients and drained the
+                # registry between accept and track — wake the worker
+                _shutdown_close(conn)
 
     def _client_loop(self, client_id: int, conn: socket.socket) -> None:
         try:

@@ -15,6 +15,7 @@ import traceback
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ..analysis.sanitizer import named_lock
 from ..core import Buffer, Caps, Event, EventType, Message, MessageType
 from ..utils.log import logger
 from .pad import Pad, PadDirection, PadPresence, PadTemplate
@@ -92,8 +93,10 @@ class Element:
         self.sink_pads: List[Pad] = []
         self.src_pads: List[Pad] = []
         self._negotiated = False
-        self._eos_sent = False
-        self._lock = threading.Lock()
+        # per-instance name: EOS can cascade element-to-element, and two
+        # elements' latches must stay distinct lock-order graph nodes
+        self._lock = named_lock(f"Element._lock:{name}")
+        self._eos_sent = False  # guarded-by: _lock
         self.props: Dict[str, Any] = {}
         merged: Dict[str, Prop] = {}
         for klass in reversed(cls.__mro__):
@@ -256,7 +259,8 @@ class Element:
         stop(): EOS latches and negotiated caps are cleared (caps are
         re-announced by sources on the next start). Override to clear
         element-specific accumulation; always call super()."""
-        self._eos_sent = False
+        with self._lock:
+            self._eos_sent = False
         self._negotiated = False
         for pad in self.sink_pads + self.src_pads:
             pad.got_eos = False

@@ -11,8 +11,10 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Set
 
+from ..analysis.sanitizer import named_lock, named_rlock
 from ..core import Message, MessageType
 from ..utils.log import logger
+from ..utils.threads import ThreadRegistry
 from .element import Element, SinkElement, SourceElement
 
 
@@ -60,8 +62,16 @@ class Pipeline:
         # running-time anchor, set at each play() (GStreamer base_time analog)
         self.play_t0_mono: Optional[float] = None
         self._playing = False
-        self._eos_sinks: Set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("Pipeline._lock")
+        self._eos_sinks: Set[str] = set()  # guarded-by: _lock
+        # serializes play()/stop()/error-halt so a stale halt (spawned
+        # for a run that a supervised restart has since replaced) can
+        # never stop the NEW run's sources. Element threads must never
+        # take this lock (play/stop join them while holding it) — the
+        # error path only READS the epoch and spawns, it does not block.
+        self._state_lock = named_rlock("Pipeline._state_lock")
+        self._play_epoch = 0  # guarded-by: _state_lock
+        self._halt_threads = ThreadRegistry()
         # -- control-plane hooks (service layer) -----------------------------
         # buffers rendered at ANY sink since the last play(); the service
         # health watchdog reads this as "is data still making it through"
@@ -129,40 +139,50 @@ class Pipeline:
 
     # -- state --------------------------------------------------------------
     def play(self) -> "Pipeline":
-        if self._playing:
-            return self
-        from ..utils import trace
+        with self._state_lock:
+            if self._playing:
+                return self
+            from ..utils import trace
 
-        trace.install_from_env()   # NNS_TRACERS (GST_TRACERS analog)
-        trace.dump_dot(self)       # NNS_DOT_DIR (GST_DEBUG_DUMP_DOT_DIR)
-        if self.validate:
-            self._run_static_validation()
-        self._validate_links()
-        self._playing = True
-        self.play_t0_mono = time.monotonic()
-        self.sink_buffer_count = 0
-        self._eos_sinks.clear()
-        for el in self.elements.values():
-            el.reset_flow()
-        # start non-sources first so queues/filters are ready before data flows
-        for el in self.elements.values():
-            if not isinstance(el, SourceElement):
+            trace.install_from_env()   # NNS_TRACERS (GST_TRACERS analog)
+            trace.dump_dot(self)       # NNS_DOT_DIR (GST_DEBUG_DUMP_DOT_DIR)
+            if self.validate:
+                self._run_static_validation()
+            self._validate_links()
+            self._playing = True
+            self._play_epoch += 1
+            self.play_t0_mono = time.monotonic()
+            self.sink_buffer_count = 0
+            with self._lock:
+                self._eos_sinks.clear()
+            for el in self.elements.values():
+                el.reset_flow()
+            # start non-sources first so queues/filters are ready before
+            # data flows
+            for el in self.elements.values():
+                if not isinstance(el, SourceElement):
+                    el.start()
+            for el in self.sources:
                 el.start()
-        for el in self.sources:
-            el.start()
+        # notify OUTSIDE the state lock: listeners (the service layer)
+        # take their own locks, and holding ours across them would order
+        # Pipeline._state_lock -> Service._lock against the start() path
         self.bus.post(Message(MessageType.STATE_CHANGED, self.name, {"state": "playing"}))
         self._notify_state("playing", self.name, {})
         return self
 
     def stop(self) -> "Pipeline":
-        if not self._playing:
-            return self
-        self._playing = False
-        for el in self.sources:
-            el.stop()
-        for el in self.elements.values():
-            if not isinstance(el, SourceElement):
+        with self._state_lock:
+            if not self._playing:
+                return self
+            self._playing = False
+            for el in self.sources:
                 el.stop()
+            for el in self.elements.values():
+                if not isinstance(el, SourceElement):
+                    el.stop()
+        # joined outside _state_lock — the halt threads acquire it
+        self._halt_threads.drain(timeout_per=2.0)
         self.bus.post(Message(MessageType.STATE_CHANGED, self.name, {"state": "stopped"}))
         self._notify_state("stopped", self.name, {})
         return self
@@ -238,17 +258,29 @@ class Pipeline:
         producing immediately, the app still owns final stop())."""
         if not self._playing:
             return
-        threading.Thread(target=self._halt_sources, daemon=True,
-                         name=f"{self.name}:error-halt").start()
+        # epoch-stamped + tracked (joined by stop()), not fire-and-forget.
+        # The stamp closes a TOCTOU race: this thread can be descheduled
+        # between the _playing check and the halt running, a supervised
+        # restart replaces the run meanwhile, and an unstamped halt would
+        # then silently stop the NEW run's sources (no EOS, no error —
+        # the service parks READY forever).
+        t = threading.Thread(
+            target=self._halt_sources, args=(self._play_epoch,),
+            daemon=True, name=f"{self.name}:error-halt")
+        t.start()
+        self._halt_threads.track(t)
         self._notify_state("error", element.name,
                            {"element": element.name, "error": error})
 
-    def _halt_sources(self) -> None:
-        for el in self.sources:
-            try:
-                el.stop()
-            except Exception:  # noqa: BLE001 - best-effort halt
-                logger.exception("error stopping %s", el.name)
+    def _halt_sources(self, epoch: int) -> None:
+        with self._state_lock:
+            if epoch != self._play_epoch or not self._playing:
+                return  # a restart replaced the run this halt belongs to
+            for el in self.sources:
+                try:
+                    el.stop()
+                except Exception:  # noqa: BLE001 - best-effort halt
+                    logger.exception("error stopping %s", el.name)
 
     def _sink_reached_eos(self, sink: Element) -> None:
         with self._lock:

@@ -15,6 +15,7 @@ import threading
 from collections import deque
 from typing import Optional
 
+from ..analysis.sanitizer import named_condition
 from ..core import Buffer, Caps, Event, EventType
 from ..core.caps import any_media_caps
 from ..runtime.element import Element, Prop
@@ -28,18 +29,26 @@ class _Channel:
     """Bounded MPSC channel: buffers obey capacity/leaky policy, events pass
     through in order unconditionally."""
 
-    def __init__(self, capacity: int, leaky: str):
+    def __init__(self, capacity: int, leaky: str, name: str = "?"):
         self.capacity = capacity  # 0 = unbounded
         self.leaky = leaky
-        self._dq: deque = deque()
-        self._cond = threading.Condition()
-        self._closed = False
-        self._n_bufs = 0  # buffers in _dq (events excluded), O(1) hot path
+        # per-instance lock name: chained queues nest naturally (worker of
+        # one pushes into the next) and must stay distinct graph nodes
+        self._cond = named_condition(f"queue[{name}]._cond")
+        self._dq: deque = deque()   # guarded-by: _cond
+        self._closed = False        # guarded-by: _cond
+        # buffers in _dq (events excluded), O(1) hot path
+        self._n_bufs = 0            # guarded-by: _cond
         # leaky-mode loss accounting: upstream = incoming buffer refused,
         # downstream = oldest queued buffer evicted. Silent drops make
         # buffer loss invisible to the service health snapshot.
-        self.dropped_upstream = 0
-        self.dropped_downstream = 0
+        self.dropped_upstream = 0    # guarded-by: _cond
+        self.dropped_downstream = 0  # guarded-by: _cond
+
+    def reset_counters(self) -> None:
+        with self._cond:
+            self.dropped_upstream = 0
+            self.dropped_downstream = 0
 
     def put_buf(self, buf: Buffer) -> None:
         with self._cond:
@@ -56,7 +65,7 @@ class _Channel:
                             break
                 else:
                     while not self._closed and self._n_bufs >= self.capacity:
-                        self._cond.wait()  # backpressure
+                        self._cond.wait(0.25)  # backpressure, bounded slice
                     if self._closed:
                         return
             self._dq.append(("buf", buf))
@@ -77,7 +86,9 @@ class _Channel:
     def get(self):
         with self._cond:
             while not self._dq:
-                self._cond.wait()
+                # bounded slice: the stop sentinel normally wakes this,
+                # but a worker must never be parked unwakeably forever
+                self._cond.wait(0.25)
             item = self._dq.popleft()
             if item[0] == "buf":
                 self._n_bufs -= 1
@@ -106,7 +117,8 @@ class QueueElement(Element):
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
-        self._ch = _Channel(self.props["max_size_buffers"], self.props["leaky"])
+        self._ch = _Channel(self.props["max_size_buffers"],
+                            self.props["leaky"], name=self.name)
         self._thread: Optional[threading.Thread] = None
         self._running = threading.Event()
 
@@ -125,8 +137,7 @@ class QueueElement(Element):
 
     def reset_flow(self) -> None:
         super().reset_flow()
-        self._ch.dropped_upstream = 0
-        self._ch.dropped_downstream = 0
+        self._ch.reset_counters()
 
     # -- producer side ------------------------------------------------------
     def chain(self, pad: Pad, buf: Buffer) -> None:

@@ -35,12 +35,15 @@ class HealthMonitor(threading.Thread):
         super().__init__(name=f"svc:{service.name}:health", daemon=True)
         self.service = service
         self.poll_s = poll_s
-        self._stop = threading.Event()
+        # NOT named _stop: threading.Thread has a private _stop() METHOD
+        # that join() calls on a finished thread — shadowing it with an
+        # Event makes every join() raise
+        self._stop_evt = threading.Event()
         self._last_progress = -1
         self._last_progress_t = time.monotonic()
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop_evt.set()
 
     def reset_watchdog(self) -> None:
         """Called at every (re)start so a restart isn't instantly re-flagged
@@ -49,7 +52,7 @@ class HealthMonitor(threading.Thread):
         self._last_progress_t = time.monotonic()
 
     def run(self) -> None:
-        while not self._stop.wait(self.poll_s):
+        while not self._stop_evt.wait(self.poll_s):
             try:
                 self._tick()
             except Exception:  # noqa: BLE001 - monitor must outlive hiccups

@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..analysis.sanitizer import named_lock, named_rlock
 from ..utils.log import logger
 from .health import HealthMonitor, service_snapshot
 from .models import ModelSlots
@@ -91,20 +92,30 @@ class Service:
                  jitter_seed: Optional[int] = None):
         self.manager = manager
         self.spec = spec
-        self.state = ServiceState.REGISTERED
-        self.state_reason = "registered"
-        self.pipeline = None
+        # RLock: state transitions re-enter through _set_state. The lock
+        # ORDER contract is Service._lock -> Supervisor._lock (stop/drain
+        # cancel the supervisor while holding ours); the supervisor never
+        # calls back into the service with its own lock held — see
+        # docs/concurrency.md.
+        self._lock = named_rlock("Service._lock")
+        self.state = ServiceState.REGISTERED      # guarded-by: _lock
+        self.state_reason = "registered"          # guarded-by: _lock
+        self.pipeline = None                      # guarded-by: _lock
         self.supervisor = Supervisor(self, spec.restart, jitter_seed)
-        self.generation = 0           # play() count (restarts increment)
+        self.generation = 0           # play() count   guarded-by: _lock
         self.registered_at = time.time()
-        self.started_at: Optional[float] = None
-        self._monitor: Optional[HealthMonitor] = None
-        self._query_server = None
-        self._eos_seen = False
+        self.started_at: Optional[float] = None   # guarded-by: _lock
+        self._monitor: Optional[HealthMonitor] = None  # guarded-by: _lock
+        self._query_server = None                 # guarded-by: _lock
+        self._eos_seen = False                    # guarded-by: _lock
+        # True between a supervised restart's STARTING flip and its
+        # generation bump: the restart stops/replays the pipeline OUTSIDE
+        # the lock, and the monitor must not promote READY from a
+        # progress count read in that window (it may be the old run's)
+        self._restarting = False                  # guarded-by: _lock
         self._ready_evt = threading.Event()
         self._drained_evt = threading.Event()
-        self._lock = threading.RLock()
-        self._history: List[tuple] = [(time.time(), "registered", "")]
+        self._history: List[tuple] = [(time.time(), "registered", "")]  # guarded-by: _lock
 
     @property
     def name(self) -> str:
@@ -191,7 +202,7 @@ class Service:
 
     def _mark_ready(self, generation: Optional[int] = None) -> None:
         with self._lock:
-            if self.state is not ServiceState.STARTING:
+            if self.state is not ServiceState.STARTING or self._restarting:
                 return
             if generation is not None and generation != self.generation:
                 return  # promotion decided against a previous run's counter
@@ -214,9 +225,15 @@ class Service:
         """Hard stop: no drain, in-flight buffers are dropped."""
         with self._lock:
             self.supervisor.cancel()
-            if self.pipeline is not None and self.pipeline.playing:
-                self.pipeline.stop()
-            self._stop_query_server()
+            pipe = self.pipeline
+        # stop OUTSIDE the lock: Pipeline.stop joins element threads, and
+        # a dying element thread may be delivering _on_pipeline_event —
+        # which takes this lock. Holding it across the join would stall
+        # every stop on the event thread's 5s join timeout.
+        if pipe is not None and pipe.playing:
+            pipe.stop()
+        self._stop_query_server()
+        with self._lock:
             if self.state is not ServiceState.FAILED:
                 self._set_state(ServiceState.STOPPED, "stop requested")
         return self
@@ -225,11 +242,16 @@ class Service:
         """Graceful shutdown: sources stop producing and send EOS, queued
         work flushes through the sinks, then the pipeline stops."""
         with self._lock:
-            if self.state not in _ACTIVE:
-                return self.stop()
-            self.supervisor.cancel()
-            self._set_state(ServiceState.DRAINING, "drain requested")
-            pipe = self.pipeline
+            active = self.state in _ACTIVE
+            if active:
+                self.supervisor.cancel()
+                self._set_state(ServiceState.DRAINING, "drain requested")
+                pipe = self.pipeline
+        if not active:
+            # outside the with: stop() re-enters the RLock but must run
+            # its pipeline join with the lock COUNT at zero, or the
+            # join-vs-listener stall it was restructured to avoid returns
+            return self.stop()
         for src in pipe.sources:
             try:
                 src.stop()
@@ -240,26 +262,38 @@ class Service:
         if not self._drained_evt.wait(timeout_s):
             logger.warning("service %s: drain timed out after %.1fs, "
                            "stopping anyway", self.name, timeout_s)
+        pipe.stop()  # outside the lock — joins element threads (see stop())
+        self._stop_query_server()
         with self._lock:
-            pipe.stop()
-            self._stop_query_server()
             self._set_state(ServiceState.STOPPED, "drained")
         return self
 
     def _stop_query_server(self) -> None:
-        if self._query_server is not None:
+        """Detach under the lock, stop OUTSIDE it: QueryServer.stop joins
+        accept/serve/client threads (seconds of join timeouts worst-case),
+        and holding Service._lock across that starves the monitor tick and
+        every control call on this service."""
+        with self._lock:
+            server, self._query_server = self._query_server, None
+        if server is not None:
             try:
-                self._query_server.stop()
+                server.stop()
             except Exception:  # noqa: BLE001
                 pass
-            self._query_server = None
 
     def shutdown(self) -> None:
-        """stop() + monitor teardown (service is being unregistered)."""
+        """stop() + monitor/supervisor thread teardown (service is being
+        unregistered). Every control-plane thread this service started is
+        JOINED here — no daemon-thread leaks across unregister."""
         self.stop()
-        if self._monitor is not None:
-            self._monitor.stop()
-            self._monitor = None
+        with self._lock:
+            monitor, self._monitor = self._monitor, None
+        # joins happen with no lock held: the monitor tick and the
+        # supervisor's timer/give-up threads all take Service._lock
+        if monitor is not None:
+            monitor.stop()
+            monitor.join(timeout=2.0)
+        self.supervisor.join_threads()
 
     # -- pipeline events -----------------------------------------------------
     def _on_pipeline_event(self, kind: str, source: str, data: dict) -> None:
@@ -273,8 +307,8 @@ class Service:
             self.supervisor.notify_crash(
                 "error", str(data.get("error", data)), source)
         elif kind == "eos":
-            self._eos_seen = True
             with self._lock:
+                self._eos_seen = True
                 if self.state is ServiceState.DRAINING:
                     self._drained_evt.set()
                     return
@@ -292,18 +326,31 @@ class Service:
             self._set_state(ServiceState.STARTING,
                             f"supervised restart #{self.supervisor.restarts}")
             self._eos_seen = False
+            self._restarting = True  # blocks READY promotion (see __init__)
             pipe = self.pipeline
+        # stop/play outside the lock: stop() joins the dying run's element
+        # threads, which may be mid-_on_pipeline_event (takes our lock)
+        pipe.stop()
+        pipe.play()
+        stale = False
+        with self._lock:
+            self._restarting = False
+            if self.state is not ServiceState.STARTING:
+                stale = True  # user stopped/drained while we replayed
+            else:
+                self.started_at = time.time()
+                self.generation += 1  # after play(): see start()
+                if self._monitor is not None:
+                    self._monitor.reset_watchdog()
+        if stale:
             pipe.stop()
-            self.started_at = time.time()
-            pipe.play()
-            self.generation += 1  # after play(): see start()
-            if self._monitor is not None:
-                self._monitor.reset_watchdog()
 
     def _supervised_give_up(self, why: str) -> None:
         with self._lock:
-            if self.pipeline is not None and self.pipeline.playing:
-                self.pipeline.stop()
+            pipe = self.pipeline
+        if pipe is not None and pipe.playing:
+            pipe.stop()  # outside the lock — joins element threads
+        with self._lock:
             self._set_state(ServiceState.FAILED, why)
 
     def _supervised_complete(self) -> None:
@@ -311,8 +358,9 @@ class Service:
         with self._lock:
             if self.state not in _ACTIVE:
                 return
-            self.pipeline.stop()
             self._set_state(ServiceState.STOPPED, "stream completed (eos)")
+            pipe = self.pipeline
+        pipe.stop()  # outside the lock — joins element threads
 
     # -- integration ---------------------------------------------------------
     def attach_query_server(self, host: str = "127.0.0.1", port: int = 0,
@@ -328,7 +376,8 @@ class Service:
         server = QueryServer(host, port)
         server.attach_scheduler(el._ensure_scheduler(), priority=priority,
                                 deadline_s=deadline_s)
-        self._query_server = server
+        with self._lock:
+            self._query_server = server
         return server
 
     def _find_serving_element(self):
@@ -365,8 +414,8 @@ class ServiceManager:
     """The named-service table + model slots (one per deployment)."""
 
     def __init__(self, jitter_seed: Optional[int] = None):
-        self._services: Dict[str, Service] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("ServiceManager._lock")
+        self._services: Dict[str, Service] = {}  # guarded-by: _lock
         self._jitter_seed = jitter_seed
         self.models = ModelSlots(self)
 

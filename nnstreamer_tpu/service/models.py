@@ -26,11 +26,11 @@ candidate version — promote installs it for 100%, rollback discards it.
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.sanitizer import named_lock
 from ..registry.models import register_local_model, unregister_local_model
 from ..utils.log import logger
 
@@ -50,10 +50,10 @@ class _CanaryBackend:
         self.primary = primary
         self.canary = canary
         self.fraction = float(fraction)
-        self._n = 0
-        self._lock = threading.Lock()
-        self.primary_invokes = 0
-        self.canary_invokes = 0
+        self._lock = named_lock("CanaryBackend._lock")
+        self._n = 0                 # guarded-by: _lock
+        self.primary_invokes = 0    # guarded-by: _lock
+        self.canary_invokes = 0     # guarded-by: _lock
 
     def _pick_canary(self) -> bool:
         with self._lock:
@@ -85,8 +85,8 @@ class ModelSlots:
 
     def __init__(self, manager):
         self._manager = manager
-        self._slots: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("ModelSlots._lock")
+        self._slots: Dict[str, dict] = {}  # guarded-by: _lock
 
     # -- definition ----------------------------------------------------------
     def define(self, name: str, versions: Dict[str, str],

@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..analysis.sanitizer import named_lock
 from ..utils.log import logger
 
 
@@ -119,15 +120,22 @@ class Supervisor:
                  jitter_seed: Optional[int] = None):
         self.service = service
         self.policy = policy
-        self.restarts = 0               # restarts actually performed
-        self.breaker_open = False
+        self._lock = named_lock("Supervisor._lock")
+        self.restarts = 0               # guarded-by: _lock
+        self.breaker_open = False       # guarded-by: _lock
         self.crash_reports: List[CrashReport] = []
-        self._crash_times: List[float] = []   # breaker window accounting
-        self._consecutive = 0           # crashes since last healthy run
-        self._gave_up = False           # FAILED delivered; ignore echoes
+        self._crash_times: List[float] = []   # guarded-by: _lock
+        self._consecutive = 0           # guarded-by: _lock
+        self._gave_up = False           # guarded-by: _lock
         self._rng = random.Random(jitter_seed)
-        self._lock = threading.Lock()
-        self._timer: Optional[threading.Timer] = None
+        self._timer: Optional[threading.Timer] = None  # guarded-by: _lock
+        # _timer is nulled the moment it FIRES (so a new crash can
+        # schedule); this list keeps every fired-but-still-running timer
+        # joinable until join_threads — a restart mid stop/replay must
+        # not outlive Service.shutdown() unobserved, even when a second
+        # crash has scheduled the NEXT timer meanwhile
+        self._restart_threads: List[threading.Timer] = []  # guarded-by: _lock
+        self._giveup_thread: Optional[threading.Thread] = None
 
     # -- service feedback ----------------------------------------------------
     def note_healthy(self) -> None:
@@ -226,15 +234,24 @@ class Supervisor:
 
     def _give_up_locked(self, why: str) -> None:
         self._gave_up = True
-        threading.Thread(
+        # delivered on its own thread: _supervised_give_up takes the
+        # SERVICE lock, and notifiers reach here holding ours — calling
+        # through directly would nest Supervisor._lock -> Service._lock,
+        # the reverse of the stop() path. Tracked + joined in
+        # join_threads() (Service.shutdown), not fire-and-forget.
+        self._giveup_thread = threading.Thread(
             target=self.service._supervised_give_up, args=(why,),
-            name=f"svc:{self.service.name}:give-up", daemon=True).start()
+            name=f"svc:{self.service.name}:give-up", daemon=True)
+        self._giveup_thread.start()
 
     def _schedule_restart_locked(self, delay: float) -> None:
         if self._timer is not None:
             return  # a restart is already pending
         self._timer = threading.Timer(delay, self._do_restart)
         self._timer.daemon = True
+        # survives the fire (see __init__); pruned as timers finish
+        self._restart_threads = [t for t in self._restart_threads
+                                 if t.is_alive()] + [self._timer]
         self._timer.start()
 
     def _do_restart(self) -> None:
@@ -252,11 +269,33 @@ class Supervisor:
             return self._timer is not None
 
     def cancel(self) -> None:
-        """Abort any pending restart (service stopped/drained by the user)."""
+        """Abort any pending restart (service stopped/drained by the user).
+        Cancel only — no join: callers hold the SERVICE lock, and the
+        timer body re-takes it (join_threads does the joining, lock-free).
+        """
         with self._lock:
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
+
+    def join_threads(self, timeout_s: float = 2.0) -> None:
+        """Join the supervision threads (pending timer, give-up delivery).
+        MUST be called with no service/supervisor lock held: both threads
+        take Service._lock on their way out."""
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            restarts, self._restart_threads = self._restart_threads, []
+            giveup = self._giveup_thread
+            self._giveup_thread = None
+        # covers still-pending timers (canceled above) AND ones that
+        # already FIRED and are mid _do_restart
+        for t in restarts:
+            if t is not threading.current_thread():
+                t.join(timeout=timeout_s)
+        if giveup is not None and giveup is not threading.current_thread():
+            giveup.join(timeout=timeout_s)
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -19,10 +19,10 @@ from __future__ import annotations
 
 import heapq
 import itertools
-import threading
 import time
 from typing import List, Optional, Tuple
 
+from ..analysis.sanitizer import named_condition, named_lock
 from .request import DeadlineExceededError, QueueFullError, Request
 
 _tiebreak = itertools.count()
@@ -45,12 +45,14 @@ class RequestQueue:
         # admission-time sheds raise at the caller instead, so this is
         # the owning scheduler's only signal to account them
         self.on_shed = on_shed
-        self._heap: List[Tuple[int, int, Request]] = []
-        self._lock = threading.Lock()
-        self._not_empty = threading.Condition(self._lock)
-        self._service_ewma_s = 0.0  # EWMA of one batch's service time
-        self.shed_full = 0
-        self.shed_deadline = 0
+        self._lock = named_lock("RequestQueue._lock")
+        self._not_empty = named_condition("RequestQueue._not_empty",
+                                          lock=self._lock)
+        self._heap: List[Tuple[int, int, Request]] = []  # guarded-by: _lock
+        # EWMA of one batch's service time
+        self._service_ewma_s = 0.0  # guarded-by: _lock
+        self.shed_full = 0      # guarded-by: _lock
+        self.shed_deadline = 0  # guarded-by: _lock
 
     # -- service-time feedback ----------------------------------------------
     def observe_service_time(self, batch_s: float) -> None:
@@ -130,7 +132,10 @@ class RequestQueue:
                         heapq.heappop(self._heap)
                         return req
                     if deadline is None:
-                        self._not_empty.wait()
+                        # bounded slices, not an indefinite park: a caller
+                        # with no timeout still wakes to re-check (and a
+                        # stop/notify can never be missed forever)
+                        self._not_empty.wait(0.25)
                     else:
                         remaining = deadline - now
                         if remaining <= 0 or not self._not_empty.wait(remaining):

@@ -173,7 +173,9 @@ class SingleShot:
                     "after %.0fs; closing anyway (backend may be unsafe)",
                     drain_timeout_s)
             if self._worker is not None and self._worker.is_alive():
-                self._requests.put(None)
+                self._requests.put(None)  # stop sentinel
+                self._worker.join(timeout=2.0)
+                self._worker = None
             release_backend(self.backend, self._share_key)
             self.backend = None
 

@@ -1,0 +1,810 @@
+"""nnlint concurrency pass (NNL2xx), the tsan-lite runtime sanitizer,
+and the concurrent control-plane stress property.
+
+Every NNL201-205 rule gets a bad fixture (triggers) and a good fixture
+(stays silent); the sanitizer tests pin the enable/disable bypass
+contract and the order-violation detector; the stress test drives hot
+swap + canary promote + query-server traffic + a supervised restart
+CONCURRENTLY under the sanitizer and asserts zero observed lock-order
+violations and zero request errors.
+"""
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.analysis import Severity, lint_concurrency
+from nnstreamer_tpu.analysis import sanitizer
+
+
+def rules_of(diags):
+    return {d.rule for d in diags}
+
+
+def _lint_snippet(tmp_path, code, name="mod.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(code))
+    return lint_concurrency([f], root=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# NNL201 — lock-order inversion
+# ---------------------------------------------------------------------------
+
+class TestNNL201:
+    def test_inverted_nesting_across_functions(self, tmp_path):
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def ab():
+                with A:
+                    with B:
+                        pass
+
+            def ba():
+                with B:
+                    with A:
+                        pass
+        """)
+        hits = [d for d in bad if d.rule == "NNL201"]
+        assert hits and hits[0].severity is Severity.ERROR
+        assert "cycle" in hits[0].message
+
+    def test_consistent_order_is_silent(self, tmp_path):
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def ab():
+                with A:
+                    with B:
+                        pass
+
+            def ab2():
+                with A:
+                    with B:
+                        pass
+        """)
+        assert "NNL201" not in rules_of(good)
+
+    def test_inversion_through_method_call_expansion(self, tmp_path):
+        # f holds X and calls helper() which takes Y; g nests Y then X —
+        # the edge through the one-level call expansion closes the cycle
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._x = threading.Lock()
+                    self._y = threading.Lock()
+
+                def f(self):
+                    with self._x:
+                        self.helper()
+
+                def helper(self):
+                    with self._y:
+                        pass
+
+                def g(self):
+                    with self._y:
+                        with self._x:
+                            pass
+        """)
+        assert "NNL201" in rules_of(bad)
+
+    def test_recursive_plain_lock_is_self_deadlock(self, tmp_path):
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """)
+        (d,) = [d for d in bad if d.rule == "NNL201"]
+        assert "self-deadlock" in d.message
+
+    def test_rlock_reacquire_is_fine(self, tmp_path):
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def f(self):
+                    with self._lock:
+                        self.g()
+
+                def g(self):
+                    with self._lock:
+                        pass
+        """)
+        assert "NNL201" not in rules_of(good)
+
+
+# ---------------------------------------------------------------------------
+# NNL202 — unguarded shared state
+# ---------------------------------------------------------------------------
+
+class TestNNL202:
+    def test_guarded_by_annotation_enforced(self, tmp_path):
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = 0  # guarded-by: _lock
+
+                def poke(self):
+                    self.state = 1
+        """)
+        (d,) = [d for d in bad if d.rule == "NNL202"]
+        assert "guarded-by" in d.message
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = 0  # guarded-by: _lock
+
+                def poke(self):
+                    with self._lock:
+                        self.state = 1
+        """)
+        assert "NNL202" not in rules_of(good)
+
+    def test_condition_alias_counts_as_the_lock(self, tmp_path):
+        # holding a Condition built over the lock IS holding the lock
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._not_empty = threading.Condition(self._lock)
+                    self.depth = 0  # guarded-by: _lock
+
+                def put(self):
+                    with self._not_empty:
+                        self.depth += 1
+                        self._not_empty.notify()
+        """)
+        assert "NNL202" not in rules_of(good)
+
+    def test_mixed_locked_and_bare_writes(self, tmp_path):
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def locked_inc(self):
+                    with self._lock:
+                        self.count += 1
+
+                def bare_reset(self):
+                    self.count = 0
+        """)
+        assert "NNL202" in rules_of(bad)
+
+    def test_helper_only_called_under_lock_is_credited(self, tmp_path):
+        # _apply is private and every call site holds the lock: its bare
+        # write must NOT read as unguarded (entry-held inference)
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def inc(self):
+                    with self._lock:
+                        self._apply()
+
+                def dec(self):
+                    with self._lock:
+                        self._apply()
+
+                def _apply(self):
+                    self.count += 1
+        """)
+        assert "NNL202" not in rules_of(good)
+
+
+# ---------------------------------------------------------------------------
+# NNL203 — blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+class TestNNL203:
+    def test_sleep_under_lock(self, tmp_path):
+        bad = _lint_snippet(tmp_path, """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        time.sleep(0.1)
+        """)
+        assert "NNL203" in rules_of(bad)
+        good = _lint_snippet(tmp_path, """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        x = 1
+                    time.sleep(0.1)
+        """)
+        assert "NNL203" not in rules_of(good)
+
+    def test_indefinite_get_and_bare_join_under_lock(self, tmp_path):
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.q = None
+                    self.t = None
+
+                def f(self):
+                    with self._lock:
+                        item = self.q.get()
+
+                def g(self):
+                    with self._lock:
+                        self.t.join()
+        """)
+        hits = [d for d in bad if d.rule == "NNL203"]
+        assert len(hits) == 2
+        # bounded forms are fine
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.q = None
+                    self.t = None
+
+                def f(self):
+                    with self._lock:
+                        item = self.q.get(timeout=0.1)
+
+                def g(self):
+                    with self._lock:
+                        self.t.join(timeout=0.1)
+        """)
+        assert "NNL203" not in rules_of(good)
+
+    def test_blocking_in_helper_called_under_lock(self, tmp_path):
+        # one-level call expansion carries the held set into the helper
+        bad = _lint_snippet(tmp_path, """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        self._slow()
+
+                def _slow(self):
+                    time.sleep(1.0)
+        """)
+        assert "NNL203" in rules_of(bad)
+
+    def test_wait_on_own_condition_exempt(self, tmp_path):
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def f(self):
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait(0.1)
+        """)
+        assert "NNL203" not in rules_of(good)
+
+
+# ---------------------------------------------------------------------------
+# NNL204 — Condition.wait without predicate loop
+# ---------------------------------------------------------------------------
+
+class TestNNL204:
+    def test_wait_outside_while(self, tmp_path):
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def f(self):
+                    with self._cond:
+                        if not self.ready:
+                            self._cond.wait(1.0)
+        """)
+        assert "NNL204" in rules_of(bad)
+
+    def test_wait_inside_while_is_fine(self, tmp_path):
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def f(self):
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait(1.0)
+        """)
+        assert "NNL204" not in rules_of(good)
+
+
+# ---------------------------------------------------------------------------
+# NNL205 — thread without join/stop path
+# ---------------------------------------------------------------------------
+
+class TestNNL205:
+    def test_fire_and_forget(self, tmp_path):
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            def f(work):
+                threading.Thread(target=work, daemon=True).start()
+        """)
+        assert "NNL205" in rules_of(bad)
+
+    def test_attr_thread_without_join(self, tmp_path):
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop)
+                    self._thread.start()
+
+                def _loop(self):
+                    pass
+        """)
+        assert "NNL205" in rules_of(bad)
+
+    def test_attr_thread_with_join_is_fine(self, tmp_path):
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop)
+                    self._thread.start()
+
+                def stop(self):
+                    self._thread.join(timeout=2.0)
+
+                def _loop(self):
+                    pass
+        """)
+        assert "NNL205" not in rules_of(good)
+
+    def test_thread_subclass_instantiation_checked(self, tmp_path):
+        # Monitor subclasses threading.Thread in the same file set: an
+        # instantiation stored without a join path is still a finding
+        bad = _lint_snippet(tmp_path, """
+            import threading
+
+            class Monitor(threading.Thread):
+                pass
+
+            class C:
+                def start(self):
+                    self._mon = Monitor()
+                    self._mon.start()
+        """)
+        assert "NNL205" in rules_of(bad)
+
+    def test_local_thread_joined_or_handed_off(self, tmp_path):
+        good = _lint_snippet(tmp_path, """
+            import threading
+
+            def run(work):
+                t = threading.Thread(target=work)
+                t.start()
+                t.join()
+
+            def spawn(work, registry):
+                t = threading.Thread(target=work)
+                t.start()
+                registry.append(t)
+        """)
+        assert "NNL205" not in rules_of(good)
+
+    def test_non_threading_timer_class_not_confused(self, tmp_path):
+        # a project class named Timer (e.g. a stats context manager) must
+        # not trip the thread-lifecycle rule
+        good = _lint_snippet(tmp_path, """
+            class Timer:
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *exc):
+                    return False
+
+            def f(stats):
+                timer = Timer()
+                with timer:
+                    pass
+        """)
+        assert "NNL205" not in rules_of(good)
+
+
+class TestPragmas:
+    def test_pragma_suppresses_concurrency_rule(self, tmp_path):
+        clean = _lint_snippet(tmp_path, """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        # nnlint: disable=NNL203 — justified: test fixture
+                        time.sleep(0.1)
+        """)
+        assert "NNL203" not in rules_of(clean)
+
+
+# ---------------------------------------------------------------------------
+# CLI --rules filter
+# ---------------------------------------------------------------------------
+
+class TestRulesFilter:
+    def test_family_filter_selects_nnl2xx_only(self, tmp_path, capsys):
+        import json
+
+        from nnstreamer_tpu.analysis.cli import main as lint_main
+
+        f = tmp_path / "mod.py"
+        f.write_text(textwrap.dedent("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def chain(self, pad, buf):
+                    with self._lock:
+                        time.sleep(0.1)
+                    try:
+                        pass
+                    except:
+                        pass
+        """))
+        # unfiltered: NNL103 (bare except, an error) + NNL203
+        assert lint_main([str(f)]) == 1
+        capsys.readouterr()
+        # NNL2xx only: the NNL103 error is filtered out -> exit 0 without
+        # --strict, and the JSON carries only the concurrency finding
+        assert lint_main(["--json", "--rules", "NNL2xx", str(f)]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data and all(d["rule"].startswith("NNL2") for d in data)
+        # strict + filter: the remaining NNL203 warning now gates
+        assert lint_main(["--strict", "--rules", "NNL2xx", str(f)]) == 1
+        capsys.readouterr()
+
+    def test_bare_rules_flag_still_lists_catalog(self, capsys):
+        from nnstreamer_tpu.analysis import RULES
+        from nnstreamer_tpu.analysis.cli import main as lint_main
+
+        assert lint_main(["--rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in RULES:
+            assert rule_id in out
+
+
+# ---------------------------------------------------------------------------
+# the concurrency self-lint gate: our own tree is NNL2xx-clean
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint
+class TestConcurrencySelfLint:
+    def test_tree_has_zero_nnl2xx_findings(self):
+        from pathlib import Path
+
+        import nnstreamer_tpu
+
+        pkg = Path(nnstreamer_tpu.__file__).parent
+        diags = lint_concurrency([pkg], root=str(pkg.parent))
+        assert [d.format() for d in diags] == []
+
+
+# ---------------------------------------------------------------------------
+# tsan-lite sanitizer
+# ---------------------------------------------------------------------------
+
+class TestSanitizer:
+    def setup_method(self):
+        self._was_enabled = sanitizer.is_enabled()
+
+    def teardown_method(self):
+        # leave the session the way we found it (NNS_TSAN runs keep it on)
+        if self._was_enabled:
+            sanitizer.enable(hold_warn_s=5.0)
+        else:
+            sanitizer.disable()
+            sanitizer.reset()
+
+    def test_disabled_factories_return_raw_primitives(self):
+        sanitizer.disable()
+        assert type(sanitizer.named_lock("x")) is type(threading.Lock())
+        assert type(sanitizer.named_rlock("x")) is type(threading.RLock())
+        assert isinstance(sanitizer.named_condition("x"),
+                          threading.Condition)
+
+    def test_order_violation_detected(self):
+        sanitizer.enable(hold_warn_s=10.0)
+        a, b = sanitizer.named_lock("tA"), sanitizer.named_lock("tB")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        t = threading.Thread(target=ab)
+        t.start()
+        t.join()
+        t = threading.Thread(target=ba)
+        t.start()
+        t.join()
+        (v,) = sanitizer.violations()
+        assert v["type"] == "lock-order"
+        assert set(v["edge"]) == {"tA", "tB"}
+        rep = sanitizer.report()
+        assert rep["violations"] == [v]
+        assert any(e["from"] == "tA" and e["to"] == "tB"
+                   for e in rep["edges"])
+
+    def test_consistent_order_stays_clean(self):
+        sanitizer.enable(hold_warn_s=10.0)
+        a, b = sanitizer.named_lock("cA"), sanitizer.named_lock("cB")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert sanitizer.violations() == []
+
+    def test_long_hold_flagged(self):
+        sanitizer.enable(hold_warn_s=0.05)
+        h = sanitizer.named_lock("tHold")
+        with h:
+            time.sleep(0.1)
+        rep = sanitizer.report()
+        assert rep["long_holds"] and rep["long_holds"][0]["lock"] == "tHold"
+        assert sanitizer.violations() == []  # a long hold is not a cycle
+
+    def test_rlock_reentry_records_no_self_edge(self):
+        sanitizer.enable(hold_warn_s=10.0)
+        r = sanitizer.named_rlock("tR")
+        with r:
+            with r:
+                pass
+        assert sanitizer.violations() == []
+        assert all(e["from"] != e["to"] for e in sanitizer.report()["edges"])
+
+    def test_condition_wait_keeps_stack_truthful(self):
+        sanitizer.enable(hold_warn_s=10.0)
+        lk = sanitizer.named_lock("tQ.lock")
+        cv = sanitizer.named_condition("tQ.cond", lock=lk)
+        other = sanitizer.named_lock("tQ.other")
+        hits = []
+
+        def waiter():
+            with cv:
+                while not hits:
+                    cv.wait(0.5)
+                # the wait released tQ.lock: a lock taken by the NOTIFIER
+                # meanwhile must not have formed an edge from tQ.lock
+            with other:
+                pass
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with other:
+            with cv:
+                hits.append(1)
+                cv.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert sanitizer.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# stress: swap + canary promote + query traffic + supervised restart,
+# concurrently, under the sanitizer — zero violations, zero request errors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout_s(150)
+class TestControlPlaneStress:
+    def test_concurrent_control_plane_is_order_clean(self):
+        from nnstreamer_tpu.core import Buffer, Caps
+        from nnstreamer_tpu.query.client import QueryClient
+        from nnstreamer_tpu.query.server import QueryServer
+        from nnstreamer_tpu.service import (
+            RestartPolicy,
+            ServiceManager,
+            ServiceState,
+        )
+        from nnstreamer_tpu.serving import Scheduler
+
+        was_enabled = sanitizer.is_enabled()
+        sanitizer.enable(hold_warn_s=30.0)
+        base_violations = len(sanitizer.violations())
+        mgr = ServiceManager(jitter_seed=7)
+        request_errors = []
+        completed = [0]
+        count_lock = threading.Lock()
+        stop_traffic = threading.Event()
+        server = None
+        sched = None
+        try:
+            # the serving service whose model slot gets hammered
+            mgr.models.define(
+                "stress", {"1": "builtin://scaler?factor=2",
+                           "2": "builtin://scaler?factor=3"}, active="1")
+            svc = mgr.register(
+                "stress-svc",
+                "tensor_src num-buffers=-1 framerate=200 dimensions=8 "
+                "types=float32 pattern=counter "
+                "! tensor_filter framework=jax model=registry://stress "
+                "! tensor_sink name=out max-stored=4",
+                restart=RestartPolicy(mode="on-failure",
+                                      backoff_base_s=0.05, jitter=0.0),
+                watchdog_s=10.0)
+            svc.start()
+            assert svc.readiness()
+
+            # a crashing sibling exercises supervisor restart concurrently
+            crasher = mgr.register(
+                "stress-crash",
+                "tensor_src num-buffers=60 framerate=500 dimensions=4 "
+                "types=float32 pattern=counter "
+                "! tensor_fault crash-at-buffer=20 "
+                "! queue max-size-buffers=4 "
+                "! tensor_sink name=cout max-stored=128",
+                restart=RestartPolicy(mode="on-failure",
+                                      backoff_base_s=0.05, jitter=0.0))
+
+            # query-server traffic through a serving scheduler
+            caps = Caps.new("other/tensors")
+            server = QueryServer(port=0, caps=caps)
+            sched = Scheduler(lambda x: (x * 2.0,), bucket_sizes=(1, 2, 4),
+                              max_wait_s=0.002, name="stress-qsched")
+            server.attach_scheduler(sched)
+
+            def client_loop():
+                c = QueryClient("127.0.0.1", server.port)
+                try:
+                    c.connect(caps)
+                    while not stop_traffic.is_set():
+                        c.send(Buffer(
+                            [np.ones((1, 4), np.float32)]))
+                        out = c.responses.get(timeout=30)
+                        if out is None or not hasattr(out, "tensors"):
+                            request_errors.append(("client", out))
+                            return
+                        with count_lock:
+                            completed[0] += 1
+                except Exception as e:  # noqa: BLE001 - recorded, asserted 0
+                    request_errors.append(("client", e))
+                finally:
+                    c.close()
+
+            clients = [threading.Thread(target=client_loop,
+                                        name=f"stress-client-{i}")
+                       for i in range(3)]
+            for t in clients:
+                t.start()
+
+            def rollout_loop():
+                # swaps and canary promote/cancel against LIVE traffic
+                try:
+                    for i in range(4):
+                        mgr.models.swap("stress",
+                                        "2" if i % 2 == 0 else "1")
+                        time.sleep(0.05)
+                        mgr.models.canary("stress",
+                                          "1" if i % 2 == 0 else "2", 0.25)
+                        time.sleep(0.05)
+                        if i % 2 == 0:
+                            mgr.models.promote_canary("stress")
+                        else:
+                            mgr.models.cancel_canary("stress")
+                except Exception as e:  # noqa: BLE001
+                    request_errors.append(("rollout", e))
+
+            rollout = threading.Thread(target=rollout_loop,
+                                       name="stress-rollout")
+            rollout.start()
+            crasher.start(wait=False)
+
+            rollout.join(timeout=60)
+            assert not rollout.is_alive()
+            # the crasher must recover through its supervised restart and
+            # drain to a clean EOS while everything else churned
+            deadline = time.monotonic() + 30
+            while (crasher.state is not ServiceState.STOPPED
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert crasher.state is ServiceState.STOPPED
+            assert crasher.supervisor.restarts >= 1
+
+            stop_traffic.set()
+            for t in clients:
+                t.join(timeout=30)
+                assert not t.is_alive()
+
+            assert request_errors == []
+            assert completed[0] > 0
+            # the serving service streamed through every flip
+            assert svc.readiness()
+            assert svc.pipeline.sink_buffer_count > 0
+            # THE acceptance property: the observed lock-order graph
+            # stayed acyclic across the whole concurrent episode
+            fresh = sanitizer.violations()[base_violations:]
+            assert fresh == [], fresh
+        finally:
+            stop_traffic.set()
+            if sched is not None:
+                sched.close()
+            if server is not None:
+                server.stop()
+            mgr.shutdown()
+            if was_enabled:
+                sanitizer.enable(hold_warn_s=5.0)
+            else:
+                sanitizer.disable()
+                sanitizer.reset()

@@ -120,6 +120,25 @@ def bench(n_swaps: int = 5, settle_s: float = 1.0) -> dict:
     return result
 
 
+def _sanitizer_bypassed() -> bool:
+    """The hot-path guarantee of tsan-lite: with the sanitizer disabled
+    (the production default), the named-lock factories return RAW
+    threading primitives — no wrapper object, no recording, zero
+    steady-state overhead. A wrapper type leaking through here would put
+    instrumentation in every queue push and filter invoke."""
+    import threading
+
+    from nnstreamer_tpu.analysis import sanitizer
+
+    if sanitizer.is_enabled():  # smoke must measure the production path
+        return False
+    return (
+        type(sanitizer.named_lock("probe")) is type(threading.Lock())
+        and type(sanitizer.named_rlock("probe")) is type(threading.RLock())
+        and type(sanitizer.named_condition("probe")) is threading.Condition
+    )
+
+
 def smoke() -> dict:
     """Headless control-plane smoke: register → start → health-check →
     swap → health-check → drain. Exercises the same path CI needs green."""
@@ -128,6 +147,7 @@ def smoke() -> dict:
     mgr, svc = _mgr()
     svc.start()
     checks = {"ready_after_start": svc.readiness()}
+    checks["sanitizer_off_is_fully_bypassed"] = _sanitizer_bypassed()
     snap = svc.status()
     checks["live"] = snap["live"]
     checks["warmup_buffers"] = snap["sink_buffers"] >= 1
