@@ -19,7 +19,7 @@ import sys
 from pathlib import Path
 from typing import List, Optional, Sequence
 
-from .diagnostics import RULES, Diagnostic
+from .diagnostics import RULES, Diagnostic, Severity
 
 
 def add_lint_args(parser) -> None:
@@ -108,11 +108,15 @@ def run_lint(args) -> int:
         for d in diags:
             print(d.format())
         n_err = sum(1 for d in diags if d.is_error)
-        n_warn = len(diags) - n_err
-        print(f"lint: {n_err} error(s), {n_warn} warning(s)")
+        n_info = sum(1 for d in diags if d.severity is Severity.INFO)
+        n_warn = len(diags) - n_err - n_info
+        print(f"lint: {n_err} error(s), {n_warn} warning(s), "
+              f"{n_info} info")
     if any(d.is_error for d in diags):
         return 1
-    if args.strict and diags:
+    # info findings (NNL013 segmentation plans) are reports, not
+    # violations: they never gate, not even under --strict
+    if args.strict and any(d.severity is not Severity.INFO for d in diags):
         return 1
     return 0
 

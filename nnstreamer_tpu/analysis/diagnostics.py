@@ -17,6 +17,7 @@ from typing import Dict, Optional
 class Severity(enum.Enum):
     ERROR = "error"      # the pipeline cannot work / the code is wrong
     WARNING = "warning"  # works, but a perf or robustness hazard
+    INFO = "info"        # informational report (never gates, even --strict)
 
     def __str__(self) -> str:
         return self.value
@@ -80,6 +81,11 @@ _RULES = (
     Rule("NNL012", Severity.ERROR, "parse/construction failure",
          "the launch string does not parse, or an element constructor "
          "rejected its configuration"),
+    Rule("NNL013", Severity.INFO, "fusion segmentation plan",
+         "informational: the device-segment fusion compiler's plan for "
+         "this pipeline — which linear runs of device elements collapse "
+         "to ONE XLA dispatch per buffer (runtime/fusion.py); info "
+         "findings never gate, not even under --strict"),
     # -- source lint (pass 2) -----------------------------------------------
     Rule("NNL100", Severity.ERROR, "unlintable source file",
          "a file handed to the source lint cannot be read or parsed "

@@ -82,6 +82,7 @@ def lint_pipeline(pipeline) -> List[Diagnostic]:
         diags += _check_filter_hazards(elements, est)
         diags += _check_serving_buckets(elements, est)
     diags += _check_host_roundtrip(elements)
+    diags += _check_fusion_plan(pipeline)
     return diags
 
 
@@ -487,13 +488,48 @@ def _check_host_roundtrip(elements) -> List[Diagnostic]:
         up = reaches_device(el, _upstream)
         down = reaches_device(el, _downstream)
         if up and down:
+            # name the fusion barrier: the element's own contract (see
+            # runtime/fusion.py barrier_reason) says WHY the device chain
+            # splits here, so the fix hint is actionable
+            try:
+                barrier = el.fusion_barrier() or "host-affinity element"
+            except Exception:  # noqa: BLE001 - lint must not die on an element
+                barrier = "host-affinity element"
             diags.append(make(
                 "NNL010",
                 f"host-only element '{el.name}' "
                 f"({el.ELEMENT_NAME or type(el).__name__}) sits between "
                 f"device elements '{up}' and '{down}' — each buffer "
-                "makes a device→host→device round trip",
+                "makes a device→host→device round trip; fusion barrier: "
+                f"{barrier} (splits the fused device segments around it)",
                 location=el.name,
                 hint="move host work before the first device stage or "
                      "after the last one"))
+    return diags
+
+
+def _check_fusion_plan(pipeline) -> List[Diagnostic]:
+    """NNL013 (info): report the device-segment fusion plan — which
+    linear runs collapse to one XLA dispatch per buffer at play(). The
+    planner is the SAME code the runtime uses (runtime/fusion.py), so
+    what the linter reports is what play() installs."""
+    from ..runtime.fusion import plan_segments
+
+    if not getattr(pipeline, "fuse", True):
+        # fusion disabled for this pipeline (fuse=False / NNS_NO_FUSE=1):
+        # reporting a plan that play() will not install would be a lie
+        return []
+    try:
+        plan = plan_segments(pipeline)
+    except Exception:  # noqa: BLE001 - an info report must never fail lint
+        return []
+    diags = []
+    for seg in plan.segments:
+        names = " -> ".join(el.name for el in seg)
+        diags.append(make(
+            "NNL013",
+            f"fused device segment: {names} ({len(seg)} elements, one "
+            "XLA dispatch per buffer)",
+            location=seg[0].name,
+            hint="disable with Pipeline(fuse=False) or NNS_NO_FUSE=1"))
     return diags

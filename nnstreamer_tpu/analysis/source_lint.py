@@ -30,8 +30,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 from .diagnostics import Diagnostic, make
 
 # hot function names per scope (see module docstring)
+# "dispatch" covers the fused-segment single-dispatch path
+# (runtime/fusion.py FusedSegment.dispatch): the NNL1xx hot-path
+# discipline applies to the fusion compiler itself
 ELEMENT_HOT = {"chain", "transform", "render", "create", "_task",
-               "_chain_guarded", "push"}
+               "_chain_guarded", "push", "dispatch"}
 SERVING_HOT = {"_loop", "_execute", "_admit_one", "step", "take_ready",
                "add", "_form", "next_flush_in"}
 
@@ -114,7 +117,7 @@ def _file_scope(path: Path) -> Optional[str]:
     if "elements" in parts:
         return "element"
     if "runtime" in parts and path.name in ("pad.py", "element.py",
-                                            "queue.py"):
+                                            "queue.py", "fusion.py"):
         return "element"
     return None
 

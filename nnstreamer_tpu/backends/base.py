@@ -118,6 +118,15 @@ class FilterBackend:
     def handle_event(self, event: BackendEvent, data: Optional[dict] = None) -> None:
         """Optional event hook (model reload etc.)."""
 
+    def fusion_callable(self):
+        """A pure jax-traceable per-frame callable for the device-segment
+        fusion compiler (``runtime/fusion.py``), or None when this
+        backend's invoke cannot legally inline into a larger jit (host
+        interpreters, native programs, sharded/pinned execution). The
+        default is None: only backends whose invoke IS a jax computation
+        opt in."""
+        return None
+
     def describe(self) -> str:
         model = self.props.model if self.props else "?"
         return f"{self.NAME}({model})"

@@ -533,6 +533,22 @@ class JaxBackend(FilterBackend):
         out = self._jitted()(*device_inputs)
         return list(out)
 
+    def fusion_callable(self):
+        """Traceable per-frame callable for segment fusion. None (defuse)
+        when invokes can't inline into a larger jit: host-native programs
+        (a C++ executor, not a jax computation), mesh mode (GSPMD
+        placement belongs to THIS stage's jit), or an explicitly pinned
+        device (consecutive pinned stages are pipeline-parallelism — each
+        stage must keep its own dispatch + device_put)."""
+        fn = self._fn
+        if fn is None or getattr(fn, "host_native", False):
+            return None
+        if self._mesh is not None:
+            return None
+        if self._device is not None and not self._device_is_default:
+            return None
+        return lambda *xs: _as_tuple(fn(*xs))
+
     def handle_event(self, event: BackendEvent, data: Optional[dict] = None) -> None:
         if event is BackendEvent.RELOAD_MODEL:
             # Reference RELOAD_MODEL (nnstreamer_plugin_api_filter.h:378-384):

@@ -66,6 +66,9 @@ class TensorIf(TransformElement):
     branch decision on-device)."""
 
     ELEMENT_NAME = "tensor_if"
+    # fusion barrier (runtime/fusion.py): the branch decision is a
+    # per-buffer HOST scalar — routing cannot live inside a fused jit
+    FUSION_BARRIER = "tensor_if dynamic routing (per-buffer branch decision)"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
     # static "src" merges both branches onto one stream; the reference
     # instead creates src_%d pads on demand with THEN routed to src_0 and

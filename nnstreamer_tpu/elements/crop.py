@@ -37,6 +37,8 @@ class TensorCrop(Element):
     )
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, Caps.new("other/tensors")),)
     DEVICE_AFFINITY = "host"  # per-region slicing runs on host arrays
+    # barrier text surfaced by NNL010/NNL013 (see runtime/fusion.py)
+    FUSION_BARRIER = "host per-region slicing (dynamic shapes per region)"
     PROPERTIES = {
         # reference gsttensor_crop.c lateness (ms): tolerated pts distance
         # between the raw frame and its crop-info frame; -1 = pair blindly

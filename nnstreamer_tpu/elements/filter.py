@@ -414,6 +414,63 @@ class TensorFilter(TransformElement):
             return caps_from_tensors_info(self._out_info)
         return caps_from_tensors_info(TensorsInfo((), TensorFormat.FLEXIBLE))
 
+    # -- segment fusion (runtime/fusion.py) ---------------------------------
+    def fusion_barrier(self) -> Optional[str]:
+        base = super().fusion_barrier()
+        if base is not None:
+            return base
+        # per-instance disqualifiers: behaviors that cannot live inside a
+        # composed jit without changing semantics
+        if self.props["invoke_dynamic"]:
+            return "invoke-dynamic (output shapes decided per invoke)"
+        if self.props["suspend"] > 0:
+            return "suspend (idle framework unload would outlive the trace)"
+        if self.props["sync_invoke"]:
+            return "sync-invoke (per-invoke blocking is the requested behavior)"
+        if self.props["latency"] or self.props["latency_report"]:
+            return "latency profiling (needs per-invoke timing)"
+        return None
+
+    def fusion_stage(self):
+        """Pure per-buffer invoke for segment fusion: input-combination →
+        model fn → output-combination, all inside the segment's one jit.
+        None when the opened backend cannot hand out a traceable callable
+        (host-native programs, mesh sharding, pinned devices, canary
+        routers) — the segment then defuses gracefully."""
+        if self.fusion_barrier() is not None:
+            return None
+        backend = self.backend
+        if backend is None:
+            return None
+        fn = backend.fusion_callable()
+        if fn is None:
+            return None
+        sel = self.props["input_combination"]
+        out_comb = self.props["output_combination"]
+
+        def stage(xs):
+            inputs = [xs[i] for i in sel] if sel else list(xs)
+            outs = fn(*inputs)
+            outs = tuple(outs) if isinstance(outs, (list, tuple)) else (outs,)
+            if out_comb is not None:
+                outs = tuple(xs[idx] if src == "i" else outs[idx]
+                             for src, idx in out_comb)
+            return outs
+        return stage
+
+    def fusion_gate(self, buf: Buffer) -> bool:
+        """QoS throttle on the fused path: the SAME acceptance-window gate
+        as the unfused hot loop step 0, run host-side before the dispatch."""
+        return self._throttle_accept()
+
+    def _invalidate_fused(self) -> None:
+        """A model swap changed what this element computes: drop the
+        segment's cached callable so the next buffer re-traces against
+        the new backend (service canary/swap path stays correct)."""
+        seg = self._fusion_member
+        if seg is not None:
+            seg.invalidate()
+
     # -- QoS (reference tensor_filter.c:512) --------------------------------
     def handle_src_event(self, pad: Pad, event: Event) -> None:
         if event.type is EventType.QOS and self.props["throttle"]:
@@ -426,17 +483,26 @@ class TensorFilter(TransformElement):
         return [items[i] for i in indices]
 
     # -- hot loop (§3.2) ----------------------------------------------------
-    def transform(self, buf: Buffer) -> Optional[Buffer]:
-        if self._in_info is None:
-            raise ElementError(f"{self.describe()}: buffer before caps/open")
-        # 0. throttling: drop frames arriving faster than the QoS delay.
-        # The window starts at frame ACCEPTANCE (reference
-        # gst_tensor_filter_check_throttling_delay), not invoke completion.
+    def _throttle_accept(self) -> bool:
+        """QoS acceptance gate shared by the unfused hot loop (step 0) and
+        the fused-segment gate: drop frames arriving faster than the QoS
+        delay. The window starts at frame ACCEPTANCE (reference
+        gst_tensor_filter_check_throttling_delay), not invoke completion —
+        ONE implementation so fused and unfused throttling can never
+        drift."""
         if self._throttle_delay_s > 0:
             now = clock_now()
             if now - self._last_accept_ts < self._throttle_delay_s:
-                return None  # frame dropped (reference: GST_BASE_TRANSFORM drop)
+                return False
             self._last_accept_ts = now
+        return True
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        if self._in_info is None:
+            raise ElementError(f"{self.describe()}: buffer before caps/open")
+        # 0. throttling (shared gate, see _throttle_accept)
+        if not self._throttle_accept():
+            return None  # frame dropped (reference: GST_BASE_TRANSFORM drop)
         # 1. input combination
         sel = self.props["input_combination"]
         model_inputs = self._select(buf.tensors, sel) if sel else buf.tensors
@@ -574,6 +640,10 @@ class TensorFilter(TransformElement):
             old = self.backend
             self.backend = backend
             self.props["model"] = new_model
+        # AFTER the flip (outside the invoke lock): an in-flight fused
+        # dispatch finishes on the old trace — same semantics as an
+        # in-flight unfused invoke — and the next buffer re-resolves
+        self._invalidate_fused()
         return old
 
     def release_prepared(self, backend: Optional[FilterBackend]) -> None:
@@ -600,3 +670,4 @@ class TensorFilter(TransformElement):
                     self.backend.props.model, _ = self._resolve_model()
             if self.backend is not None:
                 self.backend.handle_event(BackendEvent.RELOAD_MODEL)
+        self._invalidate_fused()

@@ -43,6 +43,8 @@ class TensorMux(Element):
     """
 
     ELEMENT_NAME = "tensor_mux"
+    # fusion barrier (runtime/fusion.py): N-way fan-in synchronization
+    FUSION_BARRIER = "mux fan-in (cross-stream synchronization)"
     SINK_TEMPLATES = (
         PadTemplate("sink_%u", PadDirection.SINK, Caps.new("other/tensors"),
                     PadPresence.REQUEST),
@@ -155,6 +157,8 @@ class TensorDemux(Element):
     """
 
     ELEMENT_NAME = "tensor_demux"
+    # fusion barrier (runtime/fusion.py): request-pad fan-out
+    FUSION_BARRIER = "demux fan-out (per-pad tensor routing)"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, Caps.new("other/tensors")),)
     SRC_TEMPLATES = (
         PadTemplate("src_%u", PadDirection.SRC, Caps.new("other/tensors"),

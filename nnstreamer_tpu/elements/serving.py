@@ -58,6 +58,10 @@ class TensorServing(TransformElement):
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
     SRC_TEMPLATES = (PadTemplate("src", PadDirection.SRC, _TENSOR_CAPS),)
     DEVICE_AFFINITY = "device"  # batches execute under one jit compile cache
+    # fusion opt-out (runtime/fusion.py): cross-buffer batching state —
+    # a buffer's result depends on co-batched traffic from OTHER
+    # streams, which no pure per-buffer trace can express
+    FUSABLE = False
     PROPERTIES = {
         "framework": Prop("jax", str,
                           "backend executing the batches (jax only: the "

@@ -18,6 +18,10 @@ _ANY_MEDIA_CAPS = any_media_caps()
 @register_element
 class Tee(Element):
     ELEMENT_NAME = "tee"
+    # fusion barrier (runtime/fusion.py): fan-out shares ONE buffer
+    # across branches; segments fusing through it could donate/alias
+    # arrays a sibling branch still reads
+    FUSION_BARRIER = "tee fan-out (buffers shared across branches)"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _ANY_MEDIA_CAPS),)
     SRC_TEMPLATES = (
         PadTemplate("src_%u", PadDirection.SRC, _ANY_MEDIA_CAPS, PadPresence.REQUEST),

@@ -103,3 +103,15 @@ class TensorTransform(TransformElement):
     def transform(self, buf: Buffer) -> Optional[Buffer]:
         outs = self._jit(*buf.tensors)
         return Buffer(list(outs)).copy_metadata_from(buf)
+
+    def fusion_stage(self):
+        """Segment fusion (runtime/fusion.py): the raw per-tensor transform
+        composes into the segment's single jit — the element's own
+        ``self._jit`` dispatch disappears entirely."""
+        fn = self._fn
+        applies = self._applies
+
+        def stage(xs):
+            return tuple(fn(x) if applies(i) else x
+                         for i, x in enumerate(xs))
+        return stage

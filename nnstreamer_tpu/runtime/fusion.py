@@ -1,0 +1,362 @@
+"""Device-segment fusion compiler (L0' substrate).
+
+Inline push semantics charge every element hop a Python pad-hop plus —
+for device elements — its own ``jax.jit`` dispatch per buffer
+(``runtime/pad.py`` / ``elements/transform.py``). The reference's
+headline claim is low per-element overhead versus raw framework
+invocation (arxiv 1901.04985), and the multi-TPU follow-up shows model
+*segmentation* dominating inference time (arxiv 2503.01025); this module
+closes our side of that gap structurally: at ``Pipeline.play()`` every
+linear run of ``DEVICE_AFFINITY == "device"`` elements is partitioned
+into a **fused segment**, the per-element transforms compose into ONE
+jitted callable, and a buffer entering the segment head costs a single
+XLA dispatch instead of N chained chain()+dispatch hops.
+
+Planning vs tracing: the segment *plan* is pure topology (pad shapes,
+affinity, the ``Element.FUSABLE`` contract) and runs before PLAYING; the
+composed callable is resolved lazily on the first buffer — after caps
+negotiation has configured every member (``set_caps`` built the stage
+functions) — and is cached until invalidated.
+
+Segments break (a **fusion barrier**) at:
+  * host/neutral-affinity elements (decoders, converters, queues, tees);
+  * queue boundaries (thread + backpressure decoupling);
+  * tee/demux fan-out and mux fan-in (any element without exactly one
+    linked sink and one linked src pad, which also covers request pads
+    in use);
+  * ``tensor_if`` dynamic routing (per-buffer branch decision);
+  * stateful elements opting out via ``Element.FUSABLE = False``
+    (e.g. ``tensor_serving``: cross-buffer batching state);
+  * per-instance disqualifiers reported by ``Element.fusion_barrier()``
+    (e.g. ``tensor_filter invoke-dynamic`` / ``suspend`` / profiling).
+
+Cache invalidation: a CAPS event reaching any member invalidates its
+segment (re-traced on the next buffer), as do ``tensor_filter`` hot model
+swaps (``commit_model`` / ``reload_model`` — the service control plane's
+canary/swap path) and ``reset_flow()`` on restart (``Pipeline.play()``
+re-plans from scratch, so a supervised restart never sees a stale fused
+callable). Escape hatches: ``Pipeline(fuse=False)`` or ``NNS_NO_FUSE=1``.
+
+See docs/fusion.md for the segmentation rules and barrier table.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional
+
+from ..analysis.sanitizer import named_lock
+from ..core import Buffer, clock_now
+from ..utils import trace
+from ..utils.log import logger
+from .element import Element
+
+if TYPE_CHECKING:
+    from .pipeline import Pipeline
+
+
+# donation safety is TRANSITIVE: jit can alias an "output" back to an
+# input array whenever the traced computation passes a tensor through
+# unmodified (identity models, typecast to the same dtype, apply= skips,
+# output-combination i<N> passthrough), so an array entering the segment
+# may really be owned arbitrarily far upstream. Donation is therefore
+# allowed only when EVERY transitive upstream element is in this
+# allowlist (fresh per-frame producers and pure single-consumer movers)
+# and has a single linked src pad — anything that shares (tee/demux),
+# retains (aggregator/repo), duplicates (fault/rate), or lets the
+# application keep a reference (appsrc-style injection) disqualifies.
+_DONATION_SAFE_CHAIN = ("tensor_src", "capsfilter", "queue",
+                        "tensor_transform", "tensor_filter")
+
+
+def barrier_reason(el: "Element") -> Optional[str]:
+    """Why ``el`` cannot join a fused segment (None = fusable candidate).
+
+    Combines the element's own contract (``fusion_barrier()``: affinity,
+    FUSABLE flag, per-instance disqualifiers) with the structural
+    requirement of a linear chain: exactly one linked sink pad and one
+    linked src pad (tee/mux/demux fan and in-use request pads all fail
+    this). The graph linter's NNL010/NNL013 rules report these reasons.
+    """
+    reason = el.fusion_barrier()
+    if reason is not None:
+        return reason
+    linked_sinks = [p for p in el.sink_pads if p.is_linked]
+    linked_srcs = [p for p in el.src_pads if p.is_linked]
+    if (len(el.sink_pads) != 1 or len(el.src_pads) != 1
+            or len(linked_sinks) != 1 or len(linked_srcs) != 1):
+        return ("fan-in/fan-out (a fused segment needs exactly one linked "
+                "sink and one linked src pad)")
+    return None
+
+
+@dataclass
+class SegmentPlan:
+    """Result of :func:`plan_segments`: the fusable runs and, for every
+    non-member, why it broke a chain."""
+
+    segments: List[List["Element"]] = field(default_factory=list)
+    barriers: Dict[str, str] = field(default_factory=dict)
+
+    def describe(self) -> str:
+        lines = []
+        for seg in self.segments:
+            lines.append(" -> ".join(el.name for el in seg))
+        return "; ".join(lines) if lines else "(no fused segments)"
+
+
+def plan_segments(pipeline: "Pipeline") -> SegmentPlan:
+    """Partition the graph into maximal linear runs of fusable device
+    elements. Pure topology — nothing is traced, no backend is touched —
+    so the static linter runs this on parsed-not-started pipelines too.
+    Runs shorter than 2 elements are not segments (a single dispatch is
+    already a single dispatch)."""
+    plan = SegmentPlan()
+    members: Dict[int, bool] = {}
+    for el in pipeline.elements.values():
+        reason = barrier_reason(el)
+        if reason is not None:
+            plan.barriers[el.name] = reason
+        else:
+            members[id(el)] = True
+
+    def next_member(el: "Element") -> Optional["Element"]:
+        for pad in el.src_pads:
+            if pad.peer is not None:
+                nxt = pad.peer.element
+                return nxt if id(nxt) in members else None
+        return None
+
+    def prev_member(el: "Element") -> Optional["Element"]:
+        for pad in el.sink_pads:
+            if pad.peer is not None:
+                prv = pad.peer.element
+                return prv if id(prv) in members else None
+        return None
+
+    visited: set = set()
+    for el in pipeline.elements.values():
+        if id(el) not in members or id(el) in visited:
+            continue
+        # rewind to the head of this run (bounded to the member count so a
+        # pure-device cycle cannot spin the rewind; the cycle itself is
+        # rejected after the forward walk below)
+        head = el
+        hops = 0
+        while hops <= len(members):
+            prv = prev_member(head)
+            if prv is None or id(prv) in visited or prv is el:
+                break
+            head = prv
+            hops += 1
+        seg: List["Element"] = []
+        cur: Optional["Element"] = head
+        while cur is not None and id(cur) in members and id(cur) not in visited:
+            visited.add(id(cur))
+            seg.append(cur)
+            cur = next_member(cur)
+        # a pure-device ring linearizes to a run whose tail feeds a
+        # member again (cur stopped on 'already visited'): REJECT it — a
+        # fused tail pushing back into its own head would recurse
+        # unboundedly. (Such a ring is also unreachable by data — every
+        # sink pad is consumed inside the ring — but the planner must not
+        # rely on that.)
+        if cur is not None and any(cur is m for m in seg):
+            plan.barriers[seg[0].name] = "device-element cycle (not fusable)"
+            continue
+        if len(seg) >= 2:
+            plan.segments.append(seg)
+    return plan
+
+
+def _donation_safe(head: "Element") -> bool:
+    """Whether the segment may donate its input arrays to XLA (so the
+    upstream stage's output HBM is reused for the segment's own
+    intermediates). Requires a direct device-affinity producer AND a
+    fully single-owner upstream closure (see _DONATION_SAFE_CHAIN) —
+    a tee'd, retained, or application-held buffer donated here would be
+    deleted out from under its other reader."""
+    producer = None
+    for pad in head.sink_pads:
+        if pad.peer is not None:
+            producer = pad.peer.element
+    if producer is None or producer.device_affinity() != "device":
+        return False
+    seen = set()
+    stack = [producer]
+    while stack:
+        el = stack.pop()
+        if id(el) in seen:
+            continue
+        seen.add(id(el))
+        if el.ELEMENT_NAME not in _DONATION_SAFE_CHAIN:
+            return False
+        if sum(1 for p in el.src_pads if p.is_linked) != 1:
+            return False
+        for pad in el.sink_pads:
+            if pad.peer is not None:
+                stack.append(pad.peer.element)
+    return True
+
+
+class FusedSegment:
+    """One linear run of device elements compiled to a single dispatch.
+
+    The head element's ``_chain_guarded`` routes buffers here; interior
+    elements keep their pads, caps negotiation, and event flow untouched
+    (CAPS/EOS travel element-to-element exactly as unfused), only the
+    per-buffer data path collapses. ``dispatch`` returns False when the
+    segment cannot fuse at runtime (a member's stage is untraceable —
+    e.g. a host-native or canary-routing backend): the caller falls back
+    to the ordinary per-element chain until the next ``invalidate()``.
+    """
+
+    # sampled device-latency probe cadence: one blocking sync every N
+    # dispatches keeps the per-segment latency estimate honest without
+    # serializing the stream (same discipline as tensor_filter's
+    # latency_sampling prop)
+    PROBE_EVERY = 16
+
+    def __init__(self, elements: List["Element"]):
+        self.elements = list(elements)
+        self.head = elements[0]
+        self.tail = elements[-1]
+        self.name = f"{self.head.name}..{self.tail.name}"
+        self._lock = named_lock(f"FusedSegment._lock:{self.name}")
+        self._gen = 0            # guarded-by: _lock
+        self._call: Optional[Callable] = None   # guarded-by: _lock (reads racy-ok)
+        self._defused = False    # guarded-by: _lock (reads racy-ok)
+        # host-side per-buffer gates (QoS throttle on member filters);
+        # empty for pure transform chains, so the steady-state fused path
+        # pays zero extra Python per hop
+        self._gates = [
+            el.fusion_gate for el in elements
+            if type(el).fusion_gate is not Element.fusion_gate
+        ]
+        self._donate = _donation_safe(self.head)
+        self.stats = {
+            "elements": len(self.elements),
+            "dispatches": 0,
+            "retraces": 0,
+            "defused": 0,
+            "total_s": 0.0,
+            "probe_device_s": 0.0,
+        }
+
+    # -- cache control -------------------------------------------------------
+    def invalidate(self) -> None:
+        """Drop the cached callable: caps renegotiation, hot model swap
+        (``filter.commit_model``/``reload_model``), and restart paths call
+        this so the next buffer re-resolves against current state. Also
+        re-arms a defused segment (a canary router swapped back to a
+        traceable primary re-fuses)."""
+        with self._lock:
+            self._gen += 1
+            self._call = None
+            self._defused = False
+
+    def _build(self) -> Optional[Callable]:
+        import jax
+
+        with self._lock:
+            gen = self._gen
+        stages = []
+        for el in self.elements:
+            stage = el.fusion_stage()
+            if stage is None:
+                with self._lock:
+                    if self._gen == gen:
+                        self._defused = True
+                        self.stats["defused"] += 1
+                logger.info(
+                    "fused segment %s: %s has no traceable stage — "
+                    "falling back to per-element dispatch", self.name,
+                    el.describe())
+                return None
+            stages.append(stage)
+
+        # one tuple argument (not varargs): donate_argnums=(0,) then
+        # donates the WHOLE input pytree regardless of tensor arity
+        def composed(xs):
+            for stage in stages:
+                xs = stage(xs)
+            return xs
+
+        if self._donate:
+            jitted = jax.jit(composed, donate_argnums=(0,))
+        else:
+            jitted = jax.jit(composed)
+        # publish only if no invalidation raced the build (a commit_model
+        # between stage resolution and here must win)
+        with self._lock:
+            if self._gen == gen and not self._defused and self._call is None:
+                self._call = jitted
+                self.stats["retraces"] += 1
+        return jitted
+
+    # -- hot path ------------------------------------------------------------
+    def dispatch(self, pad, buf: Buffer) -> bool:
+        """Run the whole segment as one XLA dispatch; push the result from
+        the tail's src pad. Returns False when defused (caller chains
+        per-element instead). Outputs stay device-resident."""
+        call = self._call
+        if call is None:
+            if self._defused:
+                return False
+            call = self._build()
+            if call is None:
+                return False
+        for gate in self._gates:
+            if not gate(buf):
+                return True  # dropped (QoS throttle), buffer consumed
+        t0 = clock_now()
+        outs = call(tuple(buf.tensors))
+        # total_s gets ONLY the host-side dispatch time, even on probed
+        # frames — same channel separation as the unfused filter (device
+        # completion goes to probe_device_s)
+        dt = clock_now() - t0
+        st = self.stats
+        st["dispatches"] += 1
+        st["total_s"] += dt
+        if st["dispatches"] % self.PROBE_EVERY == 0:
+            for o in outs:
+                if hasattr(o, "block_until_ready"):
+                    # nnlint: disable=NNL101 — sampled latency probe: one
+                    # blocking sync every PROBE_EVERY dispatches, by contract
+                    o.block_until_ready()
+            st["probe_device_s"] = clock_now() - t0
+        if trace.ACTIVE:
+            trace.notify_fused(self.name, t0, dt,
+                               {"elements": len(self.elements)})
+        out = Buffer(list(outs)).copy_metadata_from(buf)
+        self.tail.push(out)
+        return True
+
+    def __repr__(self):
+        return f"FusedSegment<{self.name} n={len(self.elements)}>"
+
+
+def install(pipeline: "Pipeline") -> SegmentPlan:
+    """Plan and annotate: called from ``Pipeline.play()`` after flow reset,
+    before elements start. Idempotent — a replay re-plans from scratch."""
+    uninstall(pipeline)
+    plan = plan_segments(pipeline)
+    segments: List[FusedSegment] = []
+    for elements in plan.segments:
+        seg = FusedSegment(elements)
+        for el in elements:
+            el._fusion_member = seg
+        elements[0]._fusion_head = seg
+        segments.append(seg)
+    pipeline._fused_segments = segments
+    if segments:
+        logger.info("pipeline %s: fused %d device segment(s): %s",
+                    pipeline.name, len(segments), plan.describe())
+    return plan
+
+
+def uninstall(pipeline: "Pipeline") -> None:
+    """Clear every fusion annotation (``fuse=False`` replays, teardown)."""
+    for el in pipeline.elements.values():
+        el._fusion_member = None
+        el._fusion_head = None
+    pipeline._fused_segments = []

@@ -6,6 +6,13 @@ sink/src templates) and data flows by ``gst_pad_push``. Our model keeps the
 push semantics (caller's thread runs the downstream chain until a queue
 boundary) and event-driven caps negotiation: a fixed CAPS event travels
 downstream ahead of the first buffer.
+
+Fusion note: when the peer element heads a fused device segment
+(``runtime/fusion.py``), ``push`` still enters through the peer's
+``_chain_guarded`` — but the whole segment then runs as ONE XLA dispatch
+and the next per-element push happens at the segment *tail*. A traced
+``notify_flow`` span at a segment head therefore covers the entire fused
+chain (the interior hops no longer exist).
 """
 from __future__ import annotations
 

@@ -6,6 +6,7 @@ which in our design is event-driven and needs no separate state).
 """
 from __future__ import annotations
 
+import os
 import queue as _queue
 import threading
 import time
@@ -49,7 +50,8 @@ class Bus:
 class Pipeline:
     """A runnable graph of elements."""
 
-    def __init__(self, name: str = "pipeline", validate: bool = False):
+    def __init__(self, name: str = "pipeline", validate: bool = False,
+                 fuse: Optional[bool] = None):
         self.name = name
         # opt-in static validation at play(): the graph linter
         # (analysis.lint_pipeline) runs before data flows and logs its
@@ -57,6 +59,14 @@ class Pipeline:
         # diagnostic path, but validation never blocks a play() the
         # caller asked for (warn-only; use the lint CLI to gate hard)
         self.validate = validate
+        # device-segment fusion (runtime/fusion.py): ON by default — each
+        # linear run of device elements becomes one XLA dispatch per
+        # buffer. fuse=False (or the NNS_NO_FUSE=1 escape hatch) keeps
+        # the classic per-element dispatch path.
+        if fuse is None:
+            fuse = os.environ.get("NNS_NO_FUSE", "") not in ("1", "true", "yes")
+        self.fuse = bool(fuse)
+        self._fused_segments: list = []  # set by fusion.install at play()
         self.elements: Dict[str, Element] = {}
         self.bus = Bus()
         # running-time anchor, set at each play() (GStreamer base_time analog)
@@ -127,7 +137,18 @@ class Pipeline:
                 out[el.name] = dict(stats)
             elif hasattr(stats, "snapshot"):  # InvokeStats (tensor_filter)
                 out[el.name] = stats.snapshot()
+        # fused device segments report as pseudo-elements so the service
+        # health snapshot sees one-dispatch chains (docs/observability.md)
+        for seg in self._fused_segments:
+            if seg.stats.get("dispatches") or seg.stats.get("defused"):
+                out[f"fused:{seg.name}"] = dict(seg.stats)
         return out
+
+    @property
+    def fused_segments(self) -> list:
+        """The FusedSegments installed by the last play() (empty when
+        fuse=False or nothing fused)."""
+        return list(self._fused_segments)
 
     @property
     def sinks(self) -> List[SinkElement]:
@@ -157,6 +178,16 @@ class Pipeline:
                 self._eos_sinks.clear()
             for el in self.elements.values():
                 el.reset_flow()
+            # plan fused device segments AFTER flow reset (a restart must
+            # never reuse the previous run's callables) and BEFORE
+            # elements start; the composed jit resolves lazily once caps
+            # have negotiated — see runtime/fusion.py
+            from . import fusion
+
+            if self.fuse:
+                fusion.install(self)
+            else:
+                fusion.uninstall(self)
             # start non-sources first so queues/filters are ready before
             # data flows
             for el in self.elements.values():
@@ -235,7 +266,7 @@ class Pipeline:
     def _run_static_validation(self) -> None:
         """Warn-only graph lint at play() (validate=True): every finding
         becomes a log warning, never an exception — see docs/lint.md."""
-        from ..analysis import lint_pipeline
+        from ..analysis import Severity, lint_pipeline
 
         try:
             diags = lint_pipeline(self)
@@ -243,7 +274,10 @@ class Pipeline:
             logger.exception("%s: static validation failed to run", self.name)
             return
         for d in diags:
-            logger.warning("%s: %s", self.name, d.format())
+            # info findings (NNL013 fusion plans) are reports, not hazards
+            log = (logger.info if d.severity is Severity.INFO
+                   else logger.warning)
+            log("%s: %s", self.name, d.format())
 
     def _validate_links(self) -> None:
         for el in self.elements.values():

@@ -465,7 +465,7 @@ class ServiceManager:
 
     @staticmethod
     def _admission_lint(name: str, launch: str, strict: bool) -> None:
-        from ..analysis import lint_launch
+        from ..analysis import Severity, lint_launch
 
         try:
             diags = lint_launch(launch)
@@ -475,7 +475,12 @@ class ServiceManager:
             return
         errors = [d for d in diags if d.is_error]
         for d in diags:
-            if d not in errors or not strict:
+            if d.severity is Severity.INFO:
+                # NNL013 fusion-plan reports: what the service pipeline
+                # will fuse at play() — operational info, not a hazard
+                logger.info("service %s admission lint: %s", name,
+                            d.format())
+            elif d not in errors or not strict:
                 logger.warning("service %s admission lint: %s", name,
                                d.format())
         if strict and errors:

@@ -20,7 +20,14 @@ new version without stopping the pipeline:
 
 Warmup failure rolls back: prepared backends are released, the active
 version and every live element are untouched, and :class:`SwapError`
-carries the cause. Fractional **canary** routing wraps the live backend
+carries the cause.
+
+Fused-segment interaction (runtime/fusion.py): a filter running inside a
+fused device segment serves through a COMPOSED jitted callable, not its
+own backend dispatch. ``commit_model`` invalidates the segment right
+after the flip, so the next buffer re-traces against the new backend; a
+canary router (no traceable callable) defuses its segment for the canary
+window and the promote/cancel commit re-fuses it. Fractional **canary** routing wraps the live backend
 in a deterministic splitter that sends ``fraction`` of invokes to the
 candidate version — promote installs it for 100%, rollback discards it.
 """
@@ -69,6 +76,13 @@ class _CanaryBackend:
     def invoke(self, inputs):
         target = self.canary if self._pick_canary() else self.primary
         return target.invoke(inputs)
+
+    def fusion_callable(self):
+        """Never traceable: per-invoke routing is the whole point. Must be
+        explicit — __getattr__ would otherwise proxy to the primary's
+        traceable callable and the fused segment would re-fuse around the
+        primary, starving the canary of traffic for its whole window."""
+        return None
 
     def routing_stats(self) -> dict:
         with self._lock:

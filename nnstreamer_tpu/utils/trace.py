@@ -203,7 +203,10 @@ class ChromeTraceTracer(Tracer):
             return
         self._events.append({
             "name": f"{kind}:{name}",
-            "cat": "serving",
+            # fused-segment spans (runtime/fusion.py) get their own
+            # category so Perfetto separates one-dispatch chains from
+            # serving batches
+            "cat": "fused" if kind == "fused" else "serving",
             "ph": "X",
             # emitted immediately after the batch completes: now - dur
             # places the span on the same timeline as element spans
@@ -312,6 +315,13 @@ def notify_serving(kind: str, name: str, start_s: float, dur_s: float,
             t.serving_event(kind, name, start_s, dur_s, meta)
         except Exception:  # noqa: BLE001 - tracers must never kill serving
             pass
+
+
+def notify_fused(name: str, start_s: float, dur_s: float, meta: dict) -> None:
+    """Fused-segment span (runtime/fusion.py, only called when ACTIVE):
+    one span per single-dispatch device chain, kind="fused", so traces
+    show where N element hops collapsed into one XLA call."""
+    notify_serving("fused", name, start_s, dur_s, meta)
 
 
 def dump_dot(pipeline, reason: str = "play") -> Optional[str]:
