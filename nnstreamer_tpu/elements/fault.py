@@ -13,18 +13,171 @@ Properties: ``drop-prob``, ``dup-prob``, ``corrupt-prob`` (flip a random
 byte span in a COPY of the tensor — upstream data is never mutated),
 ``delay-ms`` (uniform 0..delay per affected buffer, ``delay-prob``
 gated), ``seed``. Counters ride on the element: ``.stats`` dict.
+
+Crash modes (supervised-restart chaos): ``crash-at-buffer`` raises on
+the Nth buffer of a run, one-shot unless ``crash-repeat`` re-arms it.
+
+Network-fault modes (:data:`net_chaos`, a process-global
+:class:`NetworkChaos`) extend the same harness to the tensor-query
+TRANSPORTS — the element above injects faults INSIDE a pipeline; these
+inject them BETWEEN pipelines, on the TCP links the query/fabric layers
+ride (query/protocol.py consults the hooks only while armed; disarmed
+costs one attribute read per send):
+
+* ``drop_conn_at(port, n)`` — kill the connection after ``n`` more DATA
+  frames touch it (mid-stream connection kill, the failure
+  ``tensor_query_client`` reconnect and fabric retries must mask);
+* ``delay_ms(port, ms)`` — every send to/from the port sleeps first
+  (slow-replica / congested-link mode, what hedging exists for);
+* ``partition_for_s(port, s)`` — connects and sends involving the port
+  fail for the window (network partition; heals by itself).
+
+All modes key on a TCP port (either endpoint of the link matches) so a
+chaos run can target one replica of a fabric without touching the rest.
+``clear()`` disarms everything and uninstalls the hooks.
 """
 from __future__ import annotations
 
 import time
+from typing import Dict
 
 import numpy as np
 
+from ..analysis.sanitizer import named_lock
 from ..core import Buffer
 from ..core.caps import any_media_caps
 from ..registry.elements import register_element
 from ..runtime.element import Element, Prop, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
+
+
+class NetworkChaos:
+    """Process-global network fault injector for the query transports.
+
+    Rules are keyed by TCP port and matched against BOTH endpoints of a
+    socket, so ``drop_conn_at(server_port, ...)`` hits the link no
+    matter which side sends. Arming installs the protocol hooks;
+    :meth:`clear` uninstalls them (zero steady-state overhead outside a
+    chaos run)."""
+
+    def __init__(self):
+        self._lock = named_lock("NetworkChaos._lock")
+        self._rules: Dict[int, dict] = {}  # port -> rule  guarded-by: _lock
+        self._armed = False                # guarded-by: _lock
+        self.stats = {"killed_conns": 0, "delayed_sends": 0,
+                      "partition_refusals": 0}  # guarded-by: _lock
+
+    # -- arming --------------------------------------------------------------
+    def _arm(self) -> None:
+        from ..query import protocol
+
+        with self._lock:
+            if self._armed:
+                return
+            self._armed = True
+        protocol.set_fault_hooks(send=self._on_send,
+                                 connect=self._on_connect)
+
+    def clear(self) -> None:
+        """Disarm every rule and uninstall the transport hooks."""
+        from ..query import protocol
+
+        with self._lock:
+            self._rules.clear()
+            self._armed = False
+        protocol.set_fault_hooks(None, None)
+
+    def _rule(self, port: int) -> dict:
+        # caller holds _lock
+        r = self._rules.get(port)
+        if r is None:
+            r = self._rules[port] = {"drop_countdown": None, "delay_s": 0.0,
+                                     "partition_until": 0.0}
+        return r
+
+    # -- modes ---------------------------------------------------------------
+    def drop_conn_at(self, port: int, n_frames: int = 0) -> None:
+        """Kill the next connection touching ``port`` after ``n_frames``
+        more DATA frames cross it (0 = on the very next frame)."""
+        with self._lock:
+            self._rule(port)["drop_countdown"] = int(n_frames)
+        self._arm()
+
+    def delay_ms(self, port: int, ms: float) -> None:
+        """Every send on a link touching ``port`` sleeps ``ms`` first
+        (slow replica / congested link). 0 removes the delay."""
+        with self._lock:
+            self._rule(port)["delay_s"] = float(ms) / 1e3
+        self._arm()
+
+    def partition_for_s(self, port: int, seconds: float) -> None:
+        """Connects and sends involving ``port`` fail for ``seconds``
+        (the partition heals by itself — readmission probes then
+        succeed)."""
+        with self._lock:
+            self._rule(port)["partition_until"] = (
+                time.monotonic() + float(seconds))
+        self._arm()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"armed": self._armed, "rules": len(self._rules),
+                    **self.stats}
+
+    # -- transport hooks (installed in query/protocol.py while armed) --------
+    def _on_connect(self, host: str, port: int) -> None:
+        with self._lock:
+            rule = self._rules.get(port)
+            partitioned = (rule is not None
+                           and time.monotonic() < rule["partition_until"])
+            if partitioned:
+                self.stats["partition_refusals"] += 1
+        if partitioned:
+            raise ConnectionRefusedError(
+                f"chaos: endpoint port {port} is partitioned")
+
+    def _on_send(self, sock, msg_type) -> None:
+        from ..query.protocol import MsgType
+
+        try:
+            ports = (sock.getpeername()[1], sock.getsockname()[1])
+        except OSError:
+            return  # socket already dead; let sendall report it
+        delay_s = 0.0
+        kill = None  # (reason, port)
+        with self._lock:
+            for p in ports:
+                rule = self._rules.get(p)
+                if rule is None:
+                    continue
+                if time.monotonic() < rule["partition_until"]:
+                    self.stats["partition_refusals"] += 1
+                    kill = ("partitioned", p)
+                    break
+                cd = rule["drop_countdown"]
+                if cd is not None and msg_type is MsgType.DATA:
+                    if cd <= 0:
+                        rule["drop_countdown"] = None  # one-shot
+                        self.stats["killed_conns"] += 1
+                        kill = ("connection killed", p)
+                        break
+                    rule["drop_countdown"] = cd - 1
+                if rule["delay_s"] > 0:
+                    delay_s = max(delay_s, rule["delay_s"])
+                    self.stats["delayed_sends"] += 1
+        if kill is not None:
+            from ..query.server import _shutdown_close
+
+            reason, p = kill
+            _shutdown_close(sock)  # FIN both ways: the peer's reader wakes
+            raise ConnectionResetError(
+                f"chaos: {reason} (port {p})")
+        if delay_s > 0:
+            time.sleep(delay_s)  # outside _lock: never stall other links
+
+
+#: the process-global injector tools/chaos.py and the fabric tests drive
+net_chaos = NetworkChaos()
 
 
 @register_element

@@ -13,7 +13,7 @@ from typing import Optional
 from ..core import Buffer, Caps, parse_caps_string
 from ..core.serialize import pack_tensors, unpack_tensors
 from ..utils.log import logger
-from .protocol import MsgType, recv_msg, send_msg
+from .protocol import MsgType, check_connect_fault, recv_msg, send_msg
 
 
 class Disconnected:
@@ -24,6 +24,14 @@ class Disconnected:
 
 
 DISCONNECTED = Disconnected()
+
+
+class RemoteError(RuntimeError):
+    """A typed ERROR frame received AFTER the handshake — the server shed
+    or failed this request (e.g. serving admission control on an
+    attach_scheduler server). Rides the ``responses`` queue so a waiter
+    blocked on an answer learns the request-level outcome promptly
+    instead of timing out; the fabric retries these on another replica."""
 
 
 class QueryClient:
@@ -42,6 +50,7 @@ class QueryClient:
     def connect(self, caps: Caps) -> Caps:
         """TCP connect + caps handshake; returns the server's caps
         (remote caps negotiation, tensor_query_client.c:386-460)."""
+        check_connect_fault(self.host, self.port)  # chaos partition gate
         self._sock = socket.create_connection((self.host, self.port),
                                               timeout=self.timeout)
         self._sock.settimeout(None)
@@ -75,9 +84,16 @@ class QueryClient:
                     self.server_caps = parse_caps_string(payload.decode())
                     self._caps_event.set()
                 elif msg_type is MsgType.ERROR:
-                    logger.error("tensor-query server error: %s", payload.decode())
-                    self.server_caps = None
-                    self._caps_event.set()
+                    text = payload.decode()
+                    if not self._caps_event.is_set():
+                        # pre-handshake: caps rejection ends the connect
+                        logger.error("tensor-query server error: %s", text)
+                        self.server_caps = None
+                        self._caps_event.set()
+                    else:
+                        # post-handshake: a request-level error (serving
+                        # shed) — deliver it to the answer waiter
+                        self.responses.put(RemoteError(text))
                 elif msg_type is MsgType.DATA:
                     self.responses.put(unpack_tensors(payload))
                 elif msg_type is MsgType.EOS:
@@ -94,6 +110,29 @@ class QueryClient:
         if self._sock is None:
             raise ConnectionError("tensor-query client not connected")
         send_msg(self._sock, MsgType.DATA, pack_tensors(buf.as_numpy()))
+
+    def request(self, buf: Buffer, timeout: float) -> Buffer:
+        """Blocking call: send one frame, wait for ITS answer (the link is
+        used exclusively by one in-flight request — the fabric's
+        connection discipline — so FIFO matching is exact). Raises
+        ``TimeoutError`` when no answer lands in ``timeout`` (the caller
+        must then discard this client: a late answer would mis-match the
+        next request), ``ConnectionError`` on link death/EOS, and
+        :class:`RemoteError` when the server answered with a typed
+        error."""
+        self.send(buf)
+        try:
+            item = self.responses.get(timeout=timeout)
+        except _queue.Empty:
+            raise TimeoutError(
+                f"no answer from {self.host}:{self.port} in {timeout:.2f}s")
+        if item is None:
+            raise ConnectionError("server ended the stream (EOS)")
+        if item is DISCONNECTED:
+            raise ConnectionError("connection lost awaiting the answer")
+        if isinstance(item, RemoteError):
+            raise item
+        return item
 
     def send_eos(self) -> None:
         if self._sock is not None:

@@ -21,7 +21,7 @@ from ..registry.elements import register_element
 from ..runtime.element import Element, ElementError, Prop, SinkElement, SourceElement, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
 from ..utils.log import logger
-from .client import DISCONNECTED, QueryClient
+from .client import DISCONNECTED, QueryClient, RemoteError
 from .edge import PubSubBroker, get_broker, release_broker
 from .server import (
     QueryServer,
@@ -274,6 +274,12 @@ class TensorQueryClient(Element):
             if buf is None:  # clean server EOS
                 self.send_eos()
                 return
+            if isinstance(buf, RemoteError):
+                # server shed this request (serving admission): same
+                # frame-drop QoS semantics as a send while disconnected
+                logger.warning("%s: request shed by server: %s",
+                               self.name, buf)
+                continue
             if buf is DISCONNECTED:
                 if not self._running.is_set() or not self.props["reconnect"]:
                     self.send_eos()

@@ -31,10 +31,37 @@ class MsgType(enum.IntEnum):
     ERROR = 4
 
 
+# -- chaos hooks -------------------------------------------------------------
+# Installed by elements/fault.py's NetworkChaos when armed; None (the
+# default) costs one attribute read per send/connect and nothing else.
+# send hook: (sock, msg_type) -> None, may sleep (delay) or raise
+# ConnectionError (partition / injected connection kill); connect hook:
+# (host, port) -> None, may raise ConnectionError (partition).
+_send_fault_hook = None
+_connect_fault_hook = None
+
+
+def set_fault_hooks(send=None, connect=None) -> None:
+    global _send_fault_hook, _connect_fault_hook
+    _send_fault_hook = send
+    _connect_fault_hook = connect
+
+
+def check_connect_fault(host: str, port: int) -> None:
+    """Called by transports before dialing; raises when the endpoint is
+    chaos-partitioned."""
+    hook = _connect_fault_hook
+    if hook is not None:
+        hook(host, port)
+
+
 def send_msg(sock: socket.socket, msg_type: MsgType, payload=b"") -> None:
     """Send one frame; accepts bytes or a memoryview payload. Large payloads
     go out as a second sendall so a memoryview from ``pack_tensors`` is never
     copied into a concatenated bytes object."""
+    hook = _send_fault_hook
+    if hook is not None:
+        hook(sock, msg_type)
     header = _HEADER.pack(MAGIC, int(msg_type), len(payload))
     if len(payload) <= 1 << 13:
         sock.sendall(header + bytes(payload))

@@ -12,7 +12,7 @@ import queue as _queue
 import socket
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..core import Buffer, Caps, parse_caps_string
 from ..core.serialize import pack_tensors, unpack_tensors
@@ -62,22 +62,34 @@ class QueryServer:
         self._next_id = 0
         self._lock = threading.Lock()
         self._running = threading.Event()
-        self._accept_thread: Optional[threading.Thread] = None
-        self._serve_thread: Optional[threading.Thread] = None
+        self._accepting = False
+        self._serving = False
         self._client_threads = ThreadRegistry()
+        # accept/serve threads ride a registry (like client-connection
+        # workers), so stop() joins them uniformly and SURFACES any
+        # straggler instead of silently abandoning it
+        self._core_threads = ThreadRegistry()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "QueryServer":
-        if self._accept_thread is not None:
+        if self._accepting:
             return self
+        self._accepting = True
         self._running.set()
-        self._accept_thread = threading.Thread(
+        t = threading.Thread(
             target=self._accept_loop, name=f"qserver:{self.port}", daemon=True
         )
-        self._accept_thread.start()
+        t.start()
+        self._core_threads.track(
+            t, closer=lambda: _shutdown_close(self._sock))
         return self
 
-    def stop(self) -> None:
+    def stop(self) -> List[threading.Thread]:
+        """Stop accepting, wake and join every worker. Returns the
+        STRAGGLERS — threads that outlived their join timeout — after
+        logging them, so callers (and the autouse thread-leak fixture)
+        see a stuck accept/serve/client worker instead of a silent
+        daemon leak."""
         self._running.clear()
         _shutdown_close(self._sock)
         with self._lock:
@@ -86,13 +98,16 @@ class QueryServer:
         for c in clients:
             _shutdown_close(c)
         # client sockets just closed above: the loops exit promptly
-        self._client_threads.drain(timeout_per=1.0)
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=2.0)
-            self._accept_thread = None
-        if self._serve_thread is not None:
-            self._serve_thread.join(timeout=2.0)
-            self._serve_thread = None
+        stragglers = self._client_threads.drain(timeout_per=1.0)
+        stragglers += self._core_threads.drain(timeout_per=2.0)
+        self._accepting = False
+        self._serving = False
+        for t in stragglers:
+            logger.warning(
+                "query server %d: thread %s still alive after stop() "
+                "join timeout — it will leak until it unblocks",
+                self.port, t.name)
+        return stragglers
 
     # -- serving-scheduler bridge -------------------------------------------
     def attach_scheduler(self, scheduler, priority: int = 0,
@@ -108,8 +123,9 @@ class QueryServer:
         Standalone-server mode only: the bridge consumes ``inbox``, so do
         not combine with a ``tensor_query_serversrc`` on the same id.
         """
-        if self._serve_thread is not None:
+        if self._serving:
             raise RuntimeError("a scheduler is already attached")
+        self._serving = True
         self.start()
 
         def _error_reply(client_id: int, err: BaseException) -> None:
@@ -141,10 +157,26 @@ class QueryServer:
                 if isinstance(item, tuple):  # ("eos", client_id)
                     continue
                 client_id = item.meta.get("client_id")
+                # fabric deadline propagation: a frame that arrived with
+                # a remaining budget (service/fabric.py stamps it per
+                # attempt) must not occupy a batch slot it cannot finish
+                # in — the TIGHTER of the frame's budget and the static
+                # attach-time deadline applies
+                eff_deadline = deadline_s
+                fabric_meta = item.meta.get("fabric")
+                if isinstance(fabric_meta, dict):
+                    try:  # meta is client-supplied wire data: a bad
+                        # value must not kill the one serve thread
+                        budget = float(fabric_meta["deadline_s"])
+                    except (KeyError, TypeError, ValueError):
+                        budget = None
+                    if budget is not None:
+                        eff_deadline = (budget if deadline_s is None
+                                        else min(deadline_s, budget))
                 try:
                     scheduler.submit(
                         tuple(item.tensors), priority=priority,
-                        deadline_s=deadline_s,
+                        deadline_s=eff_deadline,
                         on_done=lambda req, cid=client_id: _answer(cid, req))
                 except AdmissionError:
                     pass  # on_done already delivered the typed ERROR
@@ -155,10 +187,11 @@ class QueryServer:
                     # typed ERROR instead of a dead thread's silence
                     _error_reply(client_id, err)
 
-        self._serve_thread = threading.Thread(
+        t = threading.Thread(
             target=_serve_loop, name=f"qserver:{self.port}:serve",
             daemon=True)
-        self._serve_thread.start()
+        t.start()
+        self._core_threads.track(t)
 
     # -- accept/read --------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -252,6 +285,8 @@ class QueryServer:
 _servers: Dict[int, QueryServer] = {}
 _server_refs: Dict[int, int] = {}
 _servers_lock = threading.Lock()
+# registration wakes lookup waiters (replaces the old 20 ms poll loop)
+_servers_cond = threading.Condition(_servers_lock)
 
 
 def get_shared_server(server_id: int, host: str = "127.0.0.1",
@@ -259,35 +294,43 @@ def get_shared_server(server_id: int, host: str = "127.0.0.1",
     """Acquire the shared server for ``server_id`` (refcounted: serversrc and
     serversink each acquire in start() and release in stop(), mirroring the
     reference's shared edge-handle table, tensor_query_server.c:76-117)."""
-    with _servers_lock:
+    with _servers_cond:
         srv = _servers.get(server_id)
         if srv is None:
             srv = QueryServer(host, port).start()
             _servers[server_id] = srv
             _server_refs[server_id] = 0
         _server_refs[server_id] += 1
+        _servers_cond.notify_all()  # a serversink may be parked in lookup
         return srv
 
 
 def lookup_shared_server(server_id: int, timeout: float = 5.0) -> QueryServer:
-    """Acquire the EXISTING server for ``server_id``, waiting for its
-    creator (tensor_query_serversrc) to register it. The serversink must
-    never create the server itself: it doesn't know the host/port, and a
+    """Acquire the EXISTING server for ``server_id``, waiting (on the
+    table's condition — no polling) for its creator
+    (tensor_query_serversrc) to register it. The serversink must never
+    create the server itself: it doesn't know the host/port, and a
     sink-first start would pin the listener to an ephemeral port while the
     src's port= property gets silently ignored (reference: serversink looks
     up the handle serversrc created, tensor_query_server.c:76-117)."""
     deadline = time.monotonic() + timeout
-    while True:
-        with _servers_lock:
+    with _servers_cond:
+        while True:
             srv = _servers.get(server_id)
             if srv is not None:
                 _server_refs[server_id] += 1
                 return srv
-        if time.monotonic() >= deadline:
-            raise KeyError(
-                f"no tensor-query server with id {server_id} — is a "
-                "tensor_query_serversrc with the same id running?")
-        time.sleep(0.02)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                known = sorted(_servers)
+                raise KeyError(
+                    f"no tensor-query server with id {server_id} after "
+                    f"{timeout:.1f}s — is a tensor_query_serversrc with "
+                    f"the same id running? (registered server ids: "
+                    f"{known if known else 'none'})")
+            # bounded slice: stay responsive to a deadline that expires
+            # between registrations without burning CPU in a poll loop
+            _servers_cond.wait(min(remaining, 0.2))
 
 
 def release_shared_server(server_id: int) -> None:

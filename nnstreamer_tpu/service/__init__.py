@@ -14,7 +14,12 @@ first-class features instead of caller responsibilities:
 * **zero-downtime model rollout** — versioned model slots referenced
   from launch lines as ``registry://<slot>``, hot-swapped live via
   prepare → warmup → atomic flip → retire (rollback on warmup failure),
-  plus fractional canary routing between two versions.
+  plus fractional canary routing between two versions;
+* **a distributed replica fabric** (:mod:`.fabric`) — N service
+  replicas behind one logical name with consistent-hash + bounded-load
+  routing, retries/hedging under one propagated deadline, health-scored
+  eviction → quarantine → probed readmission, and rolling hot swap +
+  canary ACROSS replicas (docs/fabric.md).
 
 Quick start::
 
@@ -38,6 +43,15 @@ HTTP endpoint + CLI: ``python -m nnstreamer_tpu serve`` /
 docs/service.md).
 """
 from .api import ControlClient, ControlServer  # noqa: F401
+from .fabric import (  # noqa: F401
+    FabricError,
+    NoReplicaAvailable,
+    Replica,
+    ReplicaPool,
+    ReplicaState,
+    RequestFailed,
+    ServiceFabric,
+)
 from .health import HealthMonitor, service_snapshot  # noqa: F401
 from .manager import (  # noqa: F401
     AdmissionRejected,
@@ -55,11 +69,18 @@ __all__ = [
     "ControlClient",
     "ControlServer",
     "CrashReport",
+    "FabricError",
     "HealthMonitor",
     "ModelSlots",
+    "NoReplicaAvailable",
+    "Replica",
+    "ReplicaPool",
+    "ReplicaState",
+    "RequestFailed",
     "RestartPolicy",
     "Service",
     "ServiceError",
+    "ServiceFabric",
     "ServiceManager",
     "ServiceSpec",
     "ServiceState",

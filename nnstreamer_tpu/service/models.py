@@ -184,10 +184,19 @@ class ModelSlots:
         return out
 
     # -- hot swap ------------------------------------------------------------
-    def swap(self, name: str, version: str) -> dict:
+    def swap(self, name: str, version: str, services=None,
+             activate: bool = True) -> dict:
         """Roll every bound running filter to ``version`` (prepare → warmup
         → flip → retire), then activate it for future starts. Rollback on
-        any warmup failure. Returns {"slot","version","flipped": N}."""
+        any warmup failure. Returns {"slot","version","flipped": N}.
+
+        ``services`` restricts the flip to filters bound through those
+        :class:`~.manager.Service` objects — the per-replica step of a
+        fabric ROLLING swap (service/fabric.py drains one replica, flips
+        only it, readmits, then moves on). ``activate=False`` flips the
+        selected filters without advancing the slot's active version
+        (fabric replica-canary: one replica serves the candidate while
+        restarts elsewhere still resolve the old version)."""
         uri = self.uri(name, version)  # validates slot + version
         with self._lock:
             has_canary = self._slot(name)["canary"] is not None
@@ -197,6 +206,9 @@ class ModelSlots:
             # retires a plain backend
             self.cancel_canary(name)
         bound = self.bound_filters(name)
+        if services is not None:
+            keep = {id(s) for s in services}
+            bound = [(svc, el) for svc, el in bound if id(svc) in keep]
         prepared = self._prepare_all(bound, uri, name, version,
                                      what=f"swap to '{version}'")
         # phase 2: atomic flips (pointer store under each element's invoke
@@ -206,12 +218,14 @@ class ModelSlots:
         for el, backend in prepared:
             old = el.commit_model(backend, f"registry://{name}")
             el.release_prepared(old)
-        with self._lock:
-            self._slot(name)["active"] = version
-            self._slot(name)["canary"] = None
-        self._publish(name)
+        if activate:
+            with self._lock:
+                self._slot(name)["active"] = version
+                self._slot(name)["canary"] = None
+            self._publish(name)
         logger.info("slot %s: swapped to version %s (%d live filters "
-                    "flipped)", name, version, len(prepared))
+                    "flipped%s)", name, version, len(prepared),
+                    "" if activate else ", not activated")
         return {"slot": name, "version": version, "flipped": len(prepared)}
 
     def _prepare_all(self, bound, uri: str, name: str, version: str,

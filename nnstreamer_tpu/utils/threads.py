@@ -58,15 +58,23 @@ class ThreadRegistry:
         for closer_fn in dead:
             self._close(closer_fn)
 
-    def drain(self, timeout_per: float = 1.0) -> None:
+    def drain(self, timeout_per: float = 1.0) -> List[threading.Thread]:
         """Run every closer (wakes parked workers), then join every
         tracked thread (bounded per thread; the current thread is
-        skipped so a worker can drain its own registry)."""
+        skipped so a worker can drain its own registry). Returns the
+        STRAGGLERS — threads still alive after their join timeout — so
+        the owner can surface them (a silent ``join(timeout=)`` that
+        never checks ``is_alive()`` hides a stuck worker forever)."""
         with self._lock:
             entries, self._entries = self._entries, []
         for _t, closer in entries:
             self._close(closer)
         me = threading.current_thread()
+        stragglers: List[threading.Thread] = []
         for t, _closer in entries:
-            if t is not me:
-                t.join(timeout=timeout_per)
+            if t is me:
+                continue
+            t.join(timeout=timeout_per)
+            if t.is_alive():
+                stragglers.append(t)
+        return stragglers

@@ -108,6 +108,113 @@ class TestReconnect:
             server.stop()
 
 
+class TestReconnectWindowEdges:
+    """Reconnect-window edge cases (ISSUE 6 satellite): hybrid
+    re-discovery onto a NEW port mid-window, success landing right at
+    the window's edge, and stop() interrupting the backoff wait."""
+
+    def test_hybrid_rediscovery_new_port_mid_window(self):
+        """HYBRID client: the server dies and comes back on a DIFFERENT
+        port, re-advertised through the broker. The reconnect path
+        re-discovers on EVERY attempt, so the stream resumes on the new
+        address without a pipeline restart."""
+        from nnstreamer_tpu.query.hybrid import advertise
+        from nnstreamer_tpu.query.mqtt import MiniBroker
+
+        broker = MiniBroker()
+        server, port = start_echo_server(server_id=56)
+        advertise(broker.host, broker.port, "rw-topic", "127.0.0.1", port)
+        client = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=4,types=float32 "
+            f"! tensor_query_client connect-type=HYBRID host={broker.host} "
+            f"port={broker.port} topic=rw-topic "
+            "reconnect-window=15 max-reconnect-delay=0.3 timeout=2 "
+            "! tensor_sink name=out"
+        )
+        out = []
+        client.get("out").connect(out.append)
+        try:
+            client.play()
+            src = client.get("in")
+            _push_until(src, out, want=2)
+            n_before = len(out)
+            server.stop()  # the advertised address is now dead
+            server, new_port = start_echo_server(server_id=57)
+            assert new_port != port
+            advertise(broker.host, broker.port, "rw-topic",
+                      "127.0.0.1", new_port)
+            _push_until(src, out, want=n_before + 3, value=5.0, timeout=15.0)
+            assert len(out) >= n_before + 3, "stream did not resume on new port"
+            assert np.allclose(np.asarray(out[-1].tensors[0]), 5.0)
+        finally:
+            client.stop()
+            server.stop()
+            broker.stop()
+
+    def test_reconnect_success_at_window_edge(self):
+        """The server returns just before the reconnect window closes:
+        the last in-window attempt must still succeed (no premature
+        give-up), and no ERROR is posted."""
+        server, port = start_echo_server(server_id=58)
+        client = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=4,types=float32 "
+            f"! tensor_query_client host=127.0.0.1 port={port} "
+            "reconnect-window=3.0 max-reconnect-delay=0.3 timeout=1 "
+            "! tensor_sink name=out"
+        )
+        out = []
+        client.get("out").connect(out.append)
+        try:
+            client.play()
+            src = client.get("in")
+            _push_until(src, out, want=1)
+            n_before = len(out)
+            server.stop()
+            # hold the outage until ~80% of the window is spent, then
+            # come back: the remaining attempts land inside the window
+            time.sleep(2.3)
+            server, port2 = start_echo_server(port=port, server_id=59)
+            assert port2 == port
+            _push_until(src, out, want=n_before + 2, value=4.0, timeout=15.0)
+            assert len(out) >= n_before + 2, "edge-of-window reconnect failed"
+            msg = client.bus.pop(timeout=0)
+            while msg is not None:
+                assert msg.type is not MessageType.ERROR, msg
+                msg = client.bus.pop(timeout=0)
+        finally:
+            client.stop()
+            server.stop()
+
+    def test_stop_interrupts_backoff_promptly(self):
+        """stop() during the reconnect backoff must return promptly (the
+        _stopping event wakes the wait) — not after riding out
+        max-reconnect-delay or the window."""
+        server, port = start_echo_server(server_id=60)
+        client = parse_launch(
+            "appsrc name=in caps=other/tensors,format=static,dimensions=4,types=float32 "
+            f"! tensor_query_client host=127.0.0.1 port={port} "
+            "reconnect-window=30 max-reconnect-delay=8 timeout=1 "
+            "! tensor_sink name=out"
+        )
+        out = []
+        client.get("out").connect(out.append)
+        try:
+            client.play()
+            _push_until(client.get("in"), out, want=1)
+            server.stop()
+            # let the pull loop notice the drop and enter backoff (first
+            # attempt fails fast: nothing listens on the port)
+            time.sleep(0.8)
+            t0 = time.monotonic()
+            client.stop()
+            elapsed = time.monotonic() - t0
+            assert elapsed < 3.0, (
+                f"stop() took {elapsed:.1f}s — backoff was not interrupted")
+        finally:
+            client.stop()
+            server.stop()
+
+
 class TestShardBranchFailure:
     def test_surviving_branch_keeps_streaming(self):
         """Two query workers behind tensor_shard; one dies permanently.

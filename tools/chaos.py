@@ -1,0 +1,353 @@
+"""Chaos harness: drive the service fabric through every failover path.
+
+Each scenario builds a 3-replica fabric (supervised query-server
+services behind one :class:`~nnstreamer_tpu.service.fabric.ReplicaPool`),
+runs sustained request traffic against it, injects ONE class of fault
+mid-traffic, and gates on the fabric's core promise: **zero
+client-visible request errors** — every fault is masked by retry, hedge,
+eviction, or readmission. Faults are injected through
+``elements/fault.py``'s :data:`net_chaos` (transport-level: connection
+kill, delay, partition) and through service verbs (process-death analog:
+hard service stop).
+
+Scenarios
+=========
+
+``replica-kill``   hard-stop one replica mid-traffic; it must be evicted,
+                   traffic rerouted, and (after revive) readmitted.
+``conn-kill``      kill a live connection after N frames (net_chaos
+                   drop_conn_at); the pool retries on another replica.
+``partition``      partition one replica for a window; evict while
+                   unreachable, readmit after the partition heals.
+``slow-replica``   delay one replica's link; hedging keeps tail latency
+                   bounded by the healthy replicas.
+``rolling-swap``   registry:// hot swap rolled across all replicas
+                   (drain → flip → readmit each) under traffic.
+
+Usage::
+
+    python tools/chaos.py                 # all scenarios, JSON report
+    python tools/chaos.py --smoke         # CI: replica-kill + conn-kill
+    python tools/chaos.py --scenario partition
+    NNS_TSAN=1 python tools/chaos.py      # under the lock sanitizer
+
+Exit nonzero when any scenario reports errors (or, under NNS_TSAN=1,
+when the sanitizer recorded a lock-order violation).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+CAPS = "other/tensors,format=static,dimensions=4,types=float32"
+
+
+class Traffic:
+    """Sustained request load from N worker threads; counts outcomes."""
+
+    def __init__(self, fabric, rate_hz: float = 100.0, workers: int = 2,
+                 timeout: float = 8.0):
+        self.fabric = fabric
+        self.period = 1.0 / rate_hz
+        self.timeout = timeout
+        self.errors: list = []
+        self.ok = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._run, name=f"fabric:traffic:{i}",
+                             daemon=True)
+            for i in range(workers)]
+
+    def _run(self) -> None:
+        import numpy as np
+
+        i = 0
+        me = threading.current_thread().name
+        while not self._stop.is_set():
+            i += 1
+            try:
+                out = self.fabric.request(
+                    [np.full(4, float(i % 17), np.float32)],
+                    key=f"{me}:{i}", timeout=self.timeout)
+                assert out.tensors, "empty answer"
+                with self._lock:
+                    self.ok += 1
+            except Exception as e:  # noqa: BLE001 - every error is the signal
+                with self._lock:
+                    self.errors.append(f"{type(e).__name__}: {e}")
+            self._stop.wait(self.period)
+
+    def __enter__(self) -> "Traffic":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.timeout + 2.0)
+
+
+def _fabric(mgr, name: str, **pool_kw):
+    from nnstreamer_tpu.service import ServiceFabric
+
+    pool_kw.setdefault("quarantine_base_s", 0.2)
+    pool_kw.setdefault("health_poll_s", 0.05)
+    fab = ServiceFabric(
+        mgr, name, "tensor_filter framework=jax model=registry://chaos",
+        CAPS, replicas=3, **pool_kw)
+    fab.start()
+    return fab
+
+
+def _warmup(fab, n: int = 6) -> None:
+    """First invoke per replica jit-compiles (seconds on CPU); chaos
+    latency numbers must not include cold starts."""
+    import numpy as np
+
+    for i in range(n):
+        fab.request([np.zeros(4, np.float32)], key=f"warm{i}", timeout=30.0)
+
+
+def _wait_counter(pool, key: str, want: int, timeout: float = 10.0) -> int:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        n = pool.snapshot()[key]
+        if n >= want:
+            return n
+        time.sleep(0.05)
+    return pool.snapshot()[key]
+
+
+def _scenario(name: str):
+    def deco(fn):
+        SCENARIOS[name] = fn
+        return fn
+    return deco
+
+
+SCENARIOS: dict = {}
+
+
+@_scenario("replica-kill")
+def replica_kill(mgr, duration: float) -> dict:
+    """Kill one of 3 replicas mid-traffic (process-death analog), then
+    revive it; traffic never sees an error, the pool evicts + readmits."""
+    fab = _fabric(mgr, "chaos-kill")
+    try:
+        _warmup(fab)
+        with Traffic(fab) as tr:
+            time.sleep(duration / 3)
+            fab.kill_replica(1)
+            evicted = _wait_counter(fab.pool, "evictions", 1)
+            time.sleep(duration / 3)
+            fab.revive_replica(1)
+            readmitted = _wait_counter(fab.pool, "readmissions", 1)
+            time.sleep(duration / 3)
+        snap = fab.snapshot()
+        return {"requests": tr.ok, "errors": tr.errors,
+                "evictions": evicted, "readmissions": readmitted,
+                "retries": snap["retries"],
+                "ok": (not tr.errors and tr.ok > 0
+                       and evicted >= 1 and readmitted >= 1)}
+    finally:
+        fab.stop()
+
+
+@_scenario("conn-kill")
+def conn_kill(mgr, duration: float) -> dict:
+    """Kill live connections to one replica after a few frames; retries
+    on other replicas mask every kill."""
+    from nnstreamer_tpu.elements.fault import net_chaos
+
+    fab = _fabric(mgr, "chaos-conn")
+    try:
+        _warmup(fab)
+        port = fab._bound_port(fab.services()[0])
+        kills = 0
+        with Traffic(fab) as tr:
+            deadline = time.monotonic() + duration
+            while time.monotonic() < deadline:
+                net_chaos.drop_conn_at(port, 3)
+                kills += 1
+                time.sleep(duration / 5)
+        chaos = net_chaos.snapshot()
+        net_chaos.clear()
+        return {"requests": tr.ok, "errors": tr.errors,
+                "kills_armed": kills, "conns_killed": chaos["killed_conns"],
+                "ok": (not tr.errors and tr.ok > 0
+                       and chaos["killed_conns"] >= 1)}
+    finally:
+        net_chaos.clear()
+        fab.stop()
+
+
+@_scenario("partition")
+def partition(mgr, duration: float) -> dict:
+    """Partition one replica's port for a window; the pool evicts it,
+    and readmits only after the partition heals (probes fail through)."""
+    from nnstreamer_tpu.elements.fault import net_chaos
+
+    fab = _fabric(mgr, "chaos-part")
+    try:
+        _warmup(fab)
+        port = fab._bound_port(fab.services()[2])
+        with Traffic(fab) as tr:
+            time.sleep(duration / 4)
+            net_chaos.partition_for_s(port, duration / 4)
+            evicted = _wait_counter(fab.pool, "evictions", 1)
+            readmitted = _wait_counter(
+                fab.pool, "readmissions", 1, timeout=duration / 2 + 8)
+            time.sleep(duration / 4)
+        net_chaos.clear()
+        return {"requests": tr.ok, "errors": tr.errors,
+                "evictions": evicted, "readmissions": readmitted,
+                "ok": (not tr.errors and tr.ok > 0
+                       and evicted >= 1 and readmitted >= 1)}
+    finally:
+        net_chaos.clear()
+        fab.stop()
+
+
+@_scenario("slow-replica")
+def slow_replica(mgr, duration: float) -> dict:
+    """Delay one replica's link well past the hedge threshold; hedged
+    duplicates on healthy replicas keep the tail bounded."""
+    from nnstreamer_tpu.elements.fault import net_chaos
+
+    fab = _fabric(mgr, "chaos-slow", hedge_after_s=0.1)
+    try:
+        _warmup(fab)
+        port = fab._bound_port(fab.services()[1])
+        lat: list = []
+        import numpy as np
+
+        net_chaos.delay_ms(port, 500)
+        deadline = time.monotonic() + duration
+        errors: list = []
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            try:
+                fab.request([np.ones(4, np.float32)],
+                            key=f"s{len(lat)}", timeout=8.0)
+                lat.append(time.monotonic() - t0)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{type(e).__name__}: {e}")
+        net_chaos.clear()
+        snap = fab.snapshot()
+        lat.sort()
+        p95 = lat[int(0.95 * (len(lat) - 1))] if lat else 0.0
+        return {"requests": len(lat), "errors": errors,
+                "hedges": snap["hedges"], "hedge_wins": snap["hedge_wins"],
+                "p95_s": round(p95, 4),
+                # a hedged fabric must beat the injected 500 ms floor a
+                # delayed round-trip (2 delayed sends) would cost
+                "ok": (not errors and len(lat) > 0
+                       and snap["hedges"] >= 1 and p95 < 0.5)}
+    finally:
+        net_chaos.clear()
+        fab.stop()
+
+
+@_scenario("rolling-swap")
+def rolling_swap(mgr, duration: float) -> dict:
+    """Roll the model slot across all replicas under traffic; zero
+    errors, and traffic lands on the new version when the roll ends."""
+    import numpy as np
+
+    fab = _fabric(mgr, "chaos-roll")
+    try:
+        _warmup(fab)
+        with Traffic(fab) as tr:
+            time.sleep(duration / 3)
+            rolled = fab.rolling_swap("chaos", "2")
+            time.sleep(duration / 3)
+        out = fab.request([np.ones(4, np.float32)], key="verify", timeout=8.0)
+        factor = float(out.tensors[0].reshape(-1)[0])
+        return {"requests": tr.ok, "errors": tr.errors,
+                "rolled": rolled["replicas"], "post_swap_factor": factor,
+                "ok": not tr.errors and tr.ok > 0 and factor == 3.0}
+    finally:
+        fab.stop()
+
+
+def run(scenarios, duration: float) -> dict:
+    from nnstreamer_tpu.service import ServiceManager
+
+    results = {}
+    for name in scenarios:
+        mgr = ServiceManager(jitter_seed=0)
+        mgr.models.define("chaos", {"1": "builtin://scaler?factor=2",
+                                    "2": "builtin://scaler?factor=3"},
+                          active="1")
+        try:
+            results[name] = SCENARIOS[name](mgr, duration)
+        finally:
+            mgr.shutdown()
+        status = "ok" if results[name]["ok"] else "FAILED"
+        print(f"[chaos] {name}: {status} "
+              f"({results[name].get('requests', 0)} requests, "
+              f"{len(results[name].get('errors', []))} errors)",
+              file=sys.stderr)
+    report = {"bench": "fabric_chaos", "scenarios": results,
+              "ok": all(r["ok"] for r in results.values())}
+    tsan = _tsan_verdict()
+    if tsan is not None:
+        report["tsan_violations"] = tsan
+        report["ok"] = report["ok"] and not tsan
+    return report
+
+
+def _tsan_verdict():
+    """Under NNS_TSAN=1 the whole harness ran with instrumented locks —
+    surface (and gate on) anything the sanitizer recorded."""
+    from nnstreamer_tpu.analysis import sanitizer
+
+    if not sanitizer.is_enabled():
+        return None
+    return sanitizer.violations()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS), default=None,
+                    help="run one scenario (default: all)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI: replica-kill + conn-kill, short duration")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="per-scenario traffic seconds")
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args()
+    if os.environ.get("NNS_TSAN") == "1":
+        from nnstreamer_tpu.analysis import sanitizer
+
+        sanitizer.enable(hold_warn_s=5.0)
+    if args.smoke:
+        scenarios = ["replica-kill", "conn-kill"]
+        duration = args.duration or 2.0
+    elif args.scenario:
+        scenarios = [args.scenario]
+        duration = args.duration or 4.0
+    else:
+        scenarios = sorted(SCENARIOS)
+        duration = args.duration or 4.0
+    report = run(scenarios, duration)
+    print(json.dumps(report, indent=2, default=str))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=2, default=str)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    os._exit(rc)  # skip backend teardown aborts (same stance as bench.py)
