@@ -15,6 +15,8 @@ launch conversion), ``tools/development/nnstreamerCodeGenCustomFilter.py``
     python -m nnstreamer_tpu lint --strict nnstreamer_tpu/  # source lint
     python -m nnstreamer_tpu serve svc.json         # service control plane
     python -m nnstreamer_tpu service list           # talk to a serve process
+    python -m nnstreamer_tpu obs metrics            # Prometheus scrape/dump
+    python -m nnstreamer_tpu obs flight             # crash flight recorder
 """
 from __future__ import annotations
 
@@ -238,6 +240,60 @@ def _cmd_serve(args) -> int:
     return 0
 
 
+def _cmd_obs(args) -> int:
+    """Observability verbs (docs/observability.md):
+
+    * ``obs metrics`` — Prometheus text: scraped from a running serve
+      endpoint (``--endpoint``) or rendered from THIS process's registry
+      (useful under ``python -c``/tests; a fresh CLI process has no
+      pipelines, so local mode mostly shows the obs plane itself);
+    * ``obs flight`` — the crash flight recorder's recent events;
+    * ``obs trace`` — export recorded spans as Perfetto/chrome-trace
+      JSON (``--out``, default nns_spans.json).
+    """
+    from .service import ControlClient, ServiceError
+
+    try:
+        if args.verb == "metrics":
+            if args.endpoint:
+                print(ControlClient(args.endpoint).metrics_text(), end="")
+            else:
+                from .obs import metrics as obs_metrics
+
+                print(obs_metrics.render(), end="")
+        elif args.verb == "flight":
+            if args.endpoint:
+                events = ControlClient(args.endpoint).flight(
+                    last=args.last)["events"]
+            else:
+                from .obs import flight as obs_flight
+
+                events = obs_flight.dump(last=args.last)
+            print(json.dumps(events, indent=2, default=str))
+        elif args.verb == "trace":
+            if args.endpoint:
+                # no remote span-export route exists; silently exporting
+                # THIS fresh process's empty ring would read as "the
+                # server recorded nothing"
+                print("error: 'obs trace' exports this process's spans "
+                      "only — --endpoint is not supported (use "
+                      "obs.export_chrome_trace() in the serve process)",
+                      file=sys.stderr)
+                return 2
+            from .obs import context as obs_context
+
+            path = args.out or "nns_spans.json"
+            doc = obs_context.export_chrome_trace(path)
+            print(f"wrote {len(doc['traceEvents'])} span(s) to {path}")
+        else:
+            print(f"unknown verb '{args.verb}'", file=sys.stderr)
+            return 2
+    except ServiceError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _cmd_service(args) -> int:
     """CLI verbs against a running serve endpoint (start/stop/list/status/
     swap/drain and canary control)."""
@@ -340,6 +396,18 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=30.0,
                    help="drain timeout seconds")
     p.set_defaults(fn=_cmd_service)
+
+    p = sub.add_parser("obs", help="observability: /metrics scrape, "
+                                   "flight-recorder dump, span export "
+                                   "(see docs/observability.md)")
+    p.add_argument("verb", choices=["metrics", "flight", "trace"])
+    p.add_argument("--endpoint", default=None,
+                   help="serve control endpoint URL (omit = this process)")
+    p.add_argument("--last", type=int, default=64,
+                   help="flight: newest N events")
+    p.add_argument("--out", default=None,
+                   help="trace: output JSON path (default nns_spans.json)")
+    p.set_defaults(fn=_cmd_obs)
 
     p = sub.add_parser("lint", help="static pipeline-graph / source lint "
                                     "(see docs/lint.md)")

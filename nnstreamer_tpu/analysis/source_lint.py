@@ -11,6 +11,11 @@ tree:
   the element hot set (``chain``/``transform``/``render``/``create``);
 * files under ``serving/`` get the scheduler hot set (``_loop``/
   ``_execute``/``step``/``take_ready``/...);
+* files under ``obs/`` get the observability hot set — trace-context
+  propagation (``to_meta``/``from_meta``/``start_span``/``record_span``/
+  ``end``) and the flight-recorder ``record`` run inside pad pushes,
+  batch loops, and fused dispatches, so the same no-sync / no-silent-
+  swallow discipline applies;
 * helpers *called from* a hot function in the same module are hot too
   (one level — e.g. ``_block_ready`` called from ``Scheduler._execute``).
 
@@ -37,6 +42,14 @@ ELEMENT_HOT = {"chain", "transform", "render", "create", "_task",
                "_chain_guarded", "push", "dispatch"}
 SERVING_HOT = {"_loop", "_execute", "_admit_one", "step", "take_ready",
                "add", "_form", "next_flush_in"}
+# obs hot paths (obs/context.py, obs/flight.py): called from element
+# chains, the serving batch loop, and fused dispatches when tracing is
+# on — and `record` unconditionally
+OBS_HOT = {"record", "to_meta", "from_meta", "start_span", "record_span",
+           "end", "_record_finished", "_coerce_parent"}
+
+_HOT_BY_SCOPE = {"element": ELEMENT_HOT, "serving": SERVING_HOT,
+                 "obs": OBS_HOT}
 
 # NNL101 — calls that synchronize device → host
 _SYNC_METHODS = {"block_until_ready"}
@@ -116,6 +129,8 @@ def _file_scope(path: Path) -> Optional[str]:
         return "serving"
     if "elements" in parts:
         return "element"
+    if "obs" in parts:
+        return "obs"
     if "runtime" in parts and path.name in ("pad.py", "element.py",
                                             "queue.py", "fusion.py"):
         return "element"
@@ -162,7 +177,7 @@ class _FunctionIndex:
         level of same-module call expansion."""
         if scope is None:
             return []
-        names = ELEMENT_HOT if scope == "element" else SERVING_HOT
+        names = _HOT_BY_SCOPE[scope]
         roots: List[Tuple[ast.FunctionDef, Optional[str]]] = []
         for (cls, fname), fn in self.methods.items():
             if fname in names:

@@ -24,6 +24,7 @@ from typing import Optional
 
 from ..core import Buffer, Caps, tensors_info_from_caps
 from ..core.caps import caps_from_tensors_info
+from ..obs import context as obs_context
 from ..registry.elements import register_element
 from ..runtime.element import ElementError, Prop, TransformElement, prop_bool
 from ..runtime.pad import Pad, PadDirection, PadTemplate
@@ -241,10 +242,17 @@ class TensorServing(TransformElement):
 
         sched = self._ensure_scheduler()
         deadline_ms = self.props["deadline_ms"]
+        trace_ctx = None
+        if obs_context.TRACING:
+            # a trace context that arrived on the buffer (query wire,
+            # fabric attempt) follows the request into the batch
+            trace_ctx = obs_context.TraceContext.from_meta(
+                buf.meta.get("trace"))
         try:
             req = sched.submit(
                 tuple(buf.tensors), priority=self.props["priority"],
-                deadline_s=deadline_ms * 1e-3 if deadline_ms > 0 else None)
+                deadline_s=deadline_ms * 1e-3 if deadline_ms > 0 else None,
+                trace=trace_ctx)
         except AdmissionError as e:
             if self.props["on_shed"] == "error":
                 raise ElementError(f"{self.describe()}: {e}") from e

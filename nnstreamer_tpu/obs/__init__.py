@@ -1,0 +1,76 @@
+"""nnstreamer_tpu.obs — the unified observability plane (L7).
+
+Reference analog: the GstShark/NNShark tracer ecosystem the reference
+delegates profiling to (arxiv 1901.04985, SURVEY §5.1) — but where
+GstShark aggregates per-element, this package is REQUEST-scoped and
+cross-subsystem. Three pieces, one contract (near-zero cost when idle):
+
+* :mod:`.context` — request-scoped distributed tracing. A
+  :class:`~.context.TraceContext` minted where a request enters
+  (``QueryClient.request()``, serving admission) propagates through
+  fabric retries/hedges (child span per attempt), across the query wire
+  (``meta["trace"]``), into the serving batcher (batch spans *link* to
+  the N coalesced request spans) and fused device segments
+  (``fused:<head>..<tail>`` spans). Export: Perfetto/chrome-trace JSON,
+  next to ``utils.trace.jax_trace`` XPlanes. Gated on one module global
+  (:data:`~.context.TRACING`).
+
+* :mod:`.metrics` — a Prometheus-style registry serving, service,
+  fabric, queue, and fusion sources publish into; rendered at the
+  control plane's ``GET /metrics`` route and by
+  ``python -m nnstreamer_tpu obs metrics``.
+
+* :mod:`.flight` — the always-on crash flight recorder: a lock-free
+  bounded ring of recent control-plane events (state transitions,
+  evictions, crashes, spans) dumped into ``CrashReport`` postmortems and
+  on DEGRADED transitions, so "why did it stall" is answerable after
+  the fact.
+
+See docs/observability.md for the span model, propagation rules, and
+the metric name catalog.
+"""
+from . import context, flight, metrics  # noqa: F401
+from .context import (  # noqa: F401
+    Span,
+    TraceContext,
+    disable_tracing,
+    enable_tracing,
+    export_chrome_trace,
+    finished_spans,
+    record_span,
+    spans_for_trace,
+    start_span,
+)
+from .flight import FlightRecorder  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricError,
+    Registry,
+    default_registry,
+    render,
+)
+
+__all__ = [
+    "Counter",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricError",
+    "Registry",
+    "Span",
+    "TraceContext",
+    "context",
+    "default_registry",
+    "disable_tracing",
+    "enable_tracing",
+    "export_chrome_trace",
+    "finished_spans",
+    "flight",
+    "metrics",
+    "record_span",
+    "render",
+    "spans_for_trace",
+    "start_span",
+]

@@ -12,6 +12,7 @@ from typing import Optional
 
 from ..core import Buffer, Caps, parse_caps_string
 from ..core.serialize import pack_tensors, unpack_tensors
+from ..obs import context as obs_context
 from ..utils.log import logger
 from .protocol import MsgType, check_connect_fault, recv_msg, send_msg
 
@@ -119,20 +120,40 @@ class QueryClient:
         must then discard this client: a late answer would mis-match the
         next request), ``ConnectionError`` on link death/EOS, and
         :class:`RemoteError` when the server answered with a typed
-        error."""
-        self.send(buf)
+        error.
+
+        With request tracing on (obs/context.py) and no context already
+        stamped by an upstream router, this is where the trace is MINTED:
+        a root span whose context rides ``meta["trace"]`` to the server
+        (the fabric stamps per-attempt contexts before calling here, so
+        its requests keep their existing trace)."""
+        span = None
+        if obs_context.TRACING and "trace" not in buf.meta:
+            span = obs_context.start_span(
+                f"query.request:{self.host}:{self.port}", kind="query")
+            buf.meta["trace"] = span.context().to_meta()
+        status = "ok"
         try:
-            item = self.responses.get(timeout=timeout)
-        except _queue.Empty:
-            raise TimeoutError(
-                f"no answer from {self.host}:{self.port} in {timeout:.2f}s")
-        if item is None:
-            raise ConnectionError("server ended the stream (EOS)")
-        if item is DISCONNECTED:
-            raise ConnectionError("connection lost awaiting the answer")
-        if isinstance(item, RemoteError):
-            raise item
-        return item
+            self.send(buf)
+            try:
+                item = self.responses.get(timeout=timeout)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"no answer from {self.host}:{self.port} in "
+                    f"{timeout:.2f}s")
+            if item is None:
+                raise ConnectionError("server ended the stream (EOS)")
+            if item is DISCONNECTED:
+                raise ConnectionError("connection lost awaiting the answer")
+            if isinstance(item, RemoteError):
+                raise item
+            return item
+        except BaseException as e:
+            status = f"error:{type(e).__name__}"
+            raise
+        finally:
+            if span is not None:
+                span.end(status)
 
     def send_eos(self) -> None:
         if self._sock is not None:

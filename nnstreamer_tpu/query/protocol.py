@@ -11,6 +11,15 @@ Reference analog: the nnstreamer-edge transport consumed by
 
 Client-id routing meta (reference ``GstMetaQuery``, gst/nnstreamer/
 tensor_meta.c) rides in the DATA frame's meta dict as ``client_id``.
+
+Request-scoped trace propagation (obs/context.py) rides the same meta
+dict under ``trace`` — ``{"trace_id", "span_id"}`` stamped by the sender
+(``QueryClient.request`` or a fabric attempt) and consumed server-side
+(``QueryServer.attach_scheduler``, fused-segment dispatch), so one
+request is one trace across every process boundary. Fabric routing meta
+(``fabric``: remaining deadline budget, idempotency key, attempt index)
+is the third first-class meta field; all three are plain JSON and
+survive ``pack_tensors``/``unpack_tensors`` unchanged.
 """
 from __future__ import annotations
 

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..core import Buffer, Caps, parse_caps_string
 from ..core.serialize import pack_tensors, unpack_tensors
+from ..obs import context as obs_context
 from ..utils.log import logger
 from ..utils.threads import ThreadRegistry
 from .protocol import MsgType, recv_msg, send_msg
@@ -173,10 +174,17 @@ class QueryServer:
                     if budget is not None:
                         eff_deadline = (budget if deadline_s is None
                                         else min(deadline_s, budget))
+                # trace propagation: the client's (or the fabric
+                # attempt's) span context arrived in the frame meta —
+                # hand it to the scheduler so the batch span links to it
+                trace_ctx = None
+                if obs_context.TRACING:
+                    trace_ctx = obs_context.TraceContext.from_meta(
+                        item.meta.get("trace"))
                 try:
                     scheduler.submit(
                         tuple(item.tensors), priority=priority,
-                        deadline_s=eff_deadline,
+                        deadline_s=eff_deadline, trace=trace_ctx,
                         on_done=lambda req, cid=client_id: _answer(cid, req))
                 except AdmissionError:
                     pass  # on_done already delivered the typed ERROR

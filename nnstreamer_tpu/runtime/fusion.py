@@ -46,6 +46,8 @@ from typing import TYPE_CHECKING, Callable, Dict, List, Optional
 
 from ..analysis.sanitizer import named_lock
 from ..core import Buffer, clock_now
+from ..obs import context as obs_context
+from ..obs import metrics as obs_metrics
 from ..utils import trace
 from ..utils.log import logger
 from .element import Element
@@ -327,6 +329,16 @@ class FusedSegment:
         if trace.ACTIVE:
             trace.notify_fused(self.name, t0, dt,
                                {"elements": len(self.elements)})
+        if obs_context.TRACING:
+            parent = buf.meta.get("trace")
+            if parent is not None:
+                # the request's span context rode in on the buffer meta:
+                # the single-dispatch chain becomes a child span in the
+                # SAME trace as the client/fabric/batch spans
+                obs_context.record_span(
+                    f"fused:{self.name}", kind="fused", parent=parent,
+                    start_s=t0, dur_s=dt,
+                    attrs={"elements": len(self.elements)})
         out = Buffer(list(outs)).copy_metadata_from(buf)
         self.tail.push(out)
         return True
@@ -349,6 +361,9 @@ def install(pipeline: "Pipeline") -> SegmentPlan:
         segments.append(seg)
     pipeline._fused_segments = segments
     if segments:
+        # fused pipelines join the metrics plane: each segment's
+        # dispatch/retrace/defuse counters render at GET /metrics
+        obs_metrics.track_pipeline(pipeline)
         logger.info("pipeline %s: fused %d device segment(s): %s",
                     pipeline.name, len(segments), plan.describe())
     return plan

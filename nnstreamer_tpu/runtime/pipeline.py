@@ -14,6 +14,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Set
 
 from ..analysis.sanitizer import named_lock, named_rlock
 from ..core import Message, MessageType
+from ..obs import flight as obs_flight
 from ..utils.log import logger
 from ..utils.threads import ThreadRegistry
 from .element import Element, SinkElement, SourceElement
@@ -120,6 +121,14 @@ class Pipeline:
             self._state_listeners.remove(cb)
 
     def _notify_state(self, kind: str, source: str, data: dict) -> None:
+        # every lifecycle transition lands in the always-on flight
+        # recorder — the postmortem tail a CrashReport embeds
+        obs_flight.record(
+            "pipeline", kind,
+            {"source": source,
+             **({"error": str(data.get("error"))[:200]}
+                if kind == "error" else {})},
+            pipeline=self.name)
         for cb in list(self._state_listeners):
             try:
                 cb(kind, source, data)
@@ -214,6 +223,13 @@ class Pipeline:
                     el.stop()
         # joined outside _state_lock — the halt threads acquire it
         self._halt_threads.drain(timeout_per=2.0)
+        from ..utils import trace
+
+        if trace.ACTIVE:
+            # env-activated chrome traces flush at every stop(), not only
+            # at interpreter exit — a long-lived serve process produces
+            # inspectable traces per run
+            trace.flush_chrome_traces()
         self.bus.post(Message(MessageType.STATE_CHANGED, self.name, {"state": "stopped"}))
         self._notify_state("stopped", self.name, {})
         return self

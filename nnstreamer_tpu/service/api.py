@@ -8,9 +8,14 @@ plus a matching ``urllib`` client the CLI uses, so ``python -m
 nnstreamer_tpu service <verb>`` works against any running ``serve``
 process.
 
-Routes (all JSON):
+Routes (JSON unless noted):
 
     GET    /healthz                       liveness of the control plane
+    GET    /metrics                       Prometheus text exposition of the
+                                          unified obs registry (serving,
+                                          service, fabric, fused segments;
+                                          docs/observability.md)
+    GET    /flight                        flight-recorder tail (?last=N)
     GET    /services                      list (name/state/ready/restarts)
     GET    /services/<name>               full health snapshot
     POST   /services                      register {name, launch, ...}
@@ -35,6 +40,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..obs import flight as obs_flight
+from ..obs import metrics as obs_metrics
 from ..utils.log import logger
 from .manager import AdmissionRejected, ServiceError, ServiceManager
 from .models import SwapError
@@ -100,6 +107,28 @@ def _make_handler(manager: ServiceManager):
                 return {}
             return json.loads(self.rfile.read(n).decode() or "{}")
 
+        def _reply_metrics(self) -> None:
+            """GET /metrics: Prometheus text, not JSON — scrapers
+            (tools/bench_fabric.py, a real Prometheus) read it as-is."""
+            try:
+                body = obs_metrics.render().encode()
+            except Exception as e:  # noqa: BLE001 - endpoint must answer
+                logger.exception("control-http: /metrics render failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _query_params(self) -> dict:
+            from urllib.parse import parse_qsl
+
+            _, _, q = self.path.partition("?")
+            return dict(parse_qsl(q))
+
         def _dispatch(self, method: str) -> None:
             try:
                 parts = [p for p in self.path.split("?")[0].split("/") if p]
@@ -136,6 +165,14 @@ def _make_handler(manager: ServiceManager):
             m = manager
             if parts == ["healthz"] and method == "GET":
                 return {"ok": True, "services": len(m.services())}
+            if parts == ["flight"] and method == "GET":
+                params = self._query_params()
+                try:
+                    last = int(params.get("last", 256))
+                except ValueError:
+                    raise ValueError(f"last={params['last']!r} not an int")
+                return {"events": obs_flight.dump(
+                    last=last, pipeline=params.get("pipeline"))}
             if parts == ["services"]:
                 if method == "GET":
                     return {"services": m.list()}
@@ -194,6 +231,9 @@ def _make_handler(manager: ServiceManager):
             return {"name": svc.name, "state": svc.state.value}
 
         def do_GET(self):     # noqa: N802 - BaseHTTPRequestHandler API
+            if self.path.split("?")[0] == "/metrics":
+                self._reply_metrics()
+                return
             self._dispatch("GET")
 
         def do_POST(self):    # noqa: N802
@@ -241,6 +281,20 @@ class ControlClient:
     # verbs
     def healthz(self) -> dict:
         return self._call("GET", "/healthz")
+
+    def metrics_text(self) -> str:
+        """GET /metrics — raw Prometheus text (not JSON)."""
+        req = urllib.request.Request(self.endpoint + "/metrics")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().decode()
+        except (urllib.error.URLError, OSError) as e:
+            raise ServiceError(
+                f"control endpoint unreachable (GET /metrics): "
+                f"{getattr(e, 'reason', e)}") from e
+
+    def flight(self, last: int = 256) -> dict:
+        return self._call("GET", f"/flight?last={int(last)}")
 
     def list(self) -> dict:
         return self._call("GET", "/services")

@@ -81,6 +81,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis.sanitizer import named_condition, named_lock
 from ..core import Buffer, parse_caps_string
+from ..obs import context as obs_context
+from ..obs import flight as obs_flight
+from ..obs import metrics as obs_metrics
 from ..utils.log import logger
 from ..utils.threads import ThreadRegistry
 
@@ -274,6 +277,13 @@ class ReplicaPool:
         self._threads = ThreadRegistry()
         self._health_stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
+        # join the unified metrics plane: the pool shows up in
+        # serving.metrics_snapshot()["fabric"] and at GET /metrics
+        obs_metrics.track_pool(self)
+        self._latency_hist = obs_metrics.histogram(
+            "nns_fabric_request_latency_seconds",
+            "end-to-end fabric request latency (retries/hedges included)",
+            ("pool",))
 
     # -- membership ----------------------------------------------------------
     def add_endpoint(self, host: str, port: int,
@@ -434,6 +444,9 @@ class ReplicaPool:
         logger.warning("pool %s: replica %s EVICTED (%s); quarantined, "
                        "first probe in %.2fs", self.name, replica.id,
                        reason, self.quarantine_base_s)
+        obs_flight.record("fabric", "evict",
+                          {"pool": self.name, "replica": replica.id,
+                           "reason": reason[:200]})
         # in-flight connections die NOW so their waiters fail fast and
         # retry elsewhere instead of riding out the full timeout
         if replica.link is not None:
@@ -452,6 +465,8 @@ class ReplicaPool:
             self.stats["readmissions"] += 1
             self._cond.notify_all()
         logger.info("pool %s: replica %s READMITTED", self.name, replica.id)
+        obs_flight.record("fabric", "readmit",
+                          {"pool": self.name, "replica": replica.id})
 
     def _record_success(self, replica: Replica) -> None:
         with self._lock:
@@ -569,6 +584,18 @@ class ReplicaPool:
         h = self._key_hash(key)
         with self._lock:
             self.stats["requests"] += 1
+        span = None
+        if obs_context.TRACING:
+            # root span for THIS request — or a child, when the caller
+            # already carries a context in meta["trace"]; every attempt
+            # below becomes a child span whose context rides the wire
+            span = obs_context.start_span(
+                f"fabric.request:{self.name}", kind="fabric",
+                parent=obs_context.TraceContext.from_meta(
+                    (meta or {}).get("trace")),
+                attrs={"pool": self.name,
+                       "key": None if key is None else str(key)})
+        t_req = time.monotonic()
         retriable = self.assume_idempotent or key is not None
         max_attempts = self.max_attempts if retriable else 1
         tried: set = set()
@@ -596,31 +623,62 @@ class ReplicaPool:
             if attempts > 0:
                 with self._lock:
                     self.stats["retries"] += 1
-            buf = self._make_buffer(tensors, key, deadline, attempts, meta)
+            attempt_span = None
+            if span is not None:
+                attempt_span = obs_context.start_span(
+                    f"attempt:{replica.id}", kind="fabric", parent=span,
+                    attrs={"replica": replica.id, "attempt": attempts})
+            buf = self._make_buffer(
+                tensors, key, deadline, attempts, meta,
+                trace=None if attempt_span is None
+                else attempt_span.context())
             if retriable:
                 resp, err = self._attempt_maybe_hedged(
-                    replica, h, tried, buf, tensors, key, deadline, meta)
+                    replica, h, tried, buf, tensors, key, deadline, meta,
+                    span=span, attempt_span=attempt_span)
             else:
                 # hedging IS duplicate execution — a non-idempotent
                 # request must never fan out, same gate as retries
                 resp, err = self._attempt_and_score(replica, buf, deadline)
+            if attempt_span is not None:
+                # idempotent: a hedge win already ended the primary's
+                # span as superseded — this end() is then a no-op, so
+                # the success is never misattributed to a replica that
+                # did not answer
+                attempt_span.end(
+                    "ok" if resp is not None else
+                    f"error:{type(err).__name__}" if err is not None
+                    else "error")
             if resp is not None:
+                self._latency_hist.observe(time.monotonic() - t_req,
+                                           pool=self.name)
+                if span is not None:
+                    span.end("ok")
                 return resp
             last_err = err
             tried.add(replica.id)
             attempts += 1
         with self._lock:
             self.stats["request_errors"] += 1
+        self._latency_hist.observe(time.monotonic() - t_req, pool=self.name)
+        obs_flight.record(
+            "fabric", "request_error",
+            {"pool": self.name, "attempts": attempts,
+             "error": None if last_err is None else str(last_err)[:200]})
         if last_err is None:
+            if span is not None:
+                span.end("error:NoReplicaAvailable")
             raise NoReplicaAvailable(
                 f"pool '{self.name}': no replica could take the request "
                 f"within {timeout:.2f}s (replicas: {self.replicas()})")
+        if span is not None:
+            span.end(f"error:{type(last_err).__name__}")
         raise RequestFailed(
             f"pool '{self.name}': request failed after {attempts} "
             f"attempt(s): {last_err}") from last_err
 
     def _make_buffer(self, tensors, key, deadline: float, attempt: int,
-                     meta: Optional[dict]) -> Buffer:
+                     meta: Optional[dict], trace=None) -> Buffer:
         import numpy as np
 
         buf = Buffer([np.asarray(t) for t in tensors])
@@ -634,6 +692,11 @@ class ReplicaPool:
             "key": None if key is None else str(key),
             "attempt": attempt,
         }
+        # trace propagation: the attempt span's context crosses the wire
+        # in the DATA frame's JSON meta, so the replica's serving batch
+        # and fused-segment spans land in THIS request's trace
+        if trace is not None:
+            buf.meta["trace"] = trace.to_meta()
         return buf
 
     def _attempt_and_score(self, replica: Replica, buf: Buffer,
@@ -672,9 +735,15 @@ class ReplicaPool:
 
     def _attempt_maybe_hedged(self, replica: Replica, h: int, tried: set,
                               buf: Buffer, tensors, key, deadline: float,
-                              meta: Optional[dict]):
+                              meta: Optional[dict], span=None,
+                              attempt_span=None):
         """Run one attempt; when hedging is on and the primary is slow,
-        fire a duplicate on another replica and take the first answer."""
+        fire a duplicate on another replica and take the first answer.
+        ``span`` — the request's root span: the hedge duplicate gets its
+        own child span (it is a distinct wire attempt). ``attempt_span``
+        — the PRIMARY's span: on a hedge win it is closed as superseded
+        here, so the hedge replica's answer is never attributed to the
+        slow primary."""
         hedge_after = self.hedge_after_s
         remaining = deadline - time.monotonic()
         if hedge_after is None or remaining <= hedge_after:
@@ -702,12 +771,30 @@ class ReplicaPool:
                     "attempt did not complete within the deadline")
         with self._lock:
             self.stats["hedges"] += 1
-        hedge_buf = self._make_buffer(tensors, key, deadline, -1, meta)
+        obs_flight.record("fabric", "hedge",
+                          {"pool": self.name, "primary": replica.id,
+                           "hedge": hedge_replica.id})
+        hedge_span = None
+        if span is not None:
+            hedge_span = obs_context.start_span(
+                f"attempt:{hedge_replica.id}", kind="fabric", parent=span,
+                attrs={"replica": hedge_replica.id, "hedge": True})
+        hedge_buf = self._make_buffer(
+            tensors, key, deadline, -1, meta,
+            trace=None if hedge_span is None else hedge_span.context())
         resp2, err2 = self._attempt_and_score(hedge_replica, hedge_buf,
                                               deadline)
+        if hedge_span is not None:
+            hedge_span.end("ok" if resp2 is not None else
+                           f"error:{type(err2).__name__}" if err2 is not None
+                           else "error")
         if resp2 is not None:
             with self._lock:
                 self.stats["hedge_wins"] += 1
+            if attempt_span is not None:
+                # truthful trace: the primary never answered — the hedge
+                # did (its own span carries the "ok")
+                attempt_span.end("superseded:hedge-won")
             # the primary finishes on its own deadline; its late answer
             # (or failure) is scored and discarded by the worker thread
             return resp2, None

@@ -32,6 +32,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..analysis.sanitizer import named_lock, named_rlock
+from ..obs import flight as obs_flight
+from ..obs import metrics as obs_metrics
 from ..utils.log import logger
 from .health import HealthMonitor, service_snapshot
 from .models import ModelSlots
@@ -133,6 +135,9 @@ class Service:
             self.state_reason = reason
             self._history.append((time.time(), new.value, reason))
             del self._history[:-32]
+            obs_flight.record("service", new.value,
+                              {"service": self.name,
+                               "reason": reason[:200]})
             if new is ServiceState.READY:
                 self._ready_evt.set()
             else:
@@ -219,6 +224,13 @@ class Service:
             if self.state is not ServiceState.READY:
                 return
             self._set_state(ServiceState.DEGRADED, reason)
+        # answer "why did it stall" from history that was already being
+        # recorded: dump the flight-recorder tail at the transition (the
+        # supervisor's CrashReport embeds a longer one)
+        tail = obs_flight.dump(last=12)
+        logger.warning(
+            "service %s DEGRADED (%s); flight tail: %s", self.name, reason,
+            "; ".join(f"{e['kind']}:{e['name']}" for e in tail) or "(empty)")
         self.supervisor.notify_crash("stall", reason)
 
     def stop(self) -> "Service":
@@ -418,6 +430,9 @@ class ServiceManager:
         self._services: Dict[str, Service] = {}  # guarded-by: _lock
         self._jitter_seed = jitter_seed
         self.models = ModelSlots(self)
+        # managed services join the metrics plane (nns_service_* at the
+        # control plane's GET /metrics route)
+        obs_metrics.track_manager(self)
 
     # -- registration --------------------------------------------------------
     def register(self, name: str, launch: Optional[str] = None, *,

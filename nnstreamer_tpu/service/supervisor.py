@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..analysis.sanitizer import named_lock
+from ..obs import flight as obs_flight
 from ..utils.log import logger
 
 
@@ -78,6 +79,10 @@ class CrashReport:
     restart_index: int              # how many restarts preceded this crash
     buffer_specs: dict = field(default_factory=dict)   # last caps per pad
     element_stats: dict = field(default_factory=dict)  # counters at death
+    # flight-recorder tail at capture time (obs/flight.py): the last
+    # control-plane events — state flips, evictions, batch failures,
+    # spans — leading UP to the crash, recorded before anyone knew to look
+    flight: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -88,6 +93,7 @@ class CrashReport:
             "restart_index": self.restart_index,
             "buffer_specs": self.buffer_specs,
             "element_stats": self.element_stats,
+            "flight": self.flight,
         }
 
 
@@ -223,6 +229,9 @@ class Supervisor:
 
     # -- internals -----------------------------------------------------------
     def _capture(self, reason: str, error: str, source: str) -> CrashReport:
+        obs_flight.record("service", "crash",
+                          {"service": self.service.name, "reason": reason,
+                           "error": error[:200]})
         pipe = self.service.pipeline
         return CrashReport(
             time=time.time(), reason=reason, error=error,
@@ -230,6 +239,7 @@ class Supervisor:
             restart_index=self.restarts,
             buffer_specs=capture_buffer_specs(pipe) if pipe else {},
             element_stats=pipe.element_stats() if pipe else {},
+            flight=obs_flight.dump(last=64),
         )
 
     def _give_up_locked(self, why: str) -> None:

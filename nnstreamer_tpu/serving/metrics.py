@@ -36,12 +36,28 @@ def register_scheduler(name: str, scheduler) -> str:
         return unique
 
 
+def iter_schedulers():
+    """(name, scheduler) over every live scheduler (the obs metrics
+    collector reads this so the Prometheus plane and the snapshot share
+    one source)."""
+    with _registry_lock:
+        return list(_registry.items())
+
+
 def metrics_snapshot() -> dict:
     """{scheduler_name: scheduler.metrics_snapshot()} across every live
-    scheduler (schedulers drop out when garbage-collected)."""
-    with _registry_lock:
-        items = list(_registry.items())
-    return {name: s.metrics_snapshot() for name, s in items}
+    scheduler (schedulers drop out when garbage-collected), plus — under
+    the ``"fabric"`` key — every live :class:`~...service.fabric.
+    ReplicaPool` snapshot (per-replica in-flight, EWMA health score,
+    evict/readmit/hedge counters): the fabric autoscaler reads ONE
+    snapshot instead of polling three subsystems."""
+    out = {name: s.metrics_snapshot() for name, s in iter_schedulers()}
+    from ..obs import metrics as obs_metrics
+
+    fabric = obs_metrics.pools_snapshot()
+    if fabric:
+        out["fabric"] = fabric
+    return out
 
 
 class ServingMetrics:

@@ -62,12 +62,14 @@ class Request:
     __slots__ = (
         "id", "tensors", "priority", "deadline", "steps", "eos_id",
         "metrics", "on_done", "_event", "_result", "_error", "tokens",
+        "trace", "_span",
     )
 
     def __init__(self, tensors: Sequence, priority: int = 0,
                  deadline: Optional[float] = None, steps: int = 0,
                  eos_id: Optional[int] = None,
-                 on_done: Optional[Callable[["Request"], None]] = None):
+                 on_done: Optional[Callable[["Request"], None]] = None,
+                 trace=None):
         self.id = next(_req_counter)
         self.tensors = tuple(tensors)
         self.priority = priority
@@ -80,6 +82,13 @@ class Request:
         self._result: Optional[Tuple] = None
         self._error: Optional[BaseException] = None
         self.tokens: list = []  # decode mode: tokens emitted so far
+        # request-scoped tracing (obs/context.py): the TraceContext this
+        # request belongs to — propagated from the caller (query wire,
+        # tensor_serving element) or minted at admission; batch spans
+        # LINK to it (a coalesced batch serves N requests, so strict
+        # parentage would be a lie)
+        self.trace = trace
+        self._span = None  # live admission span, ended by _finish
 
     # -- rows ---------------------------------------------------------------
     @property
@@ -108,6 +117,11 @@ class Request:
         self.metrics.setdefault(
             "total_latency_s",
             time.monotonic() - self.metrics["enqueue_time"])
+        if self._span is not None:
+            self._span.end(
+                "ok" if self._error is None
+                else f"error:{type(self._error).__name__}")
+            self._span = None
         self._event.set()
         if self.on_done is not None:
             try:

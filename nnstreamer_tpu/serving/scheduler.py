@@ -27,6 +27,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import context as obs_context
+from ..obs import flight as obs_flight
 from ..utils.log import logger
 from .batcher import Batch, BatchFormer
 from .metrics import ServingMetrics, register_scheduler
@@ -156,19 +158,29 @@ class Scheduler:
     # -- submission ---------------------------------------------------------
     def submit(self, tensors: Sequence, priority: int = 0,
                deadline_s: Optional[float] = None,
-               on_done: Optional[Callable[[Request], None]] = None
-               ) -> Request:
+               on_done: Optional[Callable[[Request], None]] = None,
+               trace=None) -> Request:
         """Admit a request (tensors batch over axis 0; a lower priority
         number schedules sooner; ``deadline_s`` is a relative latency
         budget). Raises a typed :class:`AdmissionError` when shed —
         admission control happens HERE, synchronously, so a saturated
-        server pushes back instead of buffering unboundedly."""
+        server pushes back instead of buffering unboundedly.
+
+        ``trace`` — the caller's :class:`~...obs.context.TraceContext`
+        (query wire / tensor_serving propagation); with tracing on and
+        no context supplied, admission mints a fresh root span so direct
+        submitters still get request-scoped traces."""
         if self._closed:
             raise SchedulerClosedError(f"scheduler {self.name} is closed")
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
         req = Request(tensors, priority=priority, deadline=deadline,
-                      on_done=on_done)
+                      on_done=on_done, trace=trace)
+        if obs_context.TRACING and trace is None:
+            req._span = obs_context.start_span(
+                f"serving.request:{self.name}", kind="serving",
+                attrs={"request_id": req.id})
+            req.trace = req._span.context()
         self.metrics.record_submit()
         try:
             self.queue.put(req)
@@ -248,6 +260,9 @@ class Scheduler:
                 f"batch {batch.id} execution failed: {e}")
             logger.exception("serving %s: batch %d failed", self.name,
                              batch.id)
+            obs_flight.record("serving", "batch_failed",
+                              {"scheduler": self.name, "batch": batch.id,
+                               "error": str(e)[:200]})
             for r in batch.requests:
                 r.fail(err)
                 self.metrics.record_request_done(r, failed=True)
@@ -262,6 +277,17 @@ class Scheduler:
                 "batch", self.name, t_start, device_s,
                 {"batch_id": batch.id, "rows": batch.rows,
                  "bucket": batch.padded_rows})
+        if obs_context.TRACING:
+            # one batch span LINKED to every member request's span — the
+            # batch has N parents, which links express and strict
+            # parentage cannot (docs/observability.md)
+            links = [r.trace for r in batch.requests if r.trace is not None]
+            obs_context.record_span(
+                f"batch:{self.name}", kind="serving",
+                trace_id=links[0].trace_id if links else None,
+                links=links, start_s=t_start, dur_s=device_s,
+                attrs={"batch_id": batch.id, "rows": batch.rows,
+                       "bucket": batch.padded_rows})
         now = time.monotonic()
         for r, outs in zip(batch.requests, batch.split_outputs(outputs)):
             r.metrics["device_time_s"] = device_s
@@ -339,8 +365,8 @@ class DecodeScheduler:
     def submit(self, tokens, steps: int, priority: int = 0,
                deadline_s: Optional[float] = None,
                eos_id: Optional[int] = None,
-               on_done: Optional[Callable[[Request], None]] = None
-               ) -> Request:
+               on_done: Optional[Callable[[Request], None]] = None,
+               trace=None) -> Request:
         """Queue a prompt (1-D int32) for up to ``steps`` generated
         tokens (fewer when ``eos_id`` appears). The result tuple holds
         one (n,) int32 array of generated tokens."""
@@ -358,7 +384,13 @@ class DecodeScheduler:
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
         req = Request((tokens,), priority=priority, deadline=deadline,
-                      steps=steps, eos_id=eos_id, on_done=on_done)
+                      steps=steps, eos_id=eos_id, on_done=on_done,
+                      trace=trace)
+        if obs_context.TRACING and trace is None:
+            req._span = obs_context.start_span(
+                f"serving.request:{self.name}", kind="serving",
+                attrs={"request_id": req.id})
+            req.trace = req._span.context()
         self.metrics.record_submit()
         try:
             self.queue.put(req)

@@ -161,8 +161,16 @@ class ChromeTraceTracer(Tracer):
     """Complete-event trace viewable in chrome://tracing / Perfetto: one
     'X' span per element chain per buffer, thread-separated, lining up
     with ``jax_trace`` device XPlanes. Path from NNS_CHROME_TRACE
-    (default nns_trace.json); written by ``save()`` and automatically at
-    interpreter exit when env-activated."""
+    (default nns_trace.json); written by ``save()``, and — when
+    env-activated — automatically at every ``Pipeline.stop()``
+    (:func:`flush_chrome_traces`) and at interpreter exit.
+
+    Concurrency: a lock guards the event list's mutations, and
+    ``save()``/``flush()`` SNAPSHOT the list under it before serializing
+    — a flush racing in-flight ``buffer_flow`` calls can no longer
+    interleave a half-written event list into the JSON dump, and the
+    multi-second disk write of a large trace never blocks the streaming
+    hot path (the per-event lock hold stays two list ops)."""
 
     NAME = "chrometrace"
     MAX_EVENTS = 1_000_000  # bound memory on endless streams
@@ -172,6 +180,8 @@ class ChromeTraceTracer(Tracer):
         self._events: List[dict] = []
         self._t0 = time.perf_counter()
         self._saved = False
+        self._elock = threading.Lock()  # guards _events + _saved vs writes
+        self._env_activated = path is None
         if path is None:
             # env-activated use (NNS_TRACERS=chrometrace) has no code to
             # call save(); API users pass a path and save() themselves
@@ -181,11 +191,10 @@ class ChromeTraceTracer(Tracer):
 
     def buffer_flow(self, pad, buf, elapsed_s: float) -> None:
         peer = pad.peer
-        if (peer is None or self._saved
-                or len(self._events) >= self.MAX_EVENTS):
+        if peer is None:
             return
         now = time.perf_counter()
-        self._events.append({
+        event = {
             "name": peer.element.name,
             "cat": "element",
             "ph": "X",
@@ -195,13 +204,15 @@ class ChromeTraceTracer(Tracer):
             # tids are arbitrary JSON numbers — never fold them (collisions
             # render as corrupt nesting in Perfetto)
             "tid": threading.get_ident(),
-        })
+        }
+        with self._elock:
+            if self._saved or len(self._events) >= self.MAX_EVENTS:
+                return
+            self._events.append(event)
 
     def serving_event(self, kind: str, name: str, start_s: float,
                       dur_s: float, meta: dict) -> None:
-        if self._saved or len(self._events) >= self.MAX_EVENTS:
-            return
-        self._events.append({
+        event = {
             "name": f"{kind}:{name}",
             # fused-segment spans (runtime/fusion.py) get their own
             # category so Perfetto separates one-dispatch chains from
@@ -215,20 +226,52 @@ class ChromeTraceTracer(Tracer):
             "pid": os.getpid(),
             "tid": threading.get_ident(),
             "args": meta,
-        })
+        }
+        with self._elock:
+            if self._saved or len(self._events) >= self.MAX_EVENTS:
+                return
+            self._events.append(event)
 
-    def save(self) -> Optional[str]:
-        if self._saved or not self._events:
-            return None
-        import atexit
+    def _write(self, events: List[dict]) -> None:
         import json
 
         with open(self.path, "w") as fh:
-            json.dump({"traceEvents": self._events}, fh)
-        # only a successful write finalizes: a failed open/dump keeps the
-        # events so a retry can still flush them
-        self._saved = True
-        self._events = []
+            json.dump({"traceEvents": events}, fh)
+
+    def flush(self) -> Optional[str]:
+        """Write the events collected SO FAR without finalizing — the
+        tracer keeps recording and a later flush/save rewrites the file
+        with the fuller list (``Pipeline.stop()`` calls this for
+        env-activated tracers). Returns the path written, or None when
+        there was nothing to write. The disk write happens OUTSIDE the
+        event lock (a snapshot is serialized), so concurrent pipelines
+        keep streaming while a large trace writes."""
+        with self._elock:
+            if self._saved or not self._events:
+                return None
+            events = list(self._events)
+        self._write(events)
+        return self.path
+
+    def save(self) -> Optional[str]:
+        with self._elock:
+            if self._saved or not self._events:
+                return None
+            # finalize FIRST (appends stop instantly, nothing can land
+            # between snapshot and finalize and be lost), write outside
+            # the lock; a failed write rolls the state back so a retry
+            # can still flush the same events
+            events, self._events = self._events, []
+            self._saved = True
+        try:
+            self._write(events)
+        except BaseException:
+            with self._elock:
+                self._saved = False
+                self._events = events + self._events
+            raise
+        import atexit
+
         try:
             atexit.unregister(self.save)
         except Exception:  # noqa: BLE001 - unregister is best-effort
@@ -236,7 +279,8 @@ class ChromeTraceTracer(Tracer):
         return self.path
 
     def results(self) -> dict:
-        return {"events": len(self._events), "path": self.path}
+        with self._elock:
+            return {"events": len(self._events), "path": self.path}
 
 
 _BUILTIN = {t.NAME: t for t in
@@ -280,6 +324,30 @@ def uninstall_tracers() -> None:
 def trace_results() -> dict:
     with _lock:
         return {t.NAME or type(t).__name__: t.results() for t in _tracers}
+
+
+def flush_chrome_traces(env_only: bool = True) -> List[str]:
+    """Flush installed ChromeTraceTracers to disk without finalizing
+    them. Called from ``Pipeline.stop()`` for env-activated tracers
+    (which otherwise only write at interpreter exit); pass
+    ``env_only=False`` to also flush API-installed instances. Returns
+    the paths written."""
+    with _lock:
+        tracers = [t for t in _tracers
+                   if isinstance(t, ChromeTraceTracer)
+                   and (t._env_activated or not env_only)]
+    paths = []
+    for t in tracers:
+        try:
+            p = t.flush()
+        except OSError as e:
+            from .log import logger
+
+            logger.warning("chrometrace flush to %s failed: %s", t.path, e)
+            continue
+        if p:
+            paths.append(p)
+    return paths
 
 
 _env_checked = False

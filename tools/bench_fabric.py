@@ -11,6 +11,12 @@ with 3 replicas under sustained query traffic,
 * a rolling ``registry://`` hot swap across ALL replicas completes with
   zero errors while traffic flows.
 
+The failover numbers (time-to-evict, time-to-readmit, retry counts) are
+read from the control plane's ``GET /metrics`` Prometheus endpoint —
+the same scrape surface an external monitor would poll — so the bench
+doubles as an integration gate on the unified metrics plane
+(docs/observability.md).
+
     python tools/bench_fabric.py            # full bench, JSON report
     python tools/bench_fabric.py --smoke    # CI gate, short run
     NNS_TSAN=1 python tools/bench_fabric.py --smoke   # + sanitizer gate
@@ -86,17 +92,47 @@ class _TimedTraffic:
             t.join(timeout=10.0)
 
 
-def _wait_counter(pool, key: str, want: int, timeout: float = 15.0):
+def _scrape_metric(endpoint: str, name: str, **labels):
+    """One Prometheus sample from GET /metrics; None when absent."""
+    import urllib.request
+
+    with urllib.request.urlopen(endpoint + "/metrics", timeout=5.0) as resp:
+        text = resp.read().decode()
+    want = {f'{k}="{v}"' for k, v in labels.items()}
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        head, _, value = line.rpartition(" ")
+        if head.startswith(name + "{"):
+            have = set(head[len(name) + 1:].rstrip("}").split(","))
+            if not want <= have:
+                continue
+        elif head != name or want:
+            continue
+        try:
+            return float(value)
+        except ValueError:
+            return None
+    return None
+
+
+def _wait_metric(endpoint: str, name: str, labels: dict, want: float,
+                 timeout: float = 15.0):
+    """Poll the /metrics endpoint until ``name`` reaches ``want``;
+    returns the observation time (the bench's evict/readmit clock reads
+    the same scrape surface a monitoring stack would)."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
-        if pool.snapshot()[key] >= want:
+        v = _scrape_metric(endpoint, name, **labels)
+        if v is not None and v >= want:
             return time.monotonic()
         time.sleep(0.02)
     return None
 
 
 def bench(steady_s: float = 2.0, rate_hz: float = 120.0) -> dict:
-    from nnstreamer_tpu.service import ServiceFabric, ServiceManager
+    from nnstreamer_tpu.service import (ControlServer, ServiceFabric,
+                                        ServiceManager)
 
     import numpy as np
 
@@ -108,6 +144,11 @@ def bench(steady_s: float = 2.0, rate_hz: float = 120.0) -> dict:
         mgr, "bench-fab", "tensor_filter framework=jax model=registry://bench",
         CAPS, replicas=3, quarantine_base_s=0.2, health_poll_s=0.05)
     fab.start()
+    # the failover clock reads the /metrics scrape surface, not
+    # in-process snapshots — same path an external monitor polls
+    ctrl = ControlServer(mgr).start()
+    endpoint = ctrl.endpoint
+    pool_labels = {"pool": "bench-fab"}
     try:
         for i in range(6):  # warm every replica's jit before measuring
             fab.request([np.zeros(4, np.float32)], key=f"w{i}", timeout=30.0)
@@ -117,11 +158,14 @@ def bench(steady_s: float = 2.0, rate_hz: float = 120.0) -> dict:
             time.sleep(steady_s)
             t_kill = time.monotonic()
             fab.kill_replica(1)
-            t_evict = _wait_counter(fab.pool, "evictions", 1)
+            t_evict = _wait_metric(endpoint, "nns_fabric_evictions_total",
+                                   pool_labels, 1)
             time.sleep(steady_s / 2)
             fab.revive_replica(1)
             t_revive = time.monotonic()
-            t_readmit = _wait_counter(fab.pool, "readmissions", 1)
+            t_readmit = _wait_metric(endpoint,
+                                     "nns_fabric_readmissions_total",
+                                     pool_labels, 1)
             time.sleep(steady_s / 2)
 
         # -- phase 2: rolling swap across all replicas under traffic ------
@@ -137,11 +181,13 @@ def bench(steady_s: float = 2.0, rate_hz: float = 120.0) -> dict:
                         if not failover_window[0] <= t <= failover_window[1])
         during = sorted(lat for t, lat in tr.samples
                         if failover_window[0] <= t <= failover_window[1])
-        snap = fab.snapshot()
+        retries = _scrape_metric(endpoint, "nns_fabric_retries_total",
+                                 **pool_labels)
         result = {
             "bench": "fabric_failover",
             "rate_hz": rate_hz,
             "replicas": 3,
+            "metrics_source": endpoint + "/metrics",
             "failover": {
                 "requests": len(tr.samples),
                 "errors": [m for _t, m in tr.errors],
@@ -153,7 +199,7 @@ def bench(steady_s: float = 2.0, rate_hz: float = 120.0) -> dict:
                 "steady_p99_ms": round(_percentile(steady, 99) * 1e3, 2),
                 "failover_window_p99_ms": round(
                     _percentile(during, 99) * 1e3, 2),
-                "retries": snap["retries"],
+                "retries": None if retries is None else int(retries),
             },
             "rolling_swap": {
                 "requests": len(tr2.samples),
@@ -172,6 +218,7 @@ def bench(steady_s: float = 2.0, rate_hz: float = 120.0) -> dict:
             result["ok"] = result["ok"] and not tsan
         return result
     finally:
+        ctrl.stop()
         fab.stop()
         mgr.shutdown()
 
