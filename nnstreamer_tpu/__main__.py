@@ -17,6 +17,9 @@ launch conversion), ``tools/development/nnstreamerCodeGenCustomFilter.py``
     python -m nnstreamer_tpu service list           # talk to a serve process
     python -m nnstreamer_tpu obs metrics            # Prometheus scrape/dump
     python -m nnstreamer_tpu obs flight             # crash flight recorder
+    python -m nnstreamer_tpu obs profile --launch "a ! b"  # profile artifact
+    python -m nnstreamer_tpu obs slo                # SLO burn-rate status
+    python -m nnstreamer_tpu obs top --watch 2      # live text dashboard
 """
 from __future__ import annotations
 
@@ -240,6 +243,84 @@ def _cmd_serve(args) -> int:
     return 0
 
 
+def _obs_profile(args) -> int:
+    """``obs profile``: snapshot, artifact emission, merge, diff."""
+    from .obs import profile as obs_profile
+    from .service import ControlClient
+
+    if args.merge:
+        if not args.out:
+            print("--merge needs --out PATH for the merged artifact",
+                  file=sys.stderr)
+            return 2
+        arts = [obs_profile.ProfileArtifact.load(p) for p in args.merge]
+        base = arts[0]
+        for a in arts[1:]:
+            base.merge(a)
+        base.save(args.out)
+        print(f"merged {len(arts)} artifact(s) -> {args.out}")
+        print(json.dumps(base.summary(), indent=2))
+        return 0
+    if args.diff:
+        a = obs_profile.ProfileArtifact.load(args.diff[0])
+        b = obs_profile.ProfileArtifact.load(args.diff[1])
+        print(json.dumps(a.diff(b), indent=2))
+        return 0
+    if args.launch:
+        from .runtime.parse import parse_launch
+
+        pipe = parse_launch(args.launch)
+        obs_profile.start()
+        try:
+            pipe.run(timeout=args.run_timeout)
+        finally:
+            obs_profile.stop()
+        art = obs_profile.ProfileArtifact.capture(
+            pipe, model_version=args.model_version)
+        out = args.out or "profile.json"
+        art.save(out)
+        print(f"wrote profile artifact {out} "
+              f"(topology {art.key['topology']}, "
+              f"model '{art.key['model_version']}')")
+        print(json.dumps(art.summary(), indent=2))
+        return 0
+    if args.endpoint:
+        print(json.dumps(ControlClient(args.endpoint).profile(), indent=2))
+    else:
+        print(json.dumps(obs_profile.snapshot(), indent=2))
+    return 0
+
+
+def _obs_top(args) -> int:
+    """``obs top``: one-shot (default) or ``--watch N`` refreshing text
+    dashboard of per-element rates, queue waits/depths, fused quantiles,
+    request series, and SLO burn."""
+    import time
+
+    from .obs import profile as obs_profile
+    from .service import ControlClient
+
+    def fetch() -> dict:
+        if args.endpoint:
+            return ControlClient(args.endpoint).profile()
+        from .obs import slo as obs_slo
+
+        return {"profile": obs_profile.snapshot(),
+                "slo": obs_slo.status_all()}
+
+    while True:
+        data = fetch()
+        print(obs_profile.render_top(data.get("profile", {}),
+                                     data.get("slo", [])))
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+        print()
+
+
 def _cmd_obs(args) -> int:
     """Observability verbs (docs/observability.md):
 
@@ -247,9 +328,16 @@ def _cmd_obs(args) -> int:
       endpoint (``--endpoint``) or rendered from THIS process's registry
       (useful under ``python -c``/tests; a fresh CLI process has no
       pipelines, so local mode mostly shows the obs plane itself);
-    * ``obs flight`` — the crash flight recorder's recent events;
+    * ``obs flight`` — the crash flight recorder's recent events
+      (``--pipeline`` filters on the event's pipeline tag);
     * ``obs trace`` — export recorded spans as Perfetto/chrome-trace
-      JSON (``--out``, default nns_spans.json).
+      JSON (``--out``, default nns_spans.json);
+    * ``obs profile`` — continuous-profiler snapshot (local or
+      ``--endpoint``), or run ``--launch`` under the profiler and write
+      a profile artifact (``--out``); ``--merge``/``--diff`` operate on
+      saved artifacts;
+    * ``obs slo`` — SLO status (burn rates, alerting) local or remote;
+    * ``obs top`` — one-shot/``--watch`` text dashboard.
     """
     from .service import ControlClient, ServiceError
 
@@ -264,12 +352,25 @@ def _cmd_obs(args) -> int:
         elif args.verb == "flight":
             if args.endpoint:
                 events = ControlClient(args.endpoint).flight(
-                    last=args.last)["events"]
+                    last=args.last, pipeline=args.pipeline)["events"]
             else:
                 from .obs import flight as obs_flight
 
-                events = obs_flight.dump(last=args.last)
+                events = obs_flight.dump(last=args.last,
+                                         pipeline=args.pipeline)
             print(json.dumps(events, indent=2, default=str))
+        elif args.verb == "profile":
+            return _obs_profile(args)
+        elif args.verb == "slo":
+            if args.endpoint:
+                status = ControlClient(args.endpoint).profile()["slo"]
+            else:
+                from .obs import slo as obs_slo
+
+                status = obs_slo.status_all()
+            print(json.dumps(status, indent=2, default=str))
+        elif args.verb == "top":
+            return _obs_top(args)
         elif args.verb == "trace":
             if args.endpoint:
                 # no remote span-export route exists; silently exporting
@@ -398,15 +499,33 @@ def main(argv=None) -> int:
     p.set_defaults(fn=_cmd_service)
 
     p = sub.add_parser("obs", help="observability: /metrics scrape, "
-                                   "flight-recorder dump, span export "
+                                   "flight-recorder dump, span export, "
+                                   "profiler/SLO/top "
                                    "(see docs/observability.md)")
-    p.add_argument("verb", choices=["metrics", "flight", "trace"])
+    p.add_argument("verb", choices=["metrics", "flight", "trace",
+                                    "profile", "slo", "top"])
     p.add_argument("--endpoint", default=None,
                    help="serve control endpoint URL (omit = this process)")
     p.add_argument("--last", type=int, default=64,
                    help="flight: newest N events")
+    p.add_argument("--pipeline", default=None,
+                   help="flight: only events tagged with this pipeline")
     p.add_argument("--out", default=None,
-                   help="trace: output JSON path (default nns_spans.json)")
+                   help="trace/profile: output JSON path")
+    p.add_argument("--launch", default=None,
+                   help="profile: run this launch line under the profiler "
+                        "and write a profile artifact")
+    p.add_argument("--model-version", default="",
+                   help="profile: model version recorded in the artifact "
+                        "key")
+    p.add_argument("--run-timeout", type=float, default=300.0,
+                   help="profile: --launch run timeout seconds")
+    p.add_argument("--merge", nargs="+", metavar="ARTIFACT",
+                   help="profile: merge saved artifacts into --out")
+    p.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                   help="profile: p50/p99 deltas between two artifacts")
+    p.add_argument("--watch", type=float, default=0.0,
+                   help="top: refresh every N seconds until interrupted")
     p.set_defaults(fn=_cmd_obs)
 
     p = sub.add_parser("lint", help="static pipeline-graph / source lint "

@@ -26,10 +26,24 @@ cross-subsystem. Three pieces, one contract (near-zero cost when idle):
   on DEGRADED transitions, so "why did it stall" is answerable after
   the fact.
 
-See docs/observability.md for the span model, propagation rules, and
-the metric name catalog.
+* :mod:`.profile` — the continuous profiler: wall time attributed per
+  element / fused segment / queue-wait hop into mergeable
+  streaming-quantile digests (:class:`~.profile.QuantileDigest`),
+  persisted as **profile artifacts** keyed by (topology hash, caps,
+  model version) with load/merge/diff APIs — the placement planner's
+  and AOT cache's input. Surfaced at ``GET /profile`` and
+  ``python -m nnstreamer_tpu obs profile|top``.
+
+* :mod:`.slo` — declarative per-service objectives (p99 latency, error
+  rate, availability) evaluated from the same windowed digests with
+  multi-window burn-rate alerting: breaches record flight events,
+  export ``nns_slo_*`` gauges, and flip the bound Service to DEGRADED
+  through the existing health path.
+
+See docs/observability.md for the span model, propagation rules,
+profiling/SLO semantics, and the metric name catalog.
 """
-from . import context, flight, metrics  # noqa: F401
+from . import context, flight, metrics, profile, slo  # noqa: F401
 from .context import (  # noqa: F401
     Span,
     TraceContext,
@@ -51,6 +65,15 @@ from .metrics import (  # noqa: F401
     default_registry,
     render,
 )
+from .profile import (  # noqa: F401
+    ProfileArtifact,
+    ProfileStore,
+    Profiler,
+    QuantileDigest,
+    WindowedSeries,
+    topology_hash,
+)
+from .slo import SloEngine, SLObjective  # noqa: F401
 
 __all__ = [
     "Counter",
@@ -58,9 +81,16 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricError",
+    "ProfileArtifact",
+    "ProfileStore",
+    "Profiler",
+    "QuantileDigest",
     "Registry",
+    "SLObjective",
+    "SloEngine",
     "Span",
     "TraceContext",
+    "WindowedSeries",
     "context",
     "default_registry",
     "disable_tracing",
@@ -69,8 +99,11 @@ __all__ = [
     "finished_spans",
     "flight",
     "metrics",
+    "profile",
     "record_span",
     "render",
+    "slo",
     "spans_for_trace",
     "start_span",
+    "topology_hash",
 ]

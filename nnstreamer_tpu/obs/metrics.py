@@ -142,6 +142,19 @@ class Histogram(_Instrument):
     KIND = "histogram"
     DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+    # SLO-aligned presets (docs/observability.md#histogram-buckets).
+    # STAGE: per-element hops / fused dispatches / queue waits — dense
+    # 100 µs–100 ms resolution where stage-latency objectives live, so a
+    # bucket edge sits ON every common threshold (1/2.5/5/10/25/50 ms)
+    # and burn-rate queries never interpolate across an edge.
+    LATENCY_BUCKETS_STAGE = (0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                             0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                             1.0)
+    # REQUEST: end-to-end request latency incl. retries/hedges/queueing —
+    # edges on the common request SLO thresholds (10/25/50/100/250/500 ms,
+    # 1/2.5 s) plus a long tail for timeout forensics.
+    LATENCY_BUCKETS_REQUEST = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                               0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
     def __init__(self, name: str, help_text: str,
                  labelnames: Sequence[str] = (),

@@ -93,6 +93,11 @@ class Element:
 
     def __init__(self, name: Optional[str] = None, **props):
         cls = type(self)
+        # the auto-name carries a PROCESS-global counter, so it is not
+        # stable across restarts/replicas — the profiler's canonical
+        # naming (obs/profile.py series_name) substitutes a positional
+        # alias for auto-named elements
+        self.auto_named = name is None
         if name is None:
             with Element._count_lock:
                 Element._instance_count += 1

@@ -48,6 +48,7 @@ from ..analysis.sanitizer import named_lock
 from ..core import Buffer, clock_now
 from ..obs import context as obs_context
 from ..obs import metrics as obs_metrics
+from ..obs import profile as obs_profile
 from ..utils import trace
 from ..utils.log import logger
 from .element import Element
@@ -223,6 +224,16 @@ class FusedSegment:
         self.head = elements[0]
         self.tail = elements[-1]
         self.name = f"{self.head.name}..{self.tail.name}"
+        # profiler series key: pipeline-prefixed + canonical member
+        # names (positional aliases for auto-named elements), so
+        # ProfileArtifact.capture slices one pipeline's attribution and
+        # restarts/replicas of the same launch line produce the SAME
+        # per-segment entry
+        pipe = getattr(self.head, "pipeline", None)
+        self._profile_key = (
+            f"{pipe.name if pipe is not None else '?'}:"
+            f"{obs_profile.canonical_base(self.head)}.."
+            f"{obs_profile.canonical_base(self.tail)}")
         self._lock = named_lock(f"FusedSegment._lock:{self.name}")
         self._gen = 0            # guarded-by: _lock
         self._call: Optional[Callable] = None   # guarded-by: _lock (reads racy-ok)
@@ -319,13 +330,21 @@ class FusedSegment:
         st = self.stats
         st["dispatches"] += 1
         st["total_s"] += dt
-        if st["dispatches"] % self.PROBE_EVERY == 0:
+        probed = st["dispatches"] % self.PROBE_EVERY == 0
+        if probed:
             for o in outs:
                 if hasattr(o, "block_until_ready"):
                     # nnlint: disable=NNL101 — sampled latency probe: one
                     # blocking sync every PROBE_EVERY dispatches, by contract
                     o.block_until_ready()
             st["probe_device_s"] = clock_now() - t0
+        if obs_profile.ACTIVE:
+            # continuous profiler: per-segment host dispatch time every
+            # buffer, device-complete latency on probed frames — the
+            # per-segment attribution profile artifacts persist
+            obs_profile.record_fused(
+                self._profile_key, dt,
+                device_s=st["probe_device_s"] if probed else None)
         if trace.ACTIVE:
             trace.notify_fused(self.name, t0, dt,
                                {"elements": len(self.elements)})

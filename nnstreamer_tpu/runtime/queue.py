@@ -13,10 +13,12 @@ from __future__ import annotations
 
 import threading
 from collections import deque
+from time import monotonic as _monotonic
 from typing import Optional
 
 from ..analysis.sanitizer import named_condition
 from ..core import Buffer, Caps, Event, EventType
+from ..obs import profile as obs_profile
 from ..core.caps import any_media_caps
 from ..runtime.element import Element, Prop
 from .pad import Pad, PadDirection, PadTemplate
@@ -145,6 +147,12 @@ class QueueElement(Element):
 
     # -- producer side ------------------------------------------------------
     def chain(self, pad: Pad, buf: Buffer) -> None:
+        if obs_profile.ACTIVE:
+            # queue-wait attribution: stamp entry, measured at the worker
+            # pop (one module-global check when profiling is off; the
+            # meta stamp races benignly on tee-shared buffers, same
+            # contract as InterLatencyTracer's birth stamp)
+            buf.meta["_prof_q_t0"] = _monotonic()
         self._ch.put_buf(buf)
 
     def handle_sink_event(self, pad: Pad, event: Event) -> None:
@@ -184,6 +192,14 @@ class QueueElement(Element):
             if kind == "stop":
                 return
             if kind == "buf":
+                # pop unconditionally: a stamp from a profiling session
+                # that ended while the buffer was queued must not ride
+                # the meta downstream (and onto the query wire) forever
+                t0 = payload.meta.pop("_prof_q_t0", None)
+                if t0 is not None and obs_profile.ACTIVE:
+                    obs_profile.record_queue_wait(
+                        obs_profile.series_name(self),
+                        _monotonic() - t0, self._ch._n_bufs)
                 try:
                     self.srcpad.push(payload)
                 except Exception as e:  # noqa: BLE001

@@ -15,7 +15,10 @@ Routes (JSON unless noted):
                                           unified obs registry (serving,
                                           service, fabric, fused segments;
                                           docs/observability.md)
-    GET    /flight                        flight-recorder tail (?last=N)
+    GET    /flight                        flight-recorder tail
+                                          (?last=N&pipeline=NAME)
+    GET    /profile                       continuous-profiler snapshot +
+                                          SLO status (obs profile / top)
     GET    /services                      list (name/state/ready/restarts)
     GET    /services/<name>               full health snapshot
     POST   /services                      register {name, launch, ...}
@@ -173,6 +176,12 @@ def _make_handler(manager: ServiceManager):
                     raise ValueError(f"last={params['last']!r} not an int")
                 return {"events": obs_flight.dump(
                     last=last, pipeline=params.get("pipeline"))}
+            if parts == ["profile"] and method == "GET":
+                from ..obs import profile as obs_profile
+                from ..obs import slo as obs_slo
+
+                return {"profile": obs_profile.snapshot(),
+                        "slo": obs_slo.status_all()}
             if parts == ["services"]:
                 if method == "GET":
                     return {"services": m.list()}
@@ -293,8 +302,20 @@ class ControlClient:
                 f"control endpoint unreachable (GET /metrics): "
                 f"{getattr(e, 'reason', e)}") from e
 
-    def flight(self, last: int = 256) -> dict:
-        return self._call("GET", f"/flight?last={int(last)}")
+    def flight(self, last: int = 256,
+               pipeline: Optional[str] = None) -> dict:
+        """Flight-recorder tail; ``pipeline`` filters on the event's
+        pipeline tag (parity with ``flight.dump(pipeline=)``)."""
+        from urllib.parse import quote
+
+        path = f"/flight?last={int(last)}"
+        if pipeline is not None:
+            path += f"&pipeline={quote(pipeline)}"
+        return self._call("GET", path)
+
+    def profile(self) -> dict:
+        """GET /profile — profiler snapshot + SLO status."""
+        return self._call("GET", "/profile")
 
     def list(self) -> dict:
         return self._call("GET", "/services")

@@ -84,6 +84,7 @@ from ..core import Buffer, parse_caps_string
 from ..obs import context as obs_context
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
+from ..obs import profile as obs_profile
 from ..utils.log import logger
 from ..utils.threads import ThreadRegistry
 
@@ -283,7 +284,8 @@ class ReplicaPool:
         self._latency_hist = obs_metrics.histogram(
             "nns_fabric_request_latency_seconds",
             "end-to-end fabric request latency (retries/hedges included)",
-            ("pool",))
+            ("pool",),
+            buckets=obs_metrics.Histogram.LATENCY_BUCKETS_REQUEST)
 
     # -- membership ----------------------------------------------------------
     def add_endpoint(self, host: str, port: int,
@@ -650,8 +652,13 @@ class ReplicaPool:
                     f"error:{type(err).__name__}" if err is not None
                     else "error")
             if resp is not None:
-                self._latency_hist.observe(time.monotonic() - t_req,
-                                           pool=self.name)
+                dt = time.monotonic() - t_req
+                self._latency_hist.observe(dt, pool=self.name)
+                if obs_profile.ACTIVE:
+                    # the SLO plane's fabric request series: windowed
+                    # latency digests + outcome counts per pool
+                    obs_profile.record_request(f"fabric:{self.name}", dt,
+                                               ok=True)
                 if span is not None:
                     span.end("ok")
                 return resp
@@ -660,7 +667,10 @@ class ReplicaPool:
             attempts += 1
         with self._lock:
             self.stats["request_errors"] += 1
-        self._latency_hist.observe(time.monotonic() - t_req, pool=self.name)
+        dt = time.monotonic() - t_req
+        self._latency_hist.observe(dt, pool=self.name)
+        if obs_profile.ACTIVE:
+            obs_profile.record_request(f"fabric:{self.name}", dt, ok=False)
         obs_flight.record(
             "fabric", "request_error",
             {"pool": self.name, "attempts": attempts,

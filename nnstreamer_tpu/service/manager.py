@@ -217,6 +217,37 @@ class Service:
                             else "warmup=none")
         self.supervisor.note_healthy()
 
+    def mark_degraded_external(self, reason: str) -> bool:
+        """READY → DEGRADED on an external verdict — the SLO engine's
+        burn-rate breach (obs/slo.py). Unlike :meth:`_mark_degraded`
+        this does NOT notify the supervisor: an SLO breach is overload,
+        not a crash, and a restart would only add cold-start pain. The
+        pipeline keeps serving; routers and the fabric's health tick see
+        ``readiness() == False`` and shift load away. Returns True when
+        the flip happened (False when the service was not READY)."""
+        with self._lock:
+            if self.state is not ServiceState.READY:
+                return False
+            self._set_state(ServiceState.DEGRADED, reason)
+        tail = obs_flight.dump(last=12)
+        logger.warning(
+            "service %s DEGRADED by external verdict (%s); flight tail: %s",
+            self.name, reason,
+            "; ".join(f"{e['kind']}:{e['name']}" for e in tail) or "(empty)")
+        return True
+
+    def mark_recovered(self, reason: str) -> bool:
+        """DEGRADED → READY when the external verdict clears (the SLO
+        engine recovers only services IT degraded — a stall-watchdog
+        DEGRADED, which has a supervisor restart pending, is never
+        short-circuited here). Returns True when the flip happened."""
+        with self._lock:
+            if self.state is not ServiceState.DEGRADED:
+                return False
+            self._set_state(ServiceState.READY, reason)
+        self.supervisor.note_healthy()
+        return True
+
     def _mark_degraded(self, reason: str) -> None:
         """Watchdog verdict: still playing, no longer serving. The
         supervisor decides whether DEGRADED becomes a restart."""

@@ -15,8 +15,9 @@ from __future__ import annotations
 
 import threading
 import weakref
-from typing import Dict
+from typing import Dict, Optional
 
+from ..obs import profile as obs_profile
 from ..utils.stats import InvokeStats, LatencyReservoir
 
 _registry: "weakref.WeakValueDictionary[str, object]" = \
@@ -65,6 +66,11 @@ class ServingMetrics:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # profiler request-series name ("serving:<scheduler>") — set by
+        # the owning scheduler after registration; while set and the
+        # profiler is ACTIVE, every finished request lands in the
+        # windowed digests the SLO engine evaluates burn rates from
+        self.series: Optional[str] = None
         self.submitted = 0
         self.completed = 0
         self.failed = 0
@@ -116,6 +122,9 @@ class ServingMetrics:
             self.ttft.add(m["ttft_s"])
         if "total_latency_s" in m:
             self.total.add(m["total_latency_s"])
+        if obs_profile.ACTIVE and self.series is not None:
+            obs_profile.record_request(
+                self.series, m.get("total_latency_s", 0.0), ok=not failed)
 
     def record_decode_step(self, active: int, slots: int,
                            device_s: float) -> None:

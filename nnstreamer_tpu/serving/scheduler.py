@@ -117,6 +117,9 @@ class Scheduler:
         self.metrics = ServingMetrics()
         self._on_close = on_close
         self.name = register_scheduler(name, self)
+        # request-latency series for the profiler/SLO plane (the name is
+        # final only after registration uniquifies it)
+        self.metrics.series = f"serving:{self.name}"
         self._running = threading.Event()
         self._closed = False
         self._thread: Optional[threading.Thread] = None
@@ -330,6 +333,7 @@ class DecodeScheduler:
                                   on_shed=self._on_queue_shed)
         self.metrics = ServingMetrics()
         self.name = register_scheduler(name, self)
+        self.metrics.series = f"serving:{self.name}"
         self._active: Dict[int, Request] = {}
         self._free: List[int] = list(range(engine.slots))[::-1]
         self._running = threading.Event()

@@ -314,6 +314,16 @@ def install_tracer(tracer: Tracer) -> None:
         ACTIVE = True
 
 
+def uninstall_tracer(tracer: Tracer) -> None:
+    """Remove ONE installed tracer (the continuous profiler detaches
+    itself without killing an app's chrometrace/proctime tracers)."""
+    global ACTIVE
+    with _lock:
+        if tracer in _tracers:
+            _tracers.remove(tracer)
+        ACTIVE = bool(_tracers)
+
+
 def uninstall_tracers() -> None:
     global ACTIVE
     with _lock:

@@ -20,7 +20,11 @@ hot paths pay one module-global check and nothing else — measured as a
 host chain after an enable→disable cycle vs the same chain never
 enabled, asserted within 2% (best-of-N to absorb shared-runner jitter).
 Enabled-mode overhead (chrometrace + span tracing on) is REPORTED in
-the JSON, not gated — turning tracing on is a deliberate trade.
+the JSON, not gated — turning tracing on is a deliberate trade. The
+continuous profiler (obs/profile.py) gets the same leg with the same
+<= 2% gate on its stopped fast path (queue/fusion/request hooks back to
+one module-global check after ``profile.stop()``); profiler-enabled
+overhead is reported alongside.
 
 Usage:
   python tools/microbench_overhead.py [n_frames]      # full report
@@ -144,6 +148,48 @@ def tracing_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     }
 
 
+def profiler_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
+    """Continuous-profiler cost on an 8-element HOST chain, same
+    three-state protocol (and the same min-of-pairs gate discipline) as
+    :func:`tracing_overhead_report`:
+
+    * ``baseline`` — profiler never started in this leg's pair;
+    * ``enabled``  — ``obs.profile.start()`` (element tracer + queue/
+      fused/request hooks + digest inserts) — REPORTED, not gated;
+    * ``disabled`` — after ``stop()``: back to the one-module-global
+      check, gated at <= 2% vs its paired baseline.
+    """
+    import statistics
+
+    from nnstreamer_tpu.obs import profile as obs_profile
+
+    measure(8, max(200, n_bufs // 4))  # warmup
+    baselines, disableds, enabled = [], [], None
+    for _ in range(attempts):
+        baselines.append(measure(8, n_bufs))
+        obs_profile.start()
+        try:
+            if enabled is None:
+                enabled = measure(8, n_bufs)
+        finally:
+            obs_profile.stop()
+            obs_profile.reset()
+        disableds.append(measure(8, n_bufs))
+    ratios = [d / b for b, d in zip(baselines, disableds)]
+    baseline = min(baselines)
+    return {
+        "n_frames": n_bufs,
+        "attempts": attempts,
+        "baseline_us_per_frame": baseline * 1e6,
+        "enabled_us_per_frame": enabled * 1e6,
+        "disabled_us_per_frame": min(disableds) * 1e6,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "disabled_overhead_frac": min(ratios) - 1.0,
+        "disabled_overhead_frac_median": statistics.median(ratios) - 1.0,
+        "enabled_overhead_frac": enabled / baseline - 1.0,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("n_frames", nargs="?", type=int, default=4000)
@@ -159,6 +205,7 @@ def main() -> None:
         # tracing-overhead gate FIRST: it needs a process where tracing
         # was never enabled for its baseline leg
         tracing = tracing_overhead_report(n_bufs=2000, attempts=4)
+        profiling = profiler_overhead_report(n_bufs=2000, attempts=4)
         # best-of-two: wall-clock ratios on shared CI runners flake under
         # co-tenant load spikes (same mitigation as tests/test_throughput);
         # a genuine regression fails BOTH measurements
@@ -170,6 +217,7 @@ def main() -> None:
             if best["speedup_marginal"] >= 2.0:
                 break
         best["tracing_overhead"] = tracing
+        best["profiler_overhead"] = profiling
         print(json.dumps(best, indent=2))
         ok = best["speedup_marginal"] >= 2.0
         print(f"smoke: fused marginal speedup {best['speedup_marginal']:.1f}x "
@@ -181,17 +229,33 @@ def main() -> None:
               f"{tracing['disabled_overhead_frac'] * 100:+.2f}% vs baseline "
               f"(gate <= 2%), enabled mode "
               f"{tracing['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
-        sys.exit(0 if ok and trc_ok else 1)
+        prof_ok = profiling["disabled_overhead_frac"] <= 0.02
+        verdict = ("OK" if prof_ok
+                   else "REGRESSION — stopped profiler is not free anymore")
+        print(f"smoke: profiler-disabled fast path "
+              f"{profiling['disabled_overhead_frac'] * 100:+.2f}% vs "
+              f"baseline (gate <= 2%), enabled mode "
+              f"{profiling['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
+        sys.exit(0 if ok and trc_ok and prof_ok else 1)
 
     n_bufs = args.n_frames
     report = {"n_frames": n_bufs, "host_chain": [], "device_chain": None,
-              "tracing_overhead": None}
+              "tracing_overhead": None, "profiler_overhead": None}
     # before any other measurement: the baseline leg requires a process
     # where tracing has never been enabled
     report["tracing_overhead"] = tracing_overhead_report(
         n_bufs=min(n_bufs, 2000))
     t = report["tracing_overhead"]
     print("— tracing overhead (8-element host chain) —")
+    print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
+          f"enabled {t['enabled_us_per_frame']:8.1f} "
+          f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
+          f"disabled {t['disabled_us_per_frame']:8.1f} "
+          f"({t['disabled_overhead_frac'] * 100:+.2f}%, gate <= 2%)")
+    report["profiler_overhead"] = profiler_overhead_report(
+        n_bufs=min(n_bufs, 2000))
+    t = report["profiler_overhead"]
+    print("— continuous-profiler overhead (8-element host chain) —")
     print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
           f"enabled {t['enabled_us_per_frame']:8.1f} "
           f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
