@@ -33,11 +33,30 @@ def _cmd_launch(args) -> int:
     from .runtime.describe import load_pipeline_file
     from .runtime.parse import parse_launch
 
+    import os
+
+    place = None
+    if args.place and os.environ.get("NNS_NO_PLACE", "") in ("1", "true",
+                                                             "yes"):
+        # the operational kill switch must win on BOTH input forms —
+        # the file path below assigns pipe.place directly, bypassing
+        # the Pipeline-constructor check the launch-string path gets
+        args.place = None
+    if args.place:
+        if args.place == "auto":
+            place = "auto"
+        else:  # a saved PlacementPlan JSON (see docs/placement.md)
+            from .runtime.placement import PlacementPlan
+
+            with open(args.place) as fh:
+                place = PlacementPlan.from_dict(json.load(fh))
     text = args.pipeline
     if text.endswith(".json") or text.endswith(".launch"):
         pipe = load_pipeline_file(text)
+        if place is not None:
+            pipe.place = place
     else:
-        pipe = parse_launch(text)
+        pipe = parse_launch(text, place=place)
     pipe.play()
     # no --timeout means "wait for the stream to finish" (bounded at a day
     # so a wedged pipeline still exits nonzero instead of hanging forever)
@@ -304,14 +323,17 @@ def _obs_top(args) -> int:
         if args.endpoint:
             return ControlClient(args.endpoint).profile()
         from .obs import slo as obs_slo
+        from .runtime import placement
 
         return {"profile": obs_profile.snapshot(),
-                "slo": obs_slo.status_all()}
+                "slo": obs_slo.status_all(),
+                "placement": placement.snapshot_all()}
 
     while True:
         data = fetch()
         print(obs_profile.render_top(data.get("profile", {}),
-                                     data.get("slo", [])))
+                                     data.get("slo", []),
+                                     placement=data.get("placement")))
         if not args.watch:
             return 0
         try:
@@ -444,6 +466,11 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=None)
     p.add_argument("--latency", action="store_true",
                    help="print the pipeline LATENCY query (JSON) at EOS")
+    p.add_argument("--place", default=None, metavar="auto|PLAN.json",
+                   help="profile-guided cross-device placement: 'auto' "
+                        "plans from the NNS_PROFILE_STORE artifact store "
+                        "(calibrating on a miss), a path applies a saved "
+                        "PlacementPlan JSON (docs/placement.md)")
     p.set_defaults(fn=_cmd_launch)
 
     p = sub.add_parser("inspect", help="list elements / show one (gst-inspect)")

@@ -86,6 +86,12 @@ _RULES = (
          "this pipeline — which linear runs of device elements collapse "
          "to ONE XLA dispatch per buffer (runtime/fusion.py); info "
          "findings never gate, not even under --strict"),
+    Rule("NNL014", Severity.INFO, "placement plan available",
+         "informational: a multi-stage device pipeline runs with default "
+         "placement while the profile store (NNS_PROFILE_STORE) holds a "
+         "matching ProfileArtifact — a better plan is available via "
+         "Pipeline(place=\"auto\") (runtime/placement.py); info findings "
+         "never gate, not even under --strict"),
     # -- source lint (pass 2) -----------------------------------------------
     Rule("NNL100", Severity.ERROR, "unlintable source file",
          "a file handed to the source lint cannot be read or parsed "

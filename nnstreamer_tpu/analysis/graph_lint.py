@@ -83,6 +83,7 @@ def lint_pipeline(pipeline) -> List[Diagnostic]:
         diags += _check_serving_buckets(elements, est)
     diags += _check_host_roundtrip(elements)
     diags += _check_fusion_plan(pipeline)
+    diags += _check_placement_hint(pipeline)
     return diags
 
 
@@ -533,3 +534,40 @@ def _check_fusion_plan(pipeline) -> List[Diagnostic]:
             location=seg[0].name,
             hint="disable with Pipeline(fuse=False) or NNS_NO_FUSE=1"))
     return diags
+
+
+def _check_placement_hint(pipeline) -> List[Diagnostic]:
+    """NNL014 (info): the pipeline has >= 2 device stages it leaves on
+    default placement, AND the profile store already holds a matching
+    artifact — the placement planner could balance those stages across
+    chips from real measurements ("a better plan is available"). Info
+    only: never gates, not even under --strict, and absent entirely when
+    no store is configured (NNS_PROFILE_STORE unset) — the lint touches
+    no device and opens no backend, same contract as every graph rule."""
+    if getattr(pipeline, "place", None):
+        return []  # placement is already on (or an explicit plan applies)
+    try:
+        from ..obs import profile as obs_profile
+        from ..runtime.fusion import plan_segments
+        from ..runtime.placement import Planner
+
+        planner = Planner()
+        if planner.store is None:
+            return []
+        stages = plan_segments(pipeline, min_run=1).segments
+        if len(stages) < 2:
+            return []  # a single stage has nothing to balance
+        artifact = planner.artifact_for(pipeline)
+    except Exception:  # noqa: BLE001 - an info hint must never fail lint
+        return []
+    if artifact is None:
+        return []
+    return [make(
+        "NNL014",
+        f"{len(stages)}-stage device pipeline runs with default placement "
+        f"but the profile store holds a matching artifact "
+        f"(topology {artifact.key.get('topology', '?')}) — a better plan "
+        "is available",
+        location=next(iter(pipeline.elements), ""),
+        hint='enable with Pipeline(place="auto") / parse_launch(place='
+             '"auto") or `launch --place auto`')]

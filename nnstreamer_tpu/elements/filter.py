@@ -192,7 +192,18 @@ class TensorFilter(TransformElement):
         self._last_invoke_ts = 0.0  # guarded-by: _backend_lock
         self._suspend_thread: Optional[threading.Thread] = None
         self._suspend_stop = threading.Event()
+        # placement-planner device pin for singleton stages
+        # (runtime/placement.py): consumed at backend open; an explicit
+        # user custom=device:N / mesh: always wins
+        self._placement_device_index: Optional[int] = None
         self._validate_model_ref()
+
+    def set_placement_device(self, index: Optional[int]) -> None:
+        """Planner-assigned chip for this filter when it is a placement
+        stage of its own (not inside a fused segment). Applies the next
+        time the backend opens — play(), supervised restart, suspend
+        resume — never mid-invoke; None clears the pin."""
+        self._placement_device_index = index
 
     # model-file extensions whose absence is a hard CONSTRUCTION error: the
     # reference's negative launch lines (runTest.sh expectFail cases for
@@ -291,9 +302,19 @@ class TensorFilter(TransformElement):
         # registry version even if the registry file changes concurrently
         model_path, hint = self._resolve_model()
         fw = self._detect_framework(model_path, hint)
+        custom = self._custom_with_config_file()
+        pin = self._placement_device_index
+        if pin is not None:
+            # placement-planner pin (set_placement_device): forwarded as
+            # the backend's own device:N custom option, UNLESS the user
+            # already placed this filter explicitly — the planner must
+            # never silently override a hand placement
+            cd = FilterProperties(custom=custom).custom_dict()
+            if "device" not in cd and "mesh" not in cd:
+                custom = f"{custom},device:{pin}" if custom else f"device:{pin}"
         fprops = FilterProperties(
             model=model_path,
-            custom=self._custom_with_config_file(),
+            custom=custom,
             accelerator=Accelerator(self.props["accelerator"]),
         )
         self.backend = acquire_backend(

@@ -33,9 +33,16 @@ SEQ_META = "shard_seq"
 
 @register_element
 class TensorShard(Element):
-    """1 → N round-robin scatter; each frame goes to exactly ONE branch
-    (unlike tee) and carries its global sequence number in
-    ``meta["shard_seq"]`` (also mirrored to ``Buffer.offset``)."""
+    """1 → N scatter; each frame goes to exactly ONE branch (unlike tee)
+    and carries its global sequence number in ``meta["shard_seq"]``
+    (also mirrored to ``Buffer.offset``).
+
+    Dispatch is round-robin by default, or **weighted** (smooth weighted
+    round-robin — nginx's deterministic spread, no RNG) when per-branch
+    weights are set: ``weights=0.5,0.25,0.25`` in the launch line for a
+    hand split, or :meth:`set_branch_weights` for the placement
+    planner's profile-derived assignment (a branch twice as slow gets
+    half the frames — ``runtime/placement.py``)."""
 
     ELEMENT_NAME = "tensor_shard"
     SINK_TEMPLATES = (PadTemplate("sink", PadDirection.SINK, _TENSOR_CAPS),)
@@ -43,14 +50,62 @@ class TensorShard(Element):
         PadTemplate("src_%u", PadDirection.SRC, _TENSOR_CAPS,
                     PadPresence.REQUEST),
     )
+    PROPERTIES = {
+        "weights": Prop("", str,
+                        "comma-separated relative branch weights "
+                        "(empty = uniform round-robin); the placement "
+                        "planner overrides via set_branch_weights"),
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._seq = 0
+        # (weights, credit) published as ONE tuple: the planner can
+        # retune from a dispatching thread mid-stream, and the chain
+        # path must never see new weights with the old credit list
+        # (length tear -> IndexError)
+        self._wrr: Optional[tuple] = None
+        w = str(self.props.get("weights") or "").strip()
+        if w:
+            self.set_branch_weights([float(x) for x in w.split(",")])
+
+    def set_branch_weights(self, weights: Optional[List[float]]) -> None:
+        """Install per-branch weights (planner-produced assignment or a
+        hand split); None/empty restores uniform round-robin. Takes
+        effect on the next frame — safe while streaming (the chain path
+        reads the (weights, credit) pair as one reference)."""
+        if not weights:
+            self._wrr = None
+            return
+        if any(w <= 0 for w in weights):
+            raise ElementError(
+                f"{self.describe()}: weights must be > 0, got {weights}")
+        total = float(sum(weights))
+        self._wrr = ([w / total for w in weights], [0.0] * len(weights))
 
     def reset_flow(self) -> None:
         super().reset_flow()
         self._seq = 0
+        wrr = self._wrr
+        if wrr is not None:
+            self._wrr = (wrr[0], [0.0] * len(wrr[0]))
+
+    def _pick(self, n: int) -> int:
+        """Branch for the next frame: smooth weighted round-robin — each
+        tick every branch gains its weight in credit, the richest branch
+        pays 1 and wins; uniform weights reduce to exact round-robin."""
+        wrr = self._wrr
+        if wrr is None or len(wrr[0]) != n:
+            # weight arity must match the linked branches; a mismatched
+            # plan (branch added/removed) falls back to uniform rather
+            # than starving branches silently
+            return self._seq % n
+        w, credit = wrr
+        for i in range(n):
+            credit[i] += w[i]
+        best = max(range(n), key=lambda i: (credit[i], -i))
+        credit[best] -= 1.0
+        return best
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
         linked = [p for p in self.src_pads if p.is_linked]
@@ -58,7 +113,7 @@ class TensorShard(Element):
             raise ElementError(f"{self.describe()}: no linked src pads")
         buf.meta[SEQ_META] = self._seq
         buf.offset = self._seq
-        linked[self._seq % len(linked)].push(buf)
+        linked[self._pick(len(linked))].push(buf)
         self._seq += 1
 
 

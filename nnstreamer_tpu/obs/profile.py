@@ -472,13 +472,17 @@ class _ProfilerTracer:
 default_profiler = Profiler()
 _ctl_lock = threading.Lock()
 _tracer: Optional[_ProfilerTracer] = None
-# ACTIVE is the OR of two independent halves, so an explicit
-# start()/stop() profiling session and a running SLO engine
-# (enable_recording/disable_recording) cannot starve each other:
+# ACTIVE is the OR of three independent halves, so an explicit
+# start()/stop() profiling session, a running SLO engine
+# (enable_recording/disable_recording), and a placement-calibration
+# window (begin_calibration/end_calibration, refcounted — several
+# pipelines may calibrate concurrently) cannot starve each other:
 # stop() ending a capture while an engine is alive must NOT silence the
-# request series its burn rates are computed from
+# request series its burn rates are computed from, and a calibration
+# finishing must not switch off another pipeline's window
 _started = False        # guarded-by: _ctl_lock — start()/stop() sessions
 _recording = False      # guarded-by: _ctl_lock — SLO-engine recording
+_calibrating = 0        # guarded-by: _ctl_lock — placement calibrations
 
 
 def profiler() -> Profiler:
@@ -487,7 +491,7 @@ def profiler() -> Profiler:
 
 def _update_active() -> None:
     global ACTIVE
-    ACTIVE = _started or _recording
+    ACTIVE = _started or _recording or _calibrating > 0
 
 
 def start(elements: bool = True) -> Profiler:
@@ -522,6 +526,24 @@ def disable_recording() -> None:
     global _recording
     with _ctl_lock:
         _recording = False
+        _update_active()
+
+
+def begin_calibration() -> None:
+    """Placement-calibration recording (queue/fused hooks, no element
+    tracer), REFCOUNTED: each ``begin`` must be paired with one ``end``,
+    and concurrent calibrating pipelines keep recording alive until the
+    last one finishes (runtime/placement.py)."""
+    global _calibrating
+    with _ctl_lock:
+        _calibrating += 1
+        _update_active()
+
+
+def end_calibration() -> None:
+    global _calibrating
+    with _ctl_lock:
+        _calibrating = max(0, _calibrating - 1)
         _update_active()
 
 
@@ -760,6 +782,23 @@ class ProfileArtifact:
         }
 
 
+#: env var naming the default on-disk ProfileStore directory — the
+#: placement planner (runtime/placement.py) and the NNL014 lint hint
+#: consult it when no explicit store is handed in; unset = no default
+#: store (plan falls back to calibration/heuristics)
+STORE_ENV = "NNS_PROFILE_STORE"
+
+
+def default_store() -> Optional["ProfileStore"]:
+    """The process-default artifact store (``NNS_PROFILE_STORE`` dir), or
+    None when the env var is unset. The directory is created on first
+    use (ProfileStore.__init__)."""
+    root = os.environ.get(STORE_ENV, "").strip()
+    if not root:
+        return None
+    return ProfileStore(root)
+
+
 class ProfileStore:
     """On-disk artifact store keyed by (topology, caps, model version).
     ``save(merge=True)`` folds a new capture into the existing artifact
@@ -812,11 +851,27 @@ class ProfileStore:
 
 # -- text dashboard (obs top) -------------------------------------------------
 
-def render_top(profile_snap: dict, slo_status: List[dict]) -> str:
+def render_top(profile_snap: dict, slo_status: List[dict],
+               placement: Optional[List[dict]] = None) -> str:
     """The ``obs top`` one-shot/watch dashboard: per-element rates,
-    queue waits + depths, fused quantiles, request series, SLO burn."""
+    queue waits + depths, fused quantiles, request series, SLO burn,
+    and — when a placement plan is installed — per-stage device
+    assignment + balance (runtime/placement.py)."""
     lines = [f"nns obs top — profiling "
              f"{'ON' if profile_snap.get('active') else 'off'}"]
+    for plan in placement or []:
+        lines.append("")
+        lines.append(f"PLACEMENT [{plan.get('pipeline', '?')}] "
+                     f"source={plan.get('source', '?')} "
+                     f"max-stage {plan.get('balance', {}).get('max_stage_ms', 0):.3f}ms "
+                     f"/ target {plan.get('balance', {}).get('target_ms', 0):.3f}ms")
+        lines.append(f"  {'stage':<40} {'device':>8} {'cost_ms':>9}")
+        for st in plan.get("stages", []):
+            lines.append(f"  {st['stage']:<40} {st['device']:>8d} "
+                         f"{st['cost_ms']:>9.3f}")
+        for qname, q in sorted(plan.get("queues", {}).items()):
+            lines.append(f"  queue {qname:<34} depth={q['depth']:<4d} "
+                         f"(wait p99 {q.get('wait_p99_ms', 0.0):.3f}ms)")
     durations = profile_snap.get("durations", {})
     sections = (("element", "ELEMENTS (per-hop wall time)"),
                 ("fused", "FUSED SEGMENTS (host dispatch)"),

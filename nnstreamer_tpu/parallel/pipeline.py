@@ -30,8 +30,44 @@ def stack_stage_params(params_list) -> Any:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
 
 
-def make_pipeline(stage_fn: Callable, num_stages: int, mesh,
-                  axis: str = "pp") -> Callable:
+def mesh_from_assignment(assignment, num_stages: int, axis: str = "pp",
+                         devices=None):
+    """Build the ``pp`` mesh for a planner-produced stage→device
+    assignment: stage ``s`` runs on ``devices[assignment[s]]``.
+
+    ``assignment`` is a sequence of device indices (one per stage,
+    distinct) or a ``runtime.placement.PlacementPlan`` — the planner's
+    stage order IS the pipeline stage order, so its per-stage device
+    indices transfer directly. ``devices`` defaults to ``jax.devices()``
+    (the same farm ``runtime/placement.py`` assigns over).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if hasattr(assignment, "stages"):  # a PlacementPlan
+        assignment = [st.device for st in assignment.stages]
+    assignment = [int(i) for i in assignment]
+    if len(assignment) != num_stages:
+        raise ValueError(
+            f"pipeline: assignment has {len(assignment)} stages, "
+            f"expected {num_stages}")
+    if len(set(assignment)) != num_stages:
+        raise ValueError(
+            f"pipeline: assignment {assignment} reuses a device — GPipe "
+            "stages need one chip each (params + activations resident)")
+    devices = list(devices if devices is not None else jax.devices())
+    for i in assignment:
+        if not 0 <= i < len(devices):
+            raise ValueError(
+                f"pipeline: assignment index {i} out of range "
+                f"({len(devices)} devices)")
+    return Mesh(np.array([devices[i] for i in assignment]), (axis,))
+
+
+def make_pipeline(stage_fn: Callable, num_stages: int, mesh=None,
+                  axis: str = "pp", assignment=None,
+                  devices=None) -> Callable:
     """Build ``run(stacked_params, microbatches) -> outputs``.
 
     * ``stage_fn(stage_params, x) -> y`` — one stage's forward, shapes
@@ -40,6 +76,11 @@ def make_pipeline(stage_fn: Callable, num_stages: int, mesh,
       sharded over ``axis`` (see stack_stage_params);
     * ``microbatches`` — (M, mb, ...) input, replicated over ``axis``;
     * returns (M, mb, ...) final-stage outputs (replicated).
+
+    Stage→device mapping comes from ``mesh`` (hand-built, the classic
+    path) OR ``assignment`` (a planner-produced device-index list or
+    ``runtime.placement.PlacementPlan`` — see
+    :func:`mesh_from_assignment`); exactly one of the two.
 
     Differentiable end-to-end: jax.grad flows back through the scan and
     the ppermutes (reverse-mode is the opposite rotation).
@@ -53,6 +94,13 @@ def make_pipeline(stage_fn: Callable, num_stages: int, mesh,
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
+    if (mesh is None) == (assignment is None):
+        raise ValueError("pipeline: pass exactly one of mesh= or "
+                         "assignment= (a hand mesh OR a planner-produced "
+                         "stage->device assignment)")
+    if assignment is not None:
+        mesh = mesh_from_assignment(assignment, num_stages, axis=axis,
+                                    devices=devices)
     if dict(mesh.shape).get(axis) != num_stages:
         raise ValueError(
             f"pipeline: mesh axis '{axis}' size must equal num_stages "

@@ -107,12 +107,15 @@ class SegmentPlan:
         return "; ".join(lines) if lines else "(no fused segments)"
 
 
-def plan_segments(pipeline: "Pipeline") -> SegmentPlan:
+def plan_segments(pipeline: "Pipeline", min_run: int = 2) -> SegmentPlan:
     """Partition the graph into maximal linear runs of fusable device
     elements. Pure topology — nothing is traced, no backend is touched —
     so the static linter runs this on parsed-not-started pipelines too.
-    Runs shorter than 2 elements are not segments (a single dispatch is
-    already a single dispatch)."""
+    Runs shorter than ``min_run`` elements are not segments — the default
+    2 because a single dispatch is already a single dispatch; the
+    placement planner (runtime/placement.py) passes 1, since a lone
+    device element between queues is still a pipeline *stage* that needs
+    a chip."""
     plan = SegmentPlan()
     members: Dict[int, bool] = {}
     for el in pipeline.elements.values():
@@ -166,7 +169,7 @@ def plan_segments(pipeline: "Pipeline") -> SegmentPlan:
         if cur is not None and any(cur is m for m in seg):
             plan.barriers[seg[0].name] = "device-element cycle (not fusable)"
             continue
-        if len(seg) >= 2:
+        if len(seg) >= min_run:
             plan.segments.append(seg)
     return plan
 
@@ -238,6 +241,16 @@ class FusedSegment:
         self._gen = 0            # guarded-by: _lock
         self._call: Optional[Callable] = None   # guarded-by: _lock (reads racy-ok)
         self._defused = False    # guarded-by: _lock (reads racy-ok)
+        # placement (runtime/placement.py): the chip this segment's one
+        # dispatch is pinned to (a jax Device; None = jax default). Set
+        # at plan/replan time via set_device, consumed at _build — the
+        # steady-state dispatch path never looks at it.
+        self._device = None      # guarded-by: _lock
+        # calibration hook: placement installs a per-dispatch probe while
+        # a calibration window is open; cleared when the plan lands. Only
+        # consulted under obs_profile.ACTIVE (calibration keeps recording
+        # on), so the profiling-off hot path pays nothing.
+        self._placement_probe: Optional[Callable] = None
         # host-side per-buffer gates (QoS throttle on member filters);
         # empty for pure transform chains, so the steady-state fused path
         # pays zero extra Python per hop
@@ -266,12 +279,46 @@ class FusedSegment:
             self._gen += 1
             self._call = None
             self._defused = False
+        # the same events that invalidate the trace invalidate the
+        # placement decision (caps renegotiation changes tensor sizes,
+        # a hot swap changes the model's cost): tell the planner so the
+        # rebuild below re-resolves against a fresh plan
+        pipe = getattr(self.head, "pipeline", None)
+        state = getattr(pipe, "_placement_state", None)
+        if state is not None:
+            state.mark_dirty()
+
+    def set_device(self, device) -> None:
+        """Pin this segment's dispatch to ``device`` (placement planner).
+        A change drops the cached callable — the composed jit re-lowers
+        with the new target's in_shardings on the next buffer."""
+        with self._lock:
+            if device is self._device:
+                return
+            self._device = device
+            self._gen += 1
+            self._call = None
+            self._defused = False
+
+    @property
+    def device(self):
+        """The planner-assigned chip (None = jax default device)."""
+        return self._device
 
     def _build(self) -> Optional[Callable]:
         import jax
 
+        # a dirty placement plan (hot swap / caps event marked it) is
+        # re-resolved HERE, on the rebuild path — never per-buffer; the
+        # refresh may retarget this segment's device before the gen
+        # snapshot below, so the new callable lowers for the right chip
+        pipe = getattr(self.head, "pipeline", None)
+        state = getattr(pipe, "_placement_state", None)
+        if state is not None:
+            state.refresh_if_dirty()
         with self._lock:
             gen = self._gen
+            device = self._device
         stages = []
         for el in self.elements:
             stage = el.fusion_stage()
@@ -294,10 +341,19 @@ class FusedSegment:
                 xs = stage(xs)
             return xs
 
+        jit_kw: dict = {}
         if self._donate:
-            jitted = jax.jit(composed, donate_argnums=(0,))
-        else:
-            jitted = jax.jit(composed)
+            jit_kw["donate_argnums"] = (0,)
+        if device is not None:
+            # placement: the composed dispatch lowers FOR the assigned
+            # chip; explicit in_shardings also reshards committed inputs
+            # arriving from an upstream stage's device (the cross-stage
+            # hop moves device-to-device inside the jit call's C++ arg
+            # processing — no Python-side device_put on the hot path)
+            from jax.sharding import SingleDeviceSharding
+
+            jit_kw["in_shardings"] = SingleDeviceSharding(device)
+        jitted = jax.jit(composed, **jit_kw)
         # publish only if no invalidation raced the build (a commit_model
         # between stage resolution and here must win)
         with self._lock:
@@ -345,6 +401,12 @@ class FusedSegment:
             obs_profile.record_fused(
                 self._profile_key, dt,
                 device_s=st["probe_device_s"] if probed else None)
+            # placement calibration (runtime/placement.py): the planner's
+            # probe decides when enough samples landed to close the
+            # calibration window and re-plan from the measured profile
+            cb = self._placement_probe
+            if cb is not None:
+                cb(self)
         if trace.ACTIVE:
             trace.notify_fused(self.name, t0, dt,
                                {"elements": len(self.elements)})

@@ -177,18 +177,20 @@ def launch_chains(description: str) -> List[List[List[str]]]:
 
 
 def parse_launch(description: str, pipeline: Optional[Pipeline] = None,
-                 fuse: Optional[bool] = None) -> Pipeline:
+                 fuse: Optional[bool] = None, place=None) -> Pipeline:
     """Build a Pipeline from a launch string (elements linked, not started).
 
     Unknown element names raise with a did-you-mean suggestion from the
     registry (``registry.elements.suggest_element`` — the same helper the
-    linter's NNL001 rule uses). ``fuse`` forwards to the Pipeline
-    constructor (device-segment fusion, default on / NNS_NO_FUSE env);
-    ignored when an existing ``pipeline`` is passed in.
+    linter's NNL001 rule uses). ``fuse`` and ``place`` forward to the
+    Pipeline constructor (device-segment fusion, default on /
+    NNS_NO_FUSE env; profile-guided placement, default off /
+    ``place="auto"`` / NNS_NO_PLACE kill switch); ignored when an
+    existing ``pipeline`` is passed in.
     """
     from ..registry.elements import make_element
 
-    pipe = pipeline or Pipeline(fuse=fuse)
+    pipe = pipeline or Pipeline(fuse=fuse, place=place)
     chains = launch_chains(description)
 
     links: List[Tuple[Entry, Entry]] = []

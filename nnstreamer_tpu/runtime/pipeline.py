@@ -52,7 +52,7 @@ class Pipeline:
     """A runnable graph of elements."""
 
     def __init__(self, name: str = "pipeline", validate: bool = False,
-                 fuse: Optional[bool] = None):
+                 fuse: Optional[bool] = None, place=None):
         self.name = name
         # opt-in static validation at play(): the graph linter
         # (analysis.lint_pipeline) runs before data flows and logs its
@@ -68,6 +68,16 @@ class Pipeline:
             fuse = os.environ.get("NNS_NO_FUSE", "") not in ("1", "true", "yes")
         self.fuse = bool(fuse)
         self._fused_segments: list = []  # set by fusion.install at play()
+        # profile-guided cross-device placement (runtime/placement.py):
+        # OFF by default — place="auto" plans fused segments across the
+        # local device farm from the ProfileStore (calibrating on a
+        # miss) and tunes inter-stage queue depths; a PlacementPlan
+        # instance applies a serialized plan verbatim. NNS_NO_PLACE=1 is
+        # the operational kill switch (wins over any constructor value).
+        if os.environ.get("NNS_NO_PLACE", "") in ("1", "true", "yes"):
+            place = None
+        self.place = place
+        self._placement_state = None  # set by placement.install at play()
         self.elements: Dict[str, Element] = {}
         self.bus = Bus()
         # running-time anchor, set at each play() (GStreamer base_time analog)
@@ -160,6 +170,13 @@ class Pipeline:
         return list(self._fused_segments)
 
     @property
+    def placement_plan(self):
+        """The PlacementPlan applied by the last play() (None when
+        placement is off or nothing planned)."""
+        state = self._placement_state
+        return state.plan if state is not None else None
+
+    @property
     def sinks(self) -> List[SinkElement]:
         return [e for e in self.elements.values() if isinstance(e, SinkElement)]
 
@@ -197,6 +214,18 @@ class Pipeline:
                 fusion.install(self)
             else:
                 fusion.uninstall(self)
+            # placement AFTER fusion: the planner assigns the freshly
+            # installed segments (and re-plans from scratch on every
+            # play, so a supervised restart never keeps a stale
+            # assignment — same contract as the fusion cache)
+            if self.place:
+                from . import placement
+
+                placement.install(self)
+            elif self._placement_state is not None:
+                from . import placement
+
+                placement.uninstall(self)
             # start non-sources first so queues/filters are ready before
             # data flows
             for el in self.elements.values():
@@ -223,6 +252,12 @@ class Pipeline:
                     el.stop()
         # joined outside _state_lock — the halt threads acquire it
         self._halt_threads.drain(timeout_per=2.0)
+        if self._placement_state is not None:
+            # an open calibration window must not outlive the run that
+            # was feeding it samples (recording refcount balance)
+            from . import placement
+
+            placement.on_stop(self)
         from ..utils import trace
 
         if trace.ACTIVE:

@@ -46,11 +46,27 @@ class _Channel:
         # buffer loss invisible to the service health snapshot.
         self.dropped_upstream = 0    # guarded-by: _cond
         self.dropped_downstream = 0  # guarded-by: _cond
+        # plan-time depth retunes (runtime/placement.py)
+        self.retuned = 0             # guarded-by: _cond
 
     def reset_counters(self) -> None:
         with self._cond:
             self.dropped_upstream = 0
             self.dropped_downstream = 0
+
+    def set_capacity(self, capacity: int) -> None:
+        """Retune the depth at plan time (placement-planner hook). Safe
+        against the producer/worker paths: capacity is only read under
+        ``_cond``, and blocked producers are woken so a RAISED capacity
+        (or a switch to unbounded) admits them immediately instead of on
+        the next bounded wait slice / worker pop."""
+        capacity = max(0, int(capacity))
+        with self._cond:
+            if capacity == self.capacity:
+                return
+            self.capacity = capacity
+            self.retuned += 1
+            self._cond.notify_all()
 
     def put_buf(self, buf: Buffer) -> None:
         with self._cond:
@@ -66,7 +82,14 @@ class _Channel:
                             self.dropped_downstream += 1
                             break
                 else:
-                    while not self._closed and self._n_bufs >= self.capacity:
+                    # re-read capacity every iteration: a concurrent
+                    # set_capacity may raise it (wake via its notify) or
+                    # set it to 0 = unbounded — a stale bound here would
+                    # park this producer against a limit that no longer
+                    # exists (it could only ever leave via the worker
+                    # pop's notify, racing the retune)
+                    while (not self._closed and self.capacity > 0
+                           and self._n_bufs >= self.capacity):
                         self._cond.wait(0.25)  # backpressure, bounded slice
                     if self._closed:
                         return
@@ -139,7 +162,13 @@ class QueueElement(Element):
             "leaky": ch.leaky,
             "dropped_upstream": ch.dropped_upstream,
             "dropped_downstream": ch.dropped_downstream,
+            "retuned": ch.retuned,
         }
+
+    def set_capacity(self, capacity: int) -> None:
+        """Planner-tuned depth (runtime/placement.py): resize the bounded
+        channel without stopping flow; counted in ``stats['retuned']``."""
+        self._ch.set_capacity(capacity)
 
     def reset_flow(self) -> None:
         super().reset_flow()

@@ -179,9 +179,11 @@ def _make_handler(manager: ServiceManager):
             if parts == ["profile"] and method == "GET":
                 from ..obs import profile as obs_profile
                 from ..obs import slo as obs_slo
+                from ..runtime import placement
 
                 return {"profile": obs_profile.snapshot(),
-                        "slo": obs_slo.status_all()}
+                        "slo": obs_slo.status_all(),
+                        "placement": placement.snapshot_all()}
             if parts == ["services"]:
                 if method == "GET":
                     return {"services": m.list()}

@@ -1,0 +1,533 @@
+"""Profile-guided placement compiler (runtime/placement.py): planner
+determinism, store fallback + calibration, plan application (segment
+device pins, queue retune, shard weights), re-plan on invalidation and
+restart, byte parity auto vs place=False, NNL014, serialization, and
+the make_pipeline/tensor_shard planner-assignment surfaces."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.analysis import Severity, lint_pipeline
+from nnstreamer_tpu.obs import metrics as obs_metrics
+from nnstreamer_tpu.obs import profile as obs_profile
+from nnstreamer_tpu.runtime import placement
+from nnstreamer_tpu.runtime.parse import parse_launch
+from nnstreamer_tpu.runtime.placement import (
+    PlacementPlan,
+    Planner,
+    StagePlacement,
+    stage_key,
+)
+
+SRC = ("tensor_src num-buffers={n} dimensions=8 types=float32 "
+       "pattern=counter ")
+ADD = "tensor_transform mode=arithmetic option=add:1 "
+MUL = "tensor_transform mode=arithmetic option=mul:2 "
+SCALER = "tensor_filter framework=jax model=builtin://scaler?factor=2 "
+
+# 3 device stages over 2 queues: two fused segments + one singleton
+MULTI = (SRC + f"! {ADD}! {MUL}! queue name=q0 max-size-buffers=16 "
+         f"! {ADD}! {SCALER}! queue name=q1 max-size-buffers=16 "
+         f"! {SCALER}! tensor_sink name=out max-stored=1")
+
+
+def line(n=80):
+    return MULTI.format(n=n)
+
+
+def run_placed(launch, store_dir=None, place="auto", n=80):
+    pipe = parse_launch(launch.format(n=n) if "{n}" in launch else launch,
+                        place=place)
+    pipe.run(timeout=60)
+    return pipe
+
+
+def make_artifact(store_dir, n=120):
+    """One calibrated run that persists an artifact into the store (the
+    ``store`` fixture has already pointed NNS_PROFILE_STORE here)."""
+    pipe = run_placed(line(n))
+    assert os.listdir(store_dir), "calibration did not persist"
+    return pipe
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    root = str(tmp_path / "profiles")
+    monkeypatch.setenv(obs_profile.STORE_ENV, root)
+    yield root
+
+
+# ---------------------------------------------------------------------------
+# planner
+# ---------------------------------------------------------------------------
+
+class TestPlanner:
+    def test_heuristic_plan_without_store(self, monkeypatch):
+        monkeypatch.delenv(obs_profile.STORE_ENV, raising=False)
+        plan = Planner().plan(parse_launch(line()))
+        assert plan.source == "heuristic"
+        assert len(plan.stages) == 3
+        # stages spread across devices (conftest farm has 8)
+        assert len({s.device for s in plan.stages}) == 3
+        assert plan.queues == {}  # no profile -> user depths stand
+
+    def test_determinism_same_store_same_plan(self, store):
+        make_artifact(store)
+        a = Planner().plan(parse_launch(line()))
+        b = Planner().plan(parse_launch(line()))
+        assert a.source == "profile"
+        assert a.to_dict() == b.to_dict()
+
+    def test_assignment_is_exact_optimum(self):
+        """Exact search on costs [4,2,2,1] over 2 devices: the optimum
+        pairs the heavy stage with the lightest ({4,1}|{2,2} -> max 5);
+        naive round-robin would stack 4+2=6. A planner change that loses
+        optimality fails loudly here."""
+        stages = [StagePlacement(k, [k], 0, c, c, "profile")
+                  for k, c in zip("abcd", (4.0, 2.0, 2.0, 1.0))]
+        load = Planner(devices=[None, None])._assign(stages, 2)
+        assert max(load) == pytest.approx(5.0)
+        rr_load = [4.0 + 2.0, 2.0 + 1.0]  # [0,1,0,1]
+        assert max(load) < max(rr_load)
+        # deterministic: repeated assignment is identical
+        again = [StagePlacement(k, [k], 0, c, c, "profile")
+                 for k, c in zip("abcd", (4.0, 2.0, 2.0, 1.0))]
+        Planner(devices=[None, None])._assign(again, 2)
+        assert [s.device for s in again] == [s.device for s in stages]
+
+    def test_memory_cap_constrains_coresidence(self):
+        """Opt-in max_stages_per_device: a dominant stage alone would be
+        latency-optimal, but the cap=2 bound over 4 stages / 2 devices
+        forbids 3 co-resident stages."""
+        stages = [StagePlacement(k, [k], 0, c, c, "profile")
+                  for k, c in zip("abcd", (10.0, 1.0, 1.0, 1.0))]
+        Planner(devices=[None, None])._assign(stages, 2)
+        counts = [sum(1 for s in stages if s.device == d) for d in (0, 1)]
+        assert sorted(counts) == [1, 3]  # uncapped: heavy isolated
+        capped = [StagePlacement(k, [k], 0, c, c, "profile")
+                  for k, c in zip("abcd", (10.0, 1.0, 1.0, 1.0))]
+        Planner(devices=[None, None],
+                max_stages_per_device=2)._assign(capped, 2)
+        counts = [sum(1 for s in capped if s.device == d) for d in (0, 1)]
+        assert sorted(counts) == [2, 2]
+
+    def test_queue_depth_rule(self, store):
+        make_artifact(store)
+        plan = Planner().plan(parse_launch(line()))
+        assert plan.queues, "profiled queues must be tuned"
+        for q in plan.queues.values():
+            assert (placement.MIN_QUEUE_DEPTH <= q["depth"]
+                    <= placement.MAX_QUEUE_DEPTH)
+
+    def test_plan_serialization_round_trip(self, store):
+        make_artifact(store)
+        plan = Planner().plan(parse_launch(line()))
+        d = json.loads(json.dumps(plan.to_dict()))
+        back = PlacementPlan.from_dict(d)
+        assert back.to_dict() == plan.to_dict()
+        with pytest.raises(ValueError):
+            PlacementPlan.from_dict({"kind": "something-else"})
+
+
+# ---------------------------------------------------------------------------
+# runtime application
+# ---------------------------------------------------------------------------
+
+class TestApply:
+    def test_auto_assigns_segment_devices_and_queue_depths(self, store):
+        pipe = make_artifact(store)
+        # segments carry planner devices, not the jax default
+        segs = pipe.fused_segments
+        assert segs and all(s.device is not None for s in segs)
+        plan = pipe.placement_plan
+        for canon, q in plan.queues.items():
+            el = next(e for e in pipe.elements.values()
+                      if obs_profile.canonical_base(e) == canon)
+            assert el.stats["capacity"] == q["depth"]
+            assert el.stats["retuned"] >= 1
+
+    def test_singleton_filter_gets_backend_pin(self, store):
+        make_artifact(store)
+        pipe = parse_launch(line(), place="auto")
+        pipe.play()
+        try:
+            pipe.wait(timeout=60)
+            plan = pipe.placement_plan
+            singleton = next(s for s in plan.stages
+                             if len(s.elements) == 1)
+            el = next(e for e in pipe.elements.values()
+                      if obs_profile.canonical_base(e)
+                      == singleton.elements[0])
+            assert el._placement_device_index == singleton.device
+            # the opened backend runs ON the planned chip (stop()
+            # releases it, so inspect before teardown)
+            dev = el.backend_device
+            assert dev is not None and dev.id == singleton.device
+        finally:
+            pipe.stop()
+
+    def test_explicit_plan_applies_verbatim(self):
+        probe = parse_launch(line())
+        plan = Planner().plan(probe)
+        for st in plan.stages:
+            st.device = 3
+        pipe = parse_launch(line(), place=plan)
+        pipe.run(timeout=60)
+        assert pipe.placement_plan.source == "explicit"
+        for seg in pipe.fused_segments:
+            assert seg.device is not None and seg.device.id == 3
+
+    def test_place_off_and_kill_switch(self, monkeypatch):
+        pipe = parse_launch(line())
+        pipe.run(timeout=60)
+        assert pipe.placement_plan is None
+        assert all(s.device is None for s in pipe.fused_segments)
+        monkeypatch.setenv("NNS_NO_PLACE", "1")
+        pipe = parse_launch(line(), place="auto")
+        assert pipe.place is None
+
+    def test_byte_parity_auto_vs_place_false(self):
+        """Representative multi-stage pipeline: identical sink bytes and
+        event order with and without auto placement."""
+        def probed(place):
+            pipe = parse_launch(line(n=24), place=place)
+            recs = []
+            sink = pipe.get("out")
+            orig_render = type(sink).render
+            orig_hse = type(sink).handle_sink_event
+
+            def render(buf):
+                recs.append(("buf", tuple(
+                    np.ascontiguousarray(t).tobytes()
+                    for t in buf.as_numpy().tensors)))
+                orig_render(sink, buf)
+
+            def hse(pad, event):
+                recs.append(("event", event.type.name))
+                orig_hse(sink, pad, event)
+
+            sink.render = render
+            sink.handle_sink_event = hse
+            pipe.run(timeout=60)
+            return recs
+
+        assert probed(None) == probed("auto")
+
+
+# ---------------------------------------------------------------------------
+# invalidation / restart / calibration
+# ---------------------------------------------------------------------------
+
+class TestReplan:
+    def test_fusion_invalidate_marks_plan_dirty_and_replans(self, store):
+        pipe = make_artifact(store)
+        state = pipe._placement_state
+        before = state.snapshot()["replans"]
+        seg = pipe.fused_segments[0]
+        seg.invalidate()  # the hot-swap / caps-event path
+        assert state._dirty
+        state.refresh_if_dirty()
+        snap = state.snapshot()
+        assert snap["replans"] == before + 1
+        assert not state._dirty
+        # devices re-applied, no stale assignment
+        assert all(s.device is not None for s in pipe.fused_segments)
+
+    def test_restart_replans_from_scratch(self, store):
+        pipe = make_artifact(store)
+        state1 = pipe._placement_state
+        pipe.play()  # supervised-restart path: stop() already ran
+        try:
+            state2 = pipe._placement_state
+            assert state2 is not state1
+            assert all(s.device is not None for s in pipe.fused_segments)
+            assert pipe.placement_plan.source == "profile"
+        finally:
+            pipe.stop()
+
+    def test_hot_swap_triggers_replan_on_rebuild(self, store):
+        """commit_model invalidates the segment; the NEXT build must
+        refresh the plan before tracing (no stale assignment)."""
+        pipe = make_artifact(store)
+        state = pipe._placement_state
+        before = state.snapshot()["replans"]
+        seg = next(s for s in pipe.fused_segments
+                   if any(e.ELEMENT_NAME == "tensor_filter"
+                          for e in s.elements))
+        filt = next(e for e in seg.elements
+                    if e.ELEMENT_NAME == "tensor_filter")
+        filt._invalidate_fused()  # what commit_model/reload_model call
+        assert seg._call is None
+        seg._build()  # rebuild path runs refresh_if_dirty first
+        assert state.snapshot()["replans"] == before + 1
+
+    def test_calibration_persists_artifact_and_closes_window(self, store):
+        pipe = run_placed(line(120))
+        assert not obs_profile.ACTIVE, "calibration leaked recording"
+        assert os.listdir(store)
+        snap = pipe._placement_state.snapshot()
+        assert snap["source"] == "profile" and not snap["calibrating"]
+
+    def test_short_run_closes_window_at_stop(self, store):
+        # too few buffers to finish calibrating: stop() must balance the
+        # recording refcount anyway
+        run_placed(line(6))
+        assert not obs_profile.ACTIVE
+
+    def test_second_run_skips_calibration(self, store):
+        run_placed(line(120))
+        t0 = time.monotonic()
+        pipe = run_placed(line(24))
+        assert time.monotonic() - t0 < 30
+        assert pipe.placement_plan.source == "profile"
+
+
+# ---------------------------------------------------------------------------
+# queue retune mechanics
+# ---------------------------------------------------------------------------
+
+class TestQueueRetune:
+    def test_set_capacity_counts_and_applies(self):
+        from nnstreamer_tpu.runtime.queue import QueueElement
+
+        q = QueueElement(name="rq", max_size_buffers=4)
+        q.set_capacity(8)
+        assert q.stats["capacity"] == 8 and q.stats["retuned"] == 1
+        q.set_capacity(8)  # no-op: unchanged depth is not a retune
+        assert q.stats["retuned"] == 1
+
+    def test_raise_unblocks_parked_producer(self):
+        """The pop-path race fix: a producer parked on a full bounded
+        channel must wake promptly when the planner raises the depth
+        (including to 0 = unbounded), not wait for a worker pop."""
+        from nnstreamer_tpu.core import Buffer
+        from nnstreamer_tpu.runtime.queue import _Channel
+
+        ch = _Channel(1, "no", name="t")
+        ch.put_buf(Buffer([np.zeros(1, np.float32)]))
+        unparked = threading.Event()
+
+        def producer():
+            ch.put_buf(Buffer([np.zeros(1, np.float32)]))
+            unparked.set()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert not unparked.is_set()
+        ch.set_capacity(0)  # unbounded — the old wait loop would never
+        # re-check capacity>0 and could only leave via a worker pop
+        assert unparked.wait(1.0)
+        t.join(1.0)
+
+
+# ---------------------------------------------------------------------------
+# lint: NNL014
+# ---------------------------------------------------------------------------
+
+class TestLintHint:
+    def test_nnl014_when_artifact_matches(self, store):
+        make_artifact(store)
+        diags = lint_pipeline(parse_launch(line()))
+        hits = [d for d in diags if d.rule == "NNL014"]
+        assert len(hits) == 1
+        assert hits[0].severity is Severity.INFO
+        assert "better plan is available" in hits[0].message
+
+    def test_nnl014_absent_when_placed_or_no_store(self, store, monkeypatch):
+        make_artifact(store)
+        diags = lint_pipeline(parse_launch(line(), place="auto"))
+        assert not [d for d in diags if d.rule == "NNL014"]
+        monkeypatch.delenv(obs_profile.STORE_ENV, raising=False)
+        diags = lint_pipeline(parse_launch(line()))
+        assert not [d for d in diags if d.rule == "NNL014"]
+
+    def test_nnl014_never_gates_strict(self, store, tmp_path):
+        from nnstreamer_tpu.analysis.cli import run_lint
+
+        make_artifact(store)
+        target = tmp_path / "placed.launch"
+        target.write_text(line())
+
+        class Args:
+            targets = [str(target)]
+            strict = True
+            as_json = False
+            rules = "NNL014"
+
+        assert run_lint(Args()) == 0
+
+
+# ---------------------------------------------------------------------------
+# obs surfaces
+# ---------------------------------------------------------------------------
+
+class TestObs:
+    def test_gauges_and_snapshot(self, store):
+        pipe = make_artifact(store)
+        text = obs_metrics.render()
+        assert "nns_placement_stage_device" in text
+        assert f'pipeline="{pipe.name}"' in text
+        snaps = placement.snapshot_all()
+        mine = [s for s in snaps if s["pipeline"] == pipe.name]
+        assert mine and mine[0]["stages"]
+
+    def test_render_top_placement_section(self, store):
+        pipe = make_artifact(store)
+        text = obs_profile.render_top(
+            obs_profile.snapshot(), [], placement=placement.snapshot_all())
+        assert "PLACEMENT" in text
+        assert pipe.name in text
+
+
+# ---------------------------------------------------------------------------
+# planner-assignment surfaces: make_pipeline + tensor_shard
+# ---------------------------------------------------------------------------
+
+class TestAssignmentSurfaces:
+    def test_mesh_from_assignment_validation(self):
+        from nnstreamer_tpu.parallel.pipeline import mesh_from_assignment
+
+        with pytest.raises(ValueError, match="reuses a device"):
+            mesh_from_assignment([0, 0], 2)
+        with pytest.raises(ValueError, match="out of range"):
+            mesh_from_assignment([0, 99], 2)
+        with pytest.raises(ValueError, match="stages"):
+            mesh_from_assignment([0], 2)
+        mesh = mesh_from_assignment([3, 1], 2)
+        assert [d.id for d in mesh.devices.flat] == [3, 1]
+
+    def test_make_pipeline_assignment_matches_hand_mesh(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from nnstreamer_tpu.parallel.pipeline import (
+            make_pipeline,
+            stack_stage_params,
+        )
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        rng = np.random.default_rng(0)
+        params = [jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))
+                  for _ in range(2)]
+        xs = jnp.asarray(rng.normal(size=(2, 3, 4)).astype(np.float32))
+        stacked = stack_stage_params(params)
+        hand = make_pipeline(stage_fn, 2,
+                             Mesh(np.array(jax.devices()[:2]), ("pp",)))
+        auto = make_pipeline(stage_fn, 2, assignment=[0, 1])
+        np.testing.assert_allclose(np.asarray(hand(stacked, xs)),
+                                   np.asarray(auto(stacked, xs)),
+                                   rtol=1e-5)
+        with pytest.raises(ValueError, match="exactly one"):
+            make_pipeline(stage_fn, 2)
+
+    def test_make_pipeline_accepts_placement_plan(self):
+        plan = PlacementPlan(stages=[
+            StagePlacement("a", ["a"], 1, 1.0, 1.0, "profile"),
+            StagePlacement("b", ["b"], 0, 1.0, 1.0, "profile")])
+        from nnstreamer_tpu.parallel.pipeline import mesh_from_assignment
+
+        mesh = mesh_from_assignment(plan, 2)
+        assert [d.id for d in mesh.devices.flat] == [1, 0]
+
+    def test_shard_weighted_scatter(self):
+        from nnstreamer_tpu.elements.shard import TensorShard
+
+        sh = TensorShard(name="s", weights="0.5,0.25,0.25")
+        picks = [sh._pick(3) for _ in range(8)]
+        assert picks.count(0) == 4 and picks.count(1) == 2
+        # planner override + uniform round-robin fallback when the
+        # weight arity no longer matches the linked branches
+        sh.set_branch_weights([0.9, 0.1])
+        picks = []
+        for i in range(3):
+            sh._seq = i  # chain() advances this per frame
+            picks.append(sh._pick(3))
+        assert picks == [0, 1, 2]
+        sh.set_branch_weights(None)
+        picks = []
+        for i in range(4):
+            sh._seq = i
+            picks.append(sh._pick(2))
+        assert picks == [0, 1, 0, 1]
+        with pytest.raises(Exception, match="weights"):
+            sh.set_branch_weights([1.0, -1.0])
+
+    def test_subset_planner_pins_singleton_by_global_index(self):
+        """A planner over a device SUBSET must pin singleton filters by
+        the global jax.devices() index (the backend's custom=device:N
+        address space), not its local index."""
+        import jax
+
+        from nnstreamer_tpu.runtime.placement import _apply, _global_index
+
+        assert _global_index(jax.devices()[3]) == 3
+        pipe = parse_launch(line())
+        planner = Planner(devices=jax.devices()[2:4])
+        plan = planner.plan(pipe)
+        singleton = next(s for s in plan.stages if len(s.elements) == 1)
+        _apply(pipe, plan, planner.devices)
+        el = next(e for e in pipe.elements.values()
+                  if obs_profile.canonical_base(e) == singleton.elements[0])
+        assert el._placement_device_index == singleton.device + 2
+
+    def test_shard_retune_mid_stream_is_tear_free(self):
+        """set_branch_weights from another thread publishes (weights,
+        credit) atomically — _pick must never see a length tear."""
+        from nnstreamer_tpu.elements.shard import TensorShard
+
+        sh = TensorShard(name="s")
+        stop = threading.Event()
+        errors = []
+
+        def toggler():
+            i = 0
+            while not stop.is_set():
+                sh.set_branch_weights(
+                    None if i % 2 else [0.5, 0.3, 0.2])
+                i += 1
+
+        t = threading.Thread(target=toggler, daemon=True)
+        t.start()
+        try:
+            for i in range(20000):
+                sh._seq = i
+                try:
+                    assert 0 <= sh._pick(3) < 3
+                except Exception as e:  # noqa: BLE001 - the regression
+                    errors.append(e)
+                    break
+        finally:
+            stop.set()
+            t.join(2.0)
+        assert not errors
+
+    def test_planner_emits_shard_weights_from_profile(self, store):
+        lineage = (
+            "tensor_src num-buffers=64 dimensions=8 types=float32 "
+            "pattern=counter ! tensor_shard name=s "
+            "s.src_0 ! tensor_transform mode=arithmetic option=add:1 "
+            "name=ba ! u.sink_0 "
+            "s.src_1 ! tensor_transform mode=arithmetic option=add:1 "
+            "name=bb ! u.sink_1 "
+            "tensor_unshard name=u ! tensor_sink name=out max-stored=1")
+        pipe = parse_launch(lineage)
+        obs_profile.start()
+        try:
+            pipe.run(timeout=60)
+        finally:
+            obs_profile.stop()
+        art = obs_profile.ProfileArtifact.capture(pipe)
+        obs_profile.reset()
+        plan = Planner().plan(parse_launch(lineage), artifact=art)
+        weights = plan.shard_weights.get("s")
+        assert weights is not None and len(weights) == 2
+        assert abs(sum(weights) - 1.0) < 1e-6

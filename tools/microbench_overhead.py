@@ -24,7 +24,11 @@ the JSON, not gated — turning tracing on is a deliberate trade. The
 continuous profiler (obs/profile.py) gets the same leg with the same
 <= 2% gate on its stopped fast path (queue/fusion/request hooks back to
 one module-global check after ``profile.stop()``); profiler-enabled
-overhead is reported alongside.
+overhead is reported alongside. The placement compiler
+(runtime/placement.py) gets a steady-state leg too: a fused device
+chain dispatching through an applied PlacementPlan must stay within 2%
+of the same chain with placement off (planning runs at play(), never
+per buffer).
 
 Usage:
   python tools/microbench_overhead.py [n_frames]      # full report
@@ -52,11 +56,12 @@ DEVICE_ELEM = "tensor_transform mode=arithmetic option=add:1"
 
 
 def measure(n_elems: int, n_bufs: int, elem: str = HOST_ELEM,
-            fuse: bool = True) -> float:
+            fuse: bool = True, place=None) -> float:
     chain = " ! ".join([elem] * n_elems)
     pipe = parse_launch(
         f"tensor_src num-buffers={n_bufs} dimensions=16 types=float32 "
-        f"! {chain} ! tensor_sink name=out max-stored=1", fuse=fuse)
+        f"! {chain} ! tensor_sink name=out max-stored=1", fuse=fuse,
+        place=place)
     t0 = time.perf_counter()
     pipe.run(timeout=300)
     return (time.perf_counter() - t0) / n_bufs
@@ -190,6 +195,47 @@ def profiler_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     }
 
 
+def placement_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
+    """Placement cost on an 8-element fused DEVICE chain: per-buffer
+    steady state with a plan applied vs ``place`` off, same min-of-pairs
+    discipline as the tracing/profiler legs (gate <= 2%).
+
+    The plan pins the chain's one fused segment explicitly (an applied
+    :class:`PlacementPlan` — no store, no calibration window), so the
+    leg isolates exactly what every placed buffer pays: the composed
+    jit lowered with ``in_shardings`` instead of default placement. The
+    planning itself runs once at play() — off the hot path by
+    construction — and calibration cost is a bounded one-time window,
+    reported in docs/placement.md rather than gated here.
+    """
+    import statistics
+
+    from nnstreamer_tpu.runtime.placement import Planner
+
+    probe = parse_launch(
+        "tensor_src num-buffers=1 dimensions=16 types=float32 ! "
+        + " ! ".join([DEVICE_ELEM] * 8) + " ! tensor_sink max-stored=1")
+    import jax
+
+    plan = Planner(devices=[jax.devices()[0]]).plan(probe)
+    measure(8, max(200, n_bufs // 4), DEVICE_ELEM)  # warmup
+    baselines, placeds = [], []
+    for _ in range(attempts):
+        baselines.append(measure(8, n_bufs, DEVICE_ELEM))
+        placeds.append(measure(8, n_bufs, DEVICE_ELEM, place=plan))
+    ratios = [p / b for b, p in zip(baselines, placeds)]
+    baseline = min(baselines)
+    return {
+        "n_frames": n_bufs,
+        "attempts": attempts,
+        "baseline_us_per_frame": baseline * 1e6,
+        "placed_us_per_frame": min(placeds) * 1e6,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "placed_overhead_frac": min(ratios) - 1.0,
+        "placed_overhead_frac_median": statistics.median(ratios) - 1.0,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("n_frames", nargs="?", type=int, default=4000)
@@ -216,8 +262,10 @@ def main() -> None:
                 best = dev
             if best["speedup_marginal"] >= 2.0:
                 break
+        placement = placement_overhead_report(n_bufs=1500, attempts=4)
         best["tracing_overhead"] = tracing
         best["profiler_overhead"] = profiling
+        best["placement_overhead"] = placement
         print(json.dumps(best, indent=2))
         ok = best["speedup_marginal"] >= 2.0
         print(f"smoke: fused marginal speedup {best['speedup_marginal']:.1f}x "
@@ -236,11 +284,19 @@ def main() -> None:
               f"{profiling['disabled_overhead_frac'] * 100:+.2f}% vs "
               f"baseline (gate <= 2%), enabled mode "
               f"{profiling['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
-        sys.exit(0 if ok and trc_ok and prof_ok else 1)
+        plc_ok = placement["placed_overhead_frac"] <= 0.02
+        verdict = ("OK" if plc_ok
+                   else "REGRESSION — placed dispatch costs more than "
+                        "default placement")
+        print(f"smoke: placement steady-state per-buffer "
+              f"{placement['placed_overhead_frac'] * 100:+.2f}% vs "
+              f"place-off fused chain (gate <= 2%) ({verdict})")
+        sys.exit(0 if ok and trc_ok and prof_ok and plc_ok else 1)
 
     n_bufs = args.n_frames
     report = {"n_frames": n_bufs, "host_chain": [], "device_chain": None,
-              "tracing_overhead": None, "profiler_overhead": None}
+              "tracing_overhead": None, "profiler_overhead": None,
+              "placement_overhead": None}
     # before any other measurement: the baseline leg requires a process
     # where tracing has never been enabled
     report["tracing_overhead"] = tracing_overhead_report(
@@ -261,6 +317,13 @@ def main() -> None:
           f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
           f"disabled {t['disabled_us_per_frame']:8.1f} "
           f"({t['disabled_overhead_frac'] * 100:+.2f}%, gate <= 2%)")
+    report["placement_overhead"] = placement_overhead_report(
+        n_bufs=min(n_bufs, 2000))
+    t = report["placement_overhead"]
+    print("— placement overhead (8-element fused device chain) —")
+    print(f"place off {t['baseline_us_per_frame']:8.1f} us/frame | "
+          f"placed {t['placed_us_per_frame']:8.1f} "
+          f"({t['placed_overhead_frac'] * 100:+.2f}%, gate <= 2%)")
     print("— host chains (tensor_debug): pure pad-hop cost —")
     prev = None
     for n in (1, 2, 4, 8, 16, 32):
