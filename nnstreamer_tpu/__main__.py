@@ -310,6 +310,40 @@ def _obs_profile(args) -> int:
     return 0
 
 
+def _obs_store(args) -> int:
+    """``obs store``: list the profile-artifact store, ``--prune N``
+    LRU-evicts down to the newest N artifacts (the GC ``ProfileStore``
+    applies automatically when ``NNS_PROFILE_STORE_MAX`` is set)."""
+    import os
+
+    from .obs import profile as obs_profile
+
+    root = args.root or os.environ.get(obs_profile.STORE_ENV, "").strip()
+    if not root:
+        print("error: no store — pass --root DIR or set "
+              f"{obs_profile.STORE_ENV}", file=sys.stderr)
+        return 2
+    if not os.path.isdir(root):
+        # an inspection verb must not conjure the directory a typo names
+        # (ProfileStore.__init__ creates its root for writers)
+        print(f"error: store directory '{root}' does not exist",
+              file=sys.stderr)
+        return 2
+    store = obs_profile.ProfileStore(root)
+    if args.prune:
+        removed = store.prune(args.prune)
+        print(f"pruned {len(removed)} artifact(s) from {root} "
+              f"(bound {args.prune})")
+        for p in removed:
+            print(f"  removed {p}")
+    entries = store.list()
+    print(f"{len(entries)} artifact(s) in {root}")
+    for e in entries:
+        print(f"  {e['path']}  topology={e.get('topology', '?')} "
+              f"model='{e.get('model_version', '')}'")
+    return 0
+
+
 def _obs_top(args) -> int:
     """``obs top``: one-shot (default) or ``--watch N`` refreshing text
     dashboard of per-element rates, queue waits/depths, fused quantiles,
@@ -317,23 +351,32 @@ def _obs_top(args) -> int:
     import time
 
     from .obs import profile as obs_profile
-    from .service import ControlClient
+    from .service import ControlClient, ServiceError
 
     def fetch() -> dict:
         if args.endpoint:
-            return ControlClient(args.endpoint).profile()
+            client = ControlClient(args.endpoint)
+            data = client.profile()
+            try:
+                data["memory"] = client.memory().get("memory")
+            except ServiceError:
+                data["memory"] = None  # pre-PR-10 serve process
+            return data
+        from .obs import memory as obs_memory
         from .obs import slo as obs_slo
         from .runtime import placement
 
         return {"profile": obs_profile.snapshot(),
                 "slo": obs_slo.status_all(),
-                "placement": placement.snapshot_all()}
+                "placement": placement.snapshot_all(),
+                "memory": obs_memory.snapshot()}
 
     while True:
         data = fetch()
         print(obs_profile.render_top(data.get("profile", {}),
                                      data.get("slo", []),
-                                     placement=data.get("placement")))
+                                     placement=data.get("placement"),
+                                     memory=data.get("memory")))
         if not args.watch:
             return 0
         try:
@@ -359,7 +402,12 @@ def _cmd_obs(args) -> int:
       a profile artifact (``--out``); ``--merge``/``--diff`` operate on
       saved artifacts;
     * ``obs slo`` — SLO status (burn rates, alerting) local or remote;
-    * ``obs top`` — one-shot/``--watch`` text dashboard.
+    * ``obs top`` — one-shot/``--watch`` text dashboard (incl. MEMORY);
+    * ``obs memory`` — device-memory accounting snapshot (stage byte
+      estimates, device watermarks, queue/serving bytes) local or
+      ``--endpoint``;
+    * ``obs store`` — list the profile-artifact store; ``--prune N``
+      LRU-evicts old artifacts.
     """
     from .service import ControlClient, ServiceError
 
@@ -374,13 +422,25 @@ def _cmd_obs(args) -> int:
         elif args.verb == "flight":
             if args.endpoint:
                 events = ControlClient(args.endpoint).flight(
-                    last=args.last, pipeline=args.pipeline)["events"]
+                    last=args.last, pipeline=args.pipeline,
+                    category=args.category)["events"]
             else:
                 from .obs import flight as obs_flight
 
                 events = obs_flight.dump(last=args.last,
-                                         pipeline=args.pipeline)
+                                         pipeline=args.pipeline,
+                                         category=args.category)
             print(json.dumps(events, indent=2, default=str))
+        elif args.verb == "memory":
+            if args.endpoint:
+                snap = ControlClient(args.endpoint).memory()["memory"]
+            else:
+                from .obs import memory as obs_memory
+
+                snap = obs_memory.snapshot()
+            print(json.dumps(snap, indent=2, default=str))
+        elif args.verb == "store":
+            return _obs_store(args)
         elif args.verb == "profile":
             return _obs_profile(args)
         elif args.verb == "slo":
@@ -527,16 +587,26 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("obs", help="observability: /metrics scrape, "
                                    "flight-recorder dump, span export, "
-                                   "profiler/SLO/top "
+                                   "profiler/SLO/top, memory accounting, "
+                                   "artifact-store GC "
                                    "(see docs/observability.md)")
     p.add_argument("verb", choices=["metrics", "flight", "trace",
-                                    "profile", "slo", "top"])
+                                    "profile", "slo", "top", "memory",
+                                    "store"])
     p.add_argument("--endpoint", default=None,
                    help="serve control endpoint URL (omit = this process)")
     p.add_argument("--last", type=int, default=64,
                    help="flight: newest N events")
     p.add_argument("--pipeline", default=None,
                    help="flight: only events tagged with this pipeline")
+    p.add_argument("--category", default=None,
+                   help="flight: only events of this kind (memory, slo, "
+                        "pipeline, serving, ...)")
+    p.add_argument("--root", default=None,
+                   help="store: artifact directory (default "
+                        "NNS_PROFILE_STORE)")
+    p.add_argument("--prune", type=int, default=0, metavar="N",
+                   help="store: LRU-evict down to the newest N artifacts")
     p.add_argument("--out", default=None,
                    help="trace/profile: output JSON path")
     p.add_argument("--launch", default=None,

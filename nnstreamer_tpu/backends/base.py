@@ -127,6 +127,14 @@ class FilterBackend:
         opt in."""
         return None
 
+    def memory_analysis(self, inputs: List[Any]):
+        """The compiled executable for THIS backend's invoke at the
+        given input signature, for the memory accounting plane
+        (``obs/memory.py`` pulls ``.memory_analysis()`` channels off
+        it). None when the backend has no XLA executable to introspect
+        (host interpreters, native programs) — the default."""
+        return None
+
     def describe(self) -> str:
         model = self.props.model if self.props else "?"
         return f"{self.NAME}({model})"

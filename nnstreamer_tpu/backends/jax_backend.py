@@ -436,6 +436,22 @@ class JaxBackend(FilterBackend):
                 self._jit = jax.jit(lambda *xs: _as_tuple(self._fn(*xs)))
         return self._jit
 
+    def memory_analysis(self, inputs):
+        """AOT-compile the jitted invoke for this signature and hand the
+        executable to the memory accountant. jax's jit cache already
+        holds a compiled program for the signature after the first
+        invoke; ``lower().compile()`` re-derives it once — acceptable on
+        the accounting path (gated behind obs_memory.ACTIVE, once per
+        backend open), never on the per-frame path."""
+        if self._fn is None or getattr(self._fn, "host_native", False):
+            return None
+        if self._mesh is not None:
+            return None  # GSPMD footprint is per-shard; skip for now
+        try:
+            return self._jitted().lower(*inputs).compile()
+        except Exception:  # noqa: BLE001 - unloweredable signature
+            return None
+
     def compile_cache_info(self) -> dict:
         """Shape-bucketing introspection (SURVEY §7 'hard parts': flexible
         streams recompile per signature; this makes that visible)."""

@@ -41,6 +41,7 @@ from ..core import (
     tensors_info_from_caps,
 )
 from ..analysis.sanitizer import named_lock
+from ..obs import memory as obs_memory
 from ..registry.config import get_config
 from ..registry.elements import register_element
 from ..registry.subplugin import SubpluginKind, names as subplugin_names
@@ -196,6 +197,11 @@ class TensorFilter(TransformElement):
         # (runtime/placement.py): consumed at backend open; an explicit
         # user custom=device:N / mesh: always wins
         self._placement_device_index: Optional[int] = None
+        # memory accounting (obs/memory.py): armed at backend open while
+        # accounting is on; the first invoke then records the backend's
+        # compiled memory_analysis() channels. One short-circuit check
+        # per invoke when accounting is off.
+        self._mem_pending = False  # guarded-by: _backend_lock
         self._validate_model_ref()
 
     def set_placement_device(self, index: Optional[int]) -> None:
@@ -320,6 +326,32 @@ class TensorFilter(TransformElement):
         self.backend = acquire_backend(
             fw, fprops, self.props["shared_tensor_filter_key"]
         )
+        if obs_memory.ACTIVE:
+            self._record_memory_static()
+
+    def _record_memory_static(self) -> None:
+        """Static byte estimate for this filter as a singleton stage:
+        the model's param footprint now, the compiled channels on the
+        first invoke (``_mem_pending``). Names match the profiler series
+        so placement and profile artifacts line up."""
+        from ..obs import profile as obs_profile
+
+        nb = obs_memory.backend_param_nbytes(self.backend)
+        obs_memory.record_stage(obs_profile.series_name(self), "filter",
+                                param_bytes=nb)
+        if self.props["model"]:
+            obs_memory.record_model_params(self.props["model"], nb)
+        self._mem_pending = True
+
+    def _record_memory_compiled(self, inputs) -> None:
+        analyze = getattr(self.backend, "memory_analysis", None)
+        compiled = analyze(inputs) if analyze is not None else None
+        if compiled is not None:
+            from ..obs import profile as obs_profile
+
+            obs_memory.record_compiled(
+                obs_profile.series_name(self), "filter", compiled,
+                param_bytes=obs_memory.backend_param_nbytes(self.backend))
 
     def _ensure_backend(self) -> FilterBackend:
         """Reopen a suspended framework transparently (reference suspend/
@@ -546,8 +578,29 @@ class TensorFilter(TransformElement):
             # clock starts AFTER a possible suspend-resume reload — a model
             # reopen must not read as inference latency
             t0 = clock_now()
-            outputs = backend.invoke(model_inputs)
+            try:
+                outputs = backend.invoke(model_inputs)
+            except Exception as e:
+                # an OOM-shaped failure lands in the flight ring with
+                # THIS stage's name before the error path loses context
+                # (the canonical series name, so the event joins the
+                # stage's static estimate in a postmortem)
+                if obs_memory.looks_like_oom(e):
+                    from ..obs import profile as obs_profile
+
+                    pipe = getattr(self, "pipeline", None)
+                    obs_memory.record_alloc_failure(
+                        obs_profile.series_name(self), e,
+                        pipeline=pipe.name if pipe is not None else None)
+                raise
             self._last_invoke_ts = clock_now()
+            record_mem = obs_memory.ACTIVE and self._mem_pending
+            if record_mem:
+                self._mem_pending = False
+        if record_mem:
+            # outside the invoke lock: the AOT lowering is slow and must
+            # not stall the suspend watchdog or a concurrent hot swap
+            self._record_memory_compiled(model_inputs)
         # dispatch channel gets ONLY the host-side call time, even on
         # sampled frames — blocking time goes to the device channel
         self.stats.record(self._last_invoke_ts - t0)
@@ -650,6 +703,11 @@ class TensorFilter(TransformElement):
         # until commit, so a failed warmup can't poison a share-key entry
         if self._model_view_info is not None:
             backend.set_input_info(self._model_view_info)
+        # registry-slot footprint (obs/memory.py): what THIS version's
+        # params weigh, recorded at prepare time — the swap/canary
+        # control plane sees a version's memory cost before the flip
+        obs_memory.record_model_params(
+            new_model, obs_memory.backend_param_nbytes(backend))
         return backend
 
     def commit_model(self, backend: FilterBackend,

@@ -43,7 +43,8 @@ cross-subsystem. Three pieces, one contract (near-zero cost when idle):
 See docs/observability.md for the span model, propagation rules,
 profiling/SLO semantics, and the metric name catalog.
 """
-from . import context, flight, metrics, profile, slo  # noqa: F401
+from . import context, flight, memory, metrics, profile, slo  # noqa: F401
+from .memory import AdmissionGuard, MemoryAccountant  # noqa: F401
 from .context import (  # noqa: F401
     Span,
     TraceContext,
@@ -76,10 +77,12 @@ from .profile import (  # noqa: F401
 from .slo import SloEngine, SLObjective  # noqa: F401
 
 __all__ = [
+    "AdmissionGuard",
     "Counter",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "MemoryAccountant",
     "MetricError",
     "ProfileArtifact",
     "ProfileStore",
@@ -98,6 +101,7 @@ __all__ = [
     "export_chrome_trace",
     "finished_spans",
     "flight",
+    "memory",
     "metrics",
     "profile",
     "record_span",

@@ -54,14 +54,20 @@ class FlightRecorder:
         return self._last + 1
 
     def dump(self, last: Optional[int] = None,
-             pipeline: Optional[str] = None) -> List[dict]:
+             pipeline: Optional[str] = None,
+             category: Optional[str] = None) -> List[dict]:
         """The retained events, oldest first; ``last`` keeps only the
-        newest N, ``pipeline`` filters on the event's pipeline tag."""
+        newest N, ``pipeline`` filters on the event's pipeline tag, and
+        ``category`` on the event kind (``memory``, ``slo``,
+        ``pipeline``, ``serving``, ... — mirrors the pipeline filter, so
+        a postmortem can pull one subsystem's channel)."""
         entries = sorted((s for s in list(self._slots) if s is not None),
                          key=lambda s: s[0])
         out = []
         for seq, t, kind, name, data, pipe in entries:
             if pipeline is not None and pipe != pipeline:
+                continue
+            if category is not None and kind != category:
                 continue
             out.append({"seq": seq, "time": t, "kind": kind, "name": name,
                         "data": data, "pipeline": pipe})

@@ -313,6 +313,19 @@ def track_pipeline(pipeline) -> None:
     _tracked_pipelines.add(pipeline)
 
 
+def untrack_pipeline(pipeline) -> None:
+    """Explicit unregister sweep (``Pipeline.stop()`` / service retire):
+    the tracked set is weak, but weakness only helps once GC happens to
+    run — until then a stopped pipeline's stale ``nns_fused_*`` rows
+    keep rendering at every scrape. A replay re-tracks via
+    ``fusion.install``."""
+    _tracked_pipelines.discard(pipeline)
+
+
+def untrack_manager(manager) -> None:
+    _tracked_managers.discard(manager)
+
+
 def pools_snapshot() -> Dict[str, dict]:
     """{pool_name: ReplicaPool.snapshot()} over every live pool — the
     fabric half of ``serving.metrics_snapshot()`` (per-replica in-flight,
@@ -349,6 +362,9 @@ def _collect_serving(reg: Registry) -> None:
                         "requests shed: queue depth", ("scheduler",))
     shedd = reg.counter("nns_serving_shed_deadline_total",
                         "requests shed: deadline budget", ("scheduler",))
+    shedm = reg.counter("nns_serving_shed_memory_total",
+                        "requests shed: projected memory watermark",
+                        ("scheduler",))
     batches = reg.counter("nns_serving_batches_total",
                           "device batches executed", ("scheduler",))
     depth = reg.gauge("nns_serving_queue_depth",
@@ -362,8 +378,8 @@ def _collect_serving(reg: Registry) -> None:
                     ("scheduler",))
     # snapshot mirrors: repopulated from live schedulers each scrape, so
     # a garbage-collected scheduler's series disappears with it
-    for inst in (subm, comp, fail, shedf, shedd, batches, depth, occ,
-                 wait, p99):
+    for inst in (subm, comp, fail, shedf, shedd, shedm, batches, depth,
+                 occ, wait, p99):
         inst.clear()
     for name, sched in serving_metrics.iter_schedulers():
         try:
@@ -375,6 +391,7 @@ def _collect_serving(reg: Registry) -> None:
         fail.set_total(snap.get("failed", 0), scheduler=name)
         shedf.set_total(snap.get("shed_queue_full", 0), scheduler=name)
         shedd.set_total(snap.get("shed_deadline", 0), scheduler=name)
+        shedm.set_total(snap.get("shed_memory", 0), scheduler=name)
         batches.set_total(snap.get("batches", 0), scheduler=name)
         depth.set(snap.get("queue_depth", 0), scheduler=name)
         occ.set(snap.get("batch_occupancy", 0.0), scheduler=name)

@@ -628,16 +628,25 @@ class ProfileArtifact:
     """A persisted profile: per-entry digests keyed by
     (topology hash, caps, model version). ``load``/``merge``/``diff``
     are the APIs the placement planner and AOT cache consume — replicas
-    of the same topology merge exactly (digest merge is lossless)."""
+    of the same topology merge exactly (digest merge is lossless).
+
+    The ``memory`` section (PR 10, :mod:`.memory`) carries per-stage
+    static byte estimates under the SAME stage keys the duration scopes
+    use; its merge semantics are **max-watermark** per field — a
+    footprint is a high-water mark, so merged replicas report the worst
+    observed footprint, never a sum."""
 
     def __init__(self, key: dict, entries: Dict[str, Dict[str, dict]],
-                 pipeline: str = "", created: Optional[float] = None):
+                 pipeline: str = "", created: Optional[float] = None,
+                 memory: Optional[Dict[str, dict]] = None):
         self.key = {"topology": str(key.get("topology", "")),
                     "caps": str(key.get("caps", "")),
                     "model_version": str(key.get("model_version", ""))}
         # entries: {scope: {name: {"count": int, "total_s": float,
         #                          "digest": QuantileDigest}}}
         self.entries = entries
+        # memory: {stage: {"kind": str, <byte fields>, "total_bytes": int}}
+        self.memory: Dict[str, dict] = dict(memory or {})
         self.pipeline = pipeline
         self.created = time.time() if created is None else created
 
@@ -664,11 +673,19 @@ class ProfileArtifact:
                     "total_s": s.total_s,
                     "digest": s.digest.copy(),
                 }
+        # byte estimates ride the same key: the memory accountant names
+        # stages exactly like the profiler series, so the prefix strip
+        # lines fused/filter footprints up with the duration entries
+        from . import memory as obs_memory
+
+        mem = {name[len(prefix):]: cell
+               for name, cell in obs_memory.accountant()
+               .stages(prefix).items()}
         return cls(
             {"topology": topology_hash(pipeline),
              "caps": _negotiated_caps(pipeline) if caps is None else caps,
              "model_version": model_version},
-            entries, pipeline=pipeline.name)
+            entries, pipeline=pipeline.name, memory=mem)
 
     # -- persistence ---------------------------------------------------------
     def to_dict(self) -> dict:
@@ -685,6 +702,8 @@ class ProfileArtifact:
                         for name, e in sorted(names.items())}
                 for scope, names in sorted(self.entries.items())
             },
+            "memory": {name: dict(cell)
+                       for name, cell in sorted(self.memory.items())},
         }
 
     def save(self, path: str) -> str:
@@ -708,7 +727,9 @@ class ProfileArtifact:
             for scope, names in d.get("entries", {}).items()
         }
         return cls(d["key"], entries, pipeline=d.get("pipeline", ""),
-                   created=d.get("created"))
+                   created=d.get("created"),
+                   memory={str(n): dict(c)
+                           for n, c in (d.get("memory") or {}).items()})
 
     @classmethod
     def load(cls, path: str) -> "ProfileArtifact":
@@ -736,6 +757,27 @@ class ProfileArtifact:
                     cell["count"] += e["count"]
                     cell["total_s"] += e["total_s"]
                     cell["digest"].merge(e["digest"])
+        # memory is max-watermark per field: two replicas' footprints
+        # merge to the worst observed, never a sum. total_bytes is then
+        # RECOMPUTED from the merged field maxes — maxing it
+        # independently would understate a cell whose replicas peaked on
+        # different fields (and the planner reads total_bytes)
+        from . import memory as obs_memory
+
+        for name, cell in other.memory.items():
+            mine = self.memory.get(name)
+            if mine is None:
+                self.memory[name] = dict(cell)
+                continue
+            for field, value in cell.items():
+                if field == "kind":
+                    mine.setdefault("kind", value)
+                elif isinstance(value, (int, float)):
+                    if value > mine.get(field, 0):
+                        mine[field] = value
+            if any(f in mine for f in obs_memory.FIELDS):
+                mine["total_bytes"] = sum(int(mine.get(f, 0) or 0)
+                                          for f in obs_memory.FIELDS)
         self.created = max(self.created, other.created)
         return self
 
@@ -770,8 +812,9 @@ class ProfileArtifact:
 
     def summary(self) -> dict:
         """{scope: {name: {count, p50_ms, p99_ms, total_s}}} — the
-        human/bench-facing attribution table."""
-        return {
+        human/bench-facing attribution table (plus the ``memory``
+        byte-estimate section when captured)."""
+        out = {
             scope: {name: {"count": e["count"],
                            "total_s": round(e["total_s"], 6),
                            "p50_ms": round(e["digest"].quantile(0.5) * 1e3, 4),
@@ -780,6 +823,10 @@ class ProfileArtifact:
                     for name, e in sorted(names.items())}
             for scope, names in sorted(self.entries.items())
         }
+        if self.memory:
+            out["memory"] = {name: dict(cell)
+                             for name, cell in sorted(self.memory.items())}
+        return out
 
 
 #: env var naming the default on-disk ProfileStore directory — the
@@ -787,6 +834,10 @@ class ProfileArtifact:
 #: consult it when no explicit store is handed in; unset = no default
 #: store (plan falls back to calibration/heuristics)
 STORE_ENV = "NNS_PROFILE_STORE"
+
+#: env var bounding the default store's artifact count (LRU prune on
+#: save); unset/0 = unbounded, the pre-PR-10 behavior
+STORE_MAX_ENV = "NNS_PROFILE_STORE_MAX"
 
 
 def default_store() -> Optional["ProfileStore"]:
@@ -796,17 +847,30 @@ def default_store() -> Optional["ProfileStore"]:
     root = os.environ.get(STORE_ENV, "").strip()
     if not root:
         return None
-    return ProfileStore(root)
+    raw_max = os.environ.get(STORE_MAX_ENV, "").strip()
+    try:
+        max_artifacts = int(raw_max) if raw_max else None
+    except ValueError:
+        max_artifacts = None
+    return ProfileStore(root, max_artifacts=max_artifacts)
 
 
 class ProfileStore:
     """On-disk artifact store keyed by (topology, caps, model version).
     ``save(merge=True)`` folds a new capture into the existing artifact
     for the same key, so profiles accumulate across restarts — the
-    persistence the placement planner reads at plan time."""
+    persistence the placement planner reads at plan time.
 
-    def __init__(self, root: str):
+    ``max_artifacts`` bounds the store: without it one artifact per
+    (topology, caps, model version) accumulates FOREVER across restarts
+    — every experiment's one-off launch line leaves a file. When set,
+    ``save()`` LRU-prunes (oldest mtime first) down to the bound, and
+    the just-saved key always survives (its mtime is newest). ``python
+    -m nnstreamer_tpu obs store --prune N`` prunes on demand."""
+
+    def __init__(self, root: str, max_artifacts: Optional[int] = None):
         self.root = root
+        self.max_artifacts = max_artifacts
         os.makedirs(root, exist_ok=True)
 
     @staticmethod
@@ -827,7 +891,42 @@ class ProfileStore:
             existing = ProfileArtifact.load(path)
             if existing.key == artifact.key:
                 artifact = existing.merge(artifact)
-        return artifact.save(path)
+        out = artifact.save(path)
+        if self.max_artifacts:
+            self.prune(self.max_artifacts)
+        return out
+
+    def _artifact_paths(self) -> List[str]:
+        return [os.path.join(self.root, f)
+                for f in sorted(os.listdir(self.root))
+                if f.startswith("profile-") and f.endswith(".json")]
+
+    def prune(self, max_artifacts: Optional[int] = None) -> List[str]:
+        """LRU-evict artifacts beyond the bound (oldest mtime first —
+        ``save()`` rewrites its key's file, so actively-merged keys stay
+        newest and cold one-off keys age out). Returns removed paths."""
+        bound = max_artifacts if max_artifacts is not None \
+            else self.max_artifacts
+        if not bound or bound < 1:
+            return []
+        paths = self._artifact_paths()
+        if len(paths) <= bound:
+            return []
+
+        def mtime(p: str) -> float:
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0
+        victims = sorted(paths, key=lambda p: (mtime(p), p))[:-bound]
+        removed = []
+        for p in victims:
+            try:
+                os.remove(p)
+                removed.append(p)
+            except OSError:
+                continue
+        return removed
 
     def load(self, key: dict) -> Optional[ProfileArtifact]:
         path = self.path_for(key)
@@ -852,9 +951,12 @@ class ProfileStore:
 # -- text dashboard (obs top) -------------------------------------------------
 
 def render_top(profile_snap: dict, slo_status: List[dict],
-               placement: Optional[List[dict]] = None) -> str:
+               placement: Optional[List[dict]] = None,
+               memory: Optional[dict] = None) -> str:
     """The ``obs top`` one-shot/watch dashboard: per-element rates,
     queue waits + depths, fused quantiles, request series, SLO burn,
+    a MEMORY section (device watermarks, stage byte estimates, queue
+    occupancy — :mod:`.memory`) when a memory snapshot is supplied,
     and — when a placement plan is installed — per-stage device
     assignment + balance (runtime/placement.py)."""
     lines = [f"nns obs top — profiling "
@@ -904,6 +1006,10 @@ def render_top(profile_snap: dict, slo_status: List[dict],
             lines.append(
                 f"  {name:<40} {s['p50_ms']:>9.2f} {s['p99_ms']:>9.2f} "
                 f"{s['max_ms']:>9.2f} {s['count']:>8d} {s['errors']:>6d}")
+    if memory:
+        from . import memory as obs_memory
+
+        lines.extend(obs_memory.render_section(memory))
     if slo_status:
         lines.append("")
         lines.append("SLO (burn = bad-fraction / error budget)")

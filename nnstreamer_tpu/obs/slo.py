@@ -52,7 +52,7 @@ from . import flight as obs_flight
 from . import metrics as obs_metrics
 from . import profile as obs_profile
 
-_KINDS = ("latency", "error_rate", "availability")
+_KINDS = ("latency", "error_rate", "availability", "memory")
 
 # default multi-window pairs (short_s, long_s, burn_threshold), sized to
 # fit the profiler's default 900 s series horizon; production configs
@@ -68,10 +68,13 @@ class SLObjective:
     """One declarative objective over a request series."""
 
     name: str
-    kind: str = "latency"            # latency | error_rate | availability
+    kind: str = "latency"     # latency | error_rate | availability | memory
     series: str = ""                 # e.g. "serving:svc" / "fabric:pool"
     target: float = 0.99             # required good fraction
-    threshold_s: float = 0.1         # latency kind: good = sample <= this
+    threshold_s: float = 0.1         # latency: good = sample <= this;
+    #                                  memory: max used-fraction (headroom
+    #                                  = 1 - threshold; the engine samples
+    #                                  worst-device used/budget each tick)
     windows: Tuple[Tuple[float, float, float], ...] = DEFAULT_WINDOWS
     service: str = ""                # Service to flip DEGRADED on breach
     description: str = ""
@@ -86,6 +89,13 @@ class SLObjective:
                 raise ValueError("availability objectives require service=")
             if not self.series:
                 self.series = f"availability:{self.service}"
+        elif self.kind == "memory":
+            if not 0.0 < self.threshold_s <= 1.0:
+                raise ValueError(
+                    f"memory objectives need threshold_s in (0, 1] "
+                    f"(max used fraction), got {self.threshold_s}")
+            if not self.series:
+                self.series = "memory:devices"
         elif not self.series:
             raise ValueError(f"objective '{self.name}' needs a series=")
         if not self.windows:
@@ -191,6 +201,8 @@ class SloEngine:
     def _evaluate_one(self, obj: SLObjective, now: float) -> dict:
         if obj.kind == "availability":
             self._sample_availability(obj, now)
+        elif obj.kind == "memory":
+            self._sample_memory(obj, now)
         budget = max(1e-9, 1.0 - obj.target)
         windows = []
         any_pair_breach = False
@@ -237,7 +249,10 @@ class SloEngine:
         """(burn rate, bad fraction, sample count) over one window."""
         digest, ok, err = self._profiler.request_window(
             obj.series, window_s, now=now)
-        if obj.kind == "latency":
+        if obj.kind in ("latency", "memory"):
+            # memory samples are used-fractions: "bad" = a tick whose
+            # worst-device used/budget crossed the headroom threshold —
+            # same count_above machinery as latency over seconds
             total = digest.count
             bad = digest.count_above(obj.threshold_s)
         else:
@@ -254,6 +269,17 @@ class SloEngine:
             return
         self._profiler.record_request(obj.series, 0.0,
                                       ok=svc.readiness(), now=now)
+
+    def _sample_memory(self, obj: SLObjective, now: float) -> None:
+        """Memory-pressure objectives sample themselves each tick, like
+        availability: the worst per-device used/budget fraction
+        (obs/memory.py — 0.0 when no budget is configured) lands in the
+        objective's series; the burn math reads headroom crossings."""
+        from . import memory as obs_memory
+
+        self._profiler.record_request(obj.series,
+                                      obs_memory.used_fraction(),
+                                      ok=True, now=now)
 
     # -- actions -------------------------------------------------------------
     def _service(self, name: str):

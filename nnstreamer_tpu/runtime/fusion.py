@@ -47,6 +47,7 @@ from typing import TYPE_CHECKING, Callable, Dict, List, Optional
 from ..analysis.sanitizer import named_lock
 from ..core import Buffer, clock_now
 from ..obs import context as obs_context
+from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
 from ..utils import trace
@@ -251,6 +252,13 @@ class FusedSegment:
         # consulted under obs_profile.ACTIVE (calibration keeps recording
         # on), so the profiling-off hot path pays nothing.
         self._placement_probe: Optional[Callable] = None
+        # memory accounting (obs/memory.py): armed by _build, consumed by
+        # the first dispatch of each trace generation WHILE accounting is
+        # on — one AOT lowering per generation pulls the compiled
+        # executable's memory_analysis() into the static-estimate plane.
+        # Consulted only under obs_memory.ACTIVE: off = one short-circuit
+        # (the dispatch read is racy-ok; the consume re-checks locked).
+        self._mem_pending = False  # guarded-by: _lock (reads racy-ok)
         # host-side per-buffer gates (QoS throttle on member filters);
         # empty for pure transform chains, so the steady-state fused path
         # pays zero extra Python per hop
@@ -360,7 +368,31 @@ class FusedSegment:
             if self._gen == gen and not self._defused and self._call is None:
                 self._call = jitted
                 self.stats["retraces"] += 1
+                # arm the per-generation static memory estimate: the
+                # first dispatch under obs_memory.ACTIVE records it
+                self._mem_pending = True
         return jitted
+
+    def _record_memory(self, call, args: tuple) -> None:
+        """One-shot per trace generation (memory accounting on): lower
+        the composed jit AOT for the observed signature and record its
+        memory_analysis() channels plus the member models' param
+        footprints. Runs once per (re)trace, never steady-state."""
+        try:
+            compiled = call.lower(args).compile()
+        except Exception:  # noqa: BLE001 - backends without AOT lowering
+            compiled = None
+        params = 0
+        for el in self.elements:
+            backend = getattr(el, "backend", None)
+            if backend is not None:
+                params += obs_memory.backend_param_nbytes(backend)
+        if compiled is not None:
+            obs_memory.record_compiled(self._profile_key, "fused", compiled,
+                                       param_bytes=params)
+        else:
+            obs_memory.record_stage(self._profile_key, "fused",
+                                    param_bytes=params)
 
     # -- hot path ------------------------------------------------------------
     def dispatch(self, pad, buf: Buffer) -> bool:
@@ -378,11 +410,27 @@ class FusedSegment:
             if not gate(buf):
                 return True  # dropped (QoS throttle), buffer consumed
         t0 = clock_now()
-        outs = call(tuple(buf.tensors))
+        try:
+            outs = call(tuple(buf.tensors))
+        except Exception as e:
+            # an allocation failure must land in the flight ring WITH the
+            # owning stage's name before the error path erases the context
+            if obs_memory.looks_like_oom(e):
+                pipe = getattr(self.head, "pipeline", None)
+                obs_memory.record_alloc_failure(
+                    self._profile_key, e,
+                    pipeline=pipe.name if pipe is not None else None)
+            raise
         # total_s gets ONLY the host-side dispatch time, even on probed
         # frames — same channel separation as the unfused filter (device
         # completion goes to probe_device_s)
         dt = clock_now() - t0
+        if obs_memory.ACTIVE and self._mem_pending:
+            with self._lock:  # once per trace generation, never steady state
+                pending = self._mem_pending
+                self._mem_pending = False
+            if pending:
+                self._record_memory(call, tuple(buf.tensors))
         st = self.stats
         st["dispatches"] += 1
         st["total_s"] += dt

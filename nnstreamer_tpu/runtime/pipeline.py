@@ -226,6 +226,11 @@ class Pipeline:
                 from . import placement
 
                 placement.uninstall(self)
+            # memory accounting (obs/memory.py): queue-occupancy bytes
+            # are read off live pipelines at scrape time
+            from ..obs import memory as obs_memory
+
+            obs_memory.track_pipeline(self)
             # start non-sources first so queues/filters are ready before
             # data flows
             for el in self.elements.values():
@@ -252,6 +257,15 @@ class Pipeline:
                     el.stop()
         # joined outside _state_lock — the halt threads acquire it
         self._halt_threads.drain(timeout_per=2.0)
+        # explicit metrics unregister sweep: a stopped pipeline's
+        # nns_fused_* / nns_placement_* / queue-bytes rows must leave the
+        # scrape NOW, not whenever GC collects the weak refs (a replay
+        # re-tracks at play())
+        from ..obs import memory as obs_memory
+        from ..obs import metrics as obs_metrics
+
+        obs_metrics.untrack_pipeline(self)
+        obs_memory.untrack_pipeline(self)
         if self._placement_state is not None:
             # an open calibration window must not outlive the run that
             # was feeding it samples (recording refcount balance)

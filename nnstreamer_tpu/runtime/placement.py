@@ -77,6 +77,8 @@ from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
 
 from ..analysis.sanitizer import named_lock
 from ..obs import context as obs_context
+from ..obs import flight as obs_flight
+from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
 from ..utils.log import logger
@@ -111,7 +113,10 @@ HEURISTIC_ELEMENT_MS = 1.0
 class StagePlacement:
     """One stage's assignment: ``stage`` is the canonical segment key
     (``head..tail`` for fused runs, the element's canonical name for
-    singletons), ``device`` an index into :attr:`PlacementPlan.devices`."""
+    singletons), ``device`` an index into :attr:`PlacementPlan.devices`.
+    ``bytes`` is the stage's profiled static memory footprint (params +
+    temp + output + argument + code, from the artifact's ``memory``
+    section — obs/memory.py); 0 = unprofiled, unconstrained."""
 
     stage: str
     elements: List[str]
@@ -119,18 +124,21 @@ class StagePlacement:
     cost_ms: float
     p99_ms: float
     source: str  # "profile" | "heuristic"
+    bytes: int = 0
 
     def to_dict(self) -> dict:
         return {"stage": self.stage, "elements": list(self.elements),
                 "device": self.device, "cost_ms": round(self.cost_ms, 6),
-                "p99_ms": round(self.p99_ms, 6), "source": self.source}
+                "p99_ms": round(self.p99_ms, 6), "source": self.source,
+                "bytes": int(self.bytes)}
 
     @classmethod
     def from_dict(cls, d: dict) -> "StagePlacement":
         return cls(str(d["stage"]), [str(e) for e in d.get("elements", [])],
                    int(d["device"]), float(d.get("cost_ms", 0.0)),
                    float(d.get("p99_ms", 0.0)),
-                   str(d.get("source", "heuristic")))
+                   str(d.get("source", "heuristic")),
+                   int(d.get("bytes", 0)))
 
 
 @dataclass
@@ -241,6 +249,25 @@ def _stage_cost(artifact, elements: Sequence) -> tuple:
     return cost, cost, "heuristic"
 
 
+def _stage_bytes(artifact, elements: Sequence) -> int:
+    """Profiled static memory footprint of one stage from the artifact's
+    ``memory`` section (obs/memory.py): the fused-segment entry first,
+    the sum of singleton member entries otherwise, 0 (= unconstrained)
+    when nothing was captured."""
+    mem = getattr(artifact, "memory", None) if artifact is not None else None
+    if not mem:
+        return 0
+    cell = mem.get(stage_key(elements))
+    if cell is not None:
+        return int(cell.get("total_bytes", 0) or 0)
+    total = 0
+    for el in elements:
+        cell = mem.get(obs_profile.canonical_base(el))
+        if cell is not None:
+            total += int(cell.get("total_bytes", 0) or 0)
+    return total
+
+
 # ---------------------------------------------------------------------------
 # the planner
 # ---------------------------------------------------------------------------
@@ -257,7 +284,8 @@ class Planner:
                  devices: Optional[Sequence] = None, mesh=None,
                  min_queue_depth: int = MIN_QUEUE_DEPTH,
                  max_queue_depth: int = MAX_QUEUE_DEPTH,
-                 max_stages_per_device: Optional[int] = None):
+                 max_stages_per_device: Optional[int] = None,
+                 hbm_budget_bytes: Optional[int] = None):
         if mesh is not None and devices is not None:
             raise ValueError("pass devices OR mesh, not both")
         self._store = store
@@ -265,13 +293,20 @@ class Planner:
         self._mesh = mesh
         self.min_queue_depth = int(min_queue_depth)
         self.max_queue_depth = int(max_queue_depth)
-        # memory constraint (opt-in): cap how many stages' params +
-        # activations may co-reside on one chip. None = latency-only
-        # balance — the planner has no per-stage byte estimate at plan
-        # time, and a blind ceil(S/N) cap can FORBID the latency
-        # optimum (one dominant segment alone on a chip, light stages
-        # packed elsewhere). HBM-constrained deployments set a real cap.
+        # legacy memory knob (opt-in): cap how many stages may co-reside
+        # on one chip regardless of bytes. Superseded by the byte
+        # constraint below whenever the artifact carries memory
+        # estimates, but still honored for deployments that tuned it.
         self.max_stages_per_device = max_stages_per_device
+        # the REAL memory constraint (PR 10, obs/memory.py): per-device
+        # HBM budget in bytes. None = auto — per device, the backend's
+        # reported ``memory_stats()['bytes_limit']`` when available
+        # (TPU/GPU), else the NNS_HBM_BUDGET env budget, else
+        # unconstrained. With a budget and profiled per-stage byte
+        # estimates the planner derives the co-residency cap itself:
+        # bin-pack on bytes as a feasibility constraint inside the
+        # exact/LPT balance search — no stage-count knob needed.
+        self.hbm_budget_bytes = hbm_budget_bytes
 
     # -- inputs --------------------------------------------------------------
     @property
@@ -293,6 +328,28 @@ class Planner:
 
                 self._devices = list(jax.devices())
         return self._devices
+
+    def device_budgets(self) -> List[Optional[int]]:
+        """Per-device HBM budget in bytes, aligned with :attr:`devices`:
+        the explicit ``hbm_budget_bytes`` when given, else what the
+        device's own allocator reports (``memory_stats()['bytes_limit']``),
+        else the process budget (``NNS_HBM_BUDGET``); None entries are
+        unconstrained."""
+        fallback = obs_memory.default_budget_bytes()
+        budgets: List[Optional[int]] = []
+        for d in self.devices:
+            b = self.hbm_budget_bytes
+            if b is None:
+                ms = getattr(d, "memory_stats", None)
+                if ms is not None:
+                    try:
+                        b = (ms() or {}).get("bytes_limit")
+                    except Exception:  # noqa: BLE001 - backend w/o stats
+                        b = None
+            if b is None:
+                b = fallback
+            budgets.append(int(b) if b else None)
+        return budgets
 
     def artifact_for(self, pipeline: "Pipeline", model_version: str = ""):
         """The stored profile matching this pipeline's key: the exact
@@ -355,12 +412,15 @@ class Planner:
                 stage=key,
                 elements=[obs_profile.canonical_base(e) for e in elements],
                 device=0, cost_ms=costs[key][0], p99_ms=costs[key][1],
-                source=costs[key][2]))
+                source=costs[key][2],
+                bytes=_stage_bytes(artifact, elements)))
         plan.source = ("profile" if artifact is not None
                        and any(s.source == "profile" for s in plan.stages)
                        else "heuristic")
 
-        load = self._assign(plan.stages, n_dev)
+        budgets = self.device_budgets()
+        load, dev_bytes, byte_feasible = self._assign(
+            plan.stages, n_dev, budgets=budgets)
 
         critical = sum(s.cost_ms for s in plan.stages)
         max_load = max(load) if plan.stages else 0.0
@@ -373,6 +433,11 @@ class Planner:
             # push this up — the planner cannot split inside a segment
             "ratio": round(max_load / target, 4) if target else 1.0,
             "n_devices": n_dev,
+            # memory side (obs/memory.py): what the byte constraint saw
+            "stage_bytes_total": sum(s.bytes for s in plan.stages),
+            "max_device_bytes": max(dev_bytes) if dev_bytes else 0,
+            "budget_bytes": min((b for b in budgets if b), default=0),
+            "byte_feasible": byte_feasible,
         }
 
         self._tune_queues(pipeline, artifact, plan)
@@ -386,19 +451,65 @@ class Planner:
     # re-planning runs)
     EXACT_SEARCH_LIMIT = 65536
 
-    def _assign(self, stages: List[StagePlacement], n_dev: int
-                ) -> List[float]:
-        """Assign stages to devices minimizing the max per-device load,
-        optionally under the ``max_stages_per_device`` memory cap (each
-        stage's params + activations are resident on its chip). Exact
+    def _assign(self, stages: List[StagePlacement], n_dev: int,
+                budgets: Optional[Sequence[Optional[int]]] = None
+                ) -> tuple:
+        """Assign stages to devices minimizing the max per-device load
+        under two feasibility constraints: the legacy (opt-in)
+        ``max_stages_per_device`` count cap, and — when per-stage byte
+        estimates and per-device budgets exist — the HBM **byte budget**
+        (each stage's params + activations are resident on its chip, so
+        the sum of co-resident stage bytes must fit the chip). Exact
         enumeration when the space is small — "auto matches the best
-        hand placement" is structural, not heuristic — LPT
-        (longest-processing-time-first onto the least-loaded device)
-        beyond that. Deterministic: the exact path takes the
-        lexicographically-smallest optimum in stage order; LPT breaks
-        ties on stage key then device index."""
+        hand placement among FEASIBLE assignments" is structural, not
+        heuristic — LPT (longest-processing-time-first onto the
+        least-loaded eligible device) beyond that. Deterministic: the
+        exact path takes the lexicographically-smallest optimum in
+        stage order; LPT breaks ties on stage key then device index.
+
+        Returns ``(load_ms, device_bytes, byte_feasible)``. When no
+        byte-feasible assignment exists at all (a stage alone outgrows
+        every budget, or the packing cannot fit), the byte constraint is
+        dropped with a warning + ``memory`` flight event — a plan MUST
+        always come out — and ``byte_feasible`` reports False."""
         if not stages:
-            return [0.0] * n_dev
+            return [0.0] * n_dev, [0] * n_dev, True
+        budgets = (list(budgets) if budgets is not None
+                   else [None] * n_dev)
+        budgets += [None] * (n_dev - len(budgets))
+        constrained = (any(b is not None for b in budgets)
+                       and any(s.bytes for s in stages))
+        result = self._assign_under(stages, n_dev,
+                                    budgets if constrained else
+                                    [None] * n_dev)
+        if result is not None:
+            load, dev_bytes = result
+            return load, dev_bytes, self._fits(dev_bytes, budgets)
+        # byte-infeasible everywhere: relax and report
+        logger.warning(
+            "placement: no byte-feasible assignment of %d stages "
+            "(total %d bytes) under budgets %s — relaxing the memory "
+            "constraint", len(stages), sum(s.bytes for s in stages),
+            budgets)
+        obs_flight.record("memory", "placement_infeasible",
+                          {"stages": len(stages),
+                           "stage_bytes": sum(s.bytes for s in stages),
+                           "budgets": [b or 0 for b in budgets]})
+        load, dev_bytes = self._assign_under(stages, n_dev,
+                                             [None] * n_dev)
+        return load, dev_bytes, False
+
+    @staticmethod
+    def _fits(dev_bytes: List[int],
+              budgets: Sequence[Optional[int]]) -> bool:
+        return all(b is None or used <= b
+                   for used, b in zip(dev_bytes, budgets))
+
+    def _assign_under(self, stages: List[StagePlacement], n_dev: int,
+                      budgets: Sequence[Optional[int]]
+                      ) -> Optional[tuple]:
+        """One constrained search pass; None when the exact search finds
+        no feasible assignment (only possible with byte budgets)."""
         cap = self.max_stages_per_device
         if cap is None:
             cap = len(stages)  # unconstrained
@@ -410,10 +521,14 @@ class Planner:
             for combo in itertools.product(range(n_dev), repeat=len(stages)):
                 load = [0.0] * n_dev
                 count = [0] * n_dev
+                mem = [0] * n_dev
                 ok = True
                 for st, dev in zip(stages, combo):
                     count[dev] += 1
-                    if count[dev] > cap:
+                    mem[dev] += st.bytes
+                    if count[dev] > cap or (
+                            budgets[dev] is not None
+                            and mem[dev] > budgets[dev]):
                         ok = False
                         break
                     load[dev] += st.cost_ms
@@ -421,20 +536,38 @@ class Planner:
                     continue
                 key = (max(load), combo)
                 if best is None or key < best:
-                    best = key + (load,)
-            assert best is not None  # cap*n_dev >= len(stages) always fits
+                    best = key + (load, mem)
+            if best is None:
+                return None  # byte budgets forbade every assignment
             for st, dev in zip(stages, best[1]):
                 st.device = dev
-            return best[2]
+            return best[2], best[3]
         load = [0.0] * n_dev
         count = [0] * n_dev
+        mem = [0] * n_dev
+        over_budget = False
         for st in sorted(stages, key=lambda s: (-s.cost_ms, s.stage)):
-            eligible = [i for i in range(n_dev) if count[i] < cap]
+            eligible = [i for i in range(n_dev)
+                        if count[i] < cap
+                        and (budgets[i] is None
+                             or mem[i] + st.bytes <= budgets[i])]
+            if not eligible:
+                # no device has byte headroom: this greedy packing
+                # failed — report None so _assign relaxes with the same
+                # warning + flight event the exact path emits (greedy
+                # LPT is a heuristic; a feasible packing may exist, but
+                # a silently over-budget plan must never come out as
+                # byte_feasible)
+                over_budget = True
+                eligible = [i for i in range(n_dev) if count[i] < cap]
             idx = min(eligible or range(n_dev), key=lambda i: (load[i], i))
             st.device = idx
             load[idx] += st.cost_ms
             count[idx] += 1
-        return load
+            mem[idx] += st.bytes
+        if over_budget and any(b is not None for b in budgets):
+            return None
+        return load, mem
 
     def _tune_queues(self, pipeline: "Pipeline", artifact,
                      plan: PlacementPlan) -> None:
@@ -583,6 +716,9 @@ class _PlacementState:
                 return
             self._calibrating = True
         obs_profile.begin_calibration()
+        # byte estimates ride the same window: the artifact captured at
+        # window close carries the memory section the auto-cap needs
+        obs_memory.begin_calibration()
         for seg in segments:
             seg._placement_probe = self._calibration_probe
         logger.info("placement %s: no profile artifact — calibrating over "
@@ -629,6 +765,7 @@ class _PlacementState:
                         pipeline.name, plan.describe())
         finally:
             obs_profile.end_calibration()
+            obs_memory.end_calibration()
 
     def close(self) -> None:
         """End-of-run cleanup: an open calibration window must not leak
@@ -641,6 +778,7 @@ class _PlacementState:
             for seg in (pipe.fused_segments if pipe is not None else []):
                 seg._placement_probe = None
             obs_profile.end_calibration()
+            obs_memory.end_calibration()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -766,10 +904,14 @@ def uninstall(pipeline: "Pipeline") -> None:
 
 def on_stop(pipeline: "Pipeline") -> None:
     """Pipeline.stop() hook: a calibration window must not outlive the
-    run that was feeding it samples."""
+    run that was feeding it samples, and the stopped pipeline's
+    ``nns_placement_*`` gauge rows leave the scrape immediately (the
+    weak set alone keeps them visible until GC runs; install() at the
+    next play re-tracks)."""
     state = getattr(pipeline, "_placement_state", None)
     if state is not None:
         state.close()
+    _tracked_placed.discard(pipeline)
 
 
 # ---------------------------------------------------------------------------

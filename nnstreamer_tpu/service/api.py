@@ -16,9 +16,14 @@ Routes (JSON unless noted):
                                           service, fabric, fused segments;
                                           docs/observability.md)
     GET    /flight                        flight-recorder tail
-                                          (?last=N&pipeline=NAME)
+                                          (?last=N&pipeline=NAME
+                                          &category=KIND)
     GET    /profile                       continuous-profiler snapshot +
                                           SLO status (obs profile / top)
+    GET    /memory                        device-memory accounting plane
+                                          (stage estimates, device
+                                          watermarks, queue/serving
+                                          bytes — obs/memory.py)
     GET    /services                      list (name/state/ready/restarts)
     GET    /services/<name>               full health snapshot
     POST   /services                      register {name, launch, ...}
@@ -175,7 +180,8 @@ def _make_handler(manager: ServiceManager):
                 except ValueError:
                     raise ValueError(f"last={params['last']!r} not an int")
                 return {"events": obs_flight.dump(
-                    last=last, pipeline=params.get("pipeline"))}
+                    last=last, pipeline=params.get("pipeline"),
+                    category=params.get("category"))}
             if parts == ["profile"] and method == "GET":
                 from ..obs import profile as obs_profile
                 from ..obs import slo as obs_slo
@@ -184,6 +190,10 @@ def _make_handler(manager: ServiceManager):
                 return {"profile": obs_profile.snapshot(),
                         "slo": obs_slo.status_all(),
                         "placement": placement.snapshot_all()}
+            if parts == ["memory"] and method == "GET":
+                from ..obs import memory as obs_memory
+
+                return {"memory": obs_memory.snapshot()}
             if parts == ["services"]:
                 if method == "GET":
                     return {"services": m.list()}
@@ -305,19 +315,27 @@ class ControlClient:
                 f"{getattr(e, 'reason', e)}") from e
 
     def flight(self, last: int = 256,
-               pipeline: Optional[str] = None) -> dict:
+               pipeline: Optional[str] = None,
+               category: Optional[str] = None) -> dict:
         """Flight-recorder tail; ``pipeline`` filters on the event's
-        pipeline tag (parity with ``flight.dump(pipeline=)``)."""
+        pipeline tag, ``category`` on the event kind (parity with
+        ``flight.dump(pipeline=, category=)``)."""
         from urllib.parse import quote
 
         path = f"/flight?last={int(last)}"
         if pipeline is not None:
             path += f"&pipeline={quote(pipeline)}"
+        if category is not None:
+            path += f"&category={quote(category)}"
         return self._call("GET", path)
 
     def profile(self) -> dict:
         """GET /profile — profiler snapshot + SLO status."""
         return self._call("GET", "/profile")
+
+    def memory(self) -> dict:
+        """GET /memory — the device-memory accounting snapshot."""
+        return self._call("GET", "/memory")
 
     def list(self) -> dict:
         return self._call("GET", "/services")

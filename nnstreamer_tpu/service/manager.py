@@ -583,3 +583,7 @@ class ServiceManager:
         self.models.unpublish_all()
         with self._lock:
             self._services.clear()
+        # explicit unregister sweep: a retired manager's nns_service_*
+        # rows must leave the scrape now, not when GC collects the weak
+        # tracking ref
+        obs_metrics.untrack_manager(self)

@@ -28,11 +28,15 @@ model entry; the scheduler contract is deterministic token streams.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Optional
 
 import numpy as np
 
+from ..obs import memory as obs_memory
 from .request import ServingError
+
+_engine_ids = itertools.count()
 
 
 class ContinuousLMEngine:
@@ -63,6 +67,14 @@ class ContinuousLMEngine:
         self._tok = np.zeros((slots, 1), np.int32)
         self._pos = np.zeros((slots,), np.int32)
         self._mask = np.zeros((slots,), bool)
+        # memory accounting (obs/memory.py): the batched slot cache is
+        # the serving plane's dominant resident buffer — its footprint
+        # is static (fixed slots × max_seq), so one measurement at build
+        # time is the truth for the engine's whole lifetime
+        self.cache_bytes = obs_memory.tree_nbytes(self._cache)
+        self.param_bytes = obs_memory.tree_nbytes(params)
+        self._mem_name = f"lm_engine#{next(_engine_ids)}"
+        obs_memory.track_serving(self)
 
         def _prefill(p, tokens):
             self.compile_count += 1  # trace-time only: once per prompt len
@@ -139,6 +151,15 @@ class ContinuousLMEngine:
     @property
     def active_slots(self) -> int:
         return int(self._mask.sum())
+
+    def memory_bytes(self) -> dict:
+        """Serving-plane byte source (obs/memory.py ``track_serving``
+        contract): the slot KV cache + params this engine keeps
+        device-resident, and how many slots are live in it."""
+        return {"name": self._mem_name, "kind": "kv_cache",
+                "bytes": self.cache_bytes,
+                "param_bytes": self.param_bytes,
+                "slots": self.slots, "active_slots": self.active_slots}
 
 
 def from_entry(entry, slots: int = 4,

@@ -76,6 +76,7 @@ class ServingMetrics:
         self.failed = 0
         self.shed_queue_full = 0
         self.shed_deadline = 0
+        self.shed_memory = 0
         self.batches = 0
         self.batched_rows = 0      # real rows executed
         self.padded_rows = 0       # rows incl. bucket padding
@@ -93,9 +94,12 @@ class ServingMetrics:
         with self._lock:
             self.submitted += n
 
-    def record_shed(self, deadline: bool) -> None:
+    def record_shed(self, deadline: bool = False,
+                    memory: bool = False) -> None:
         with self._lock:
-            if deadline:
+            if memory:
+                self.shed_memory += 1
+            elif deadline:
                 self.shed_deadline += 1
             else:
                 self.shed_queue_full += 1
@@ -150,6 +154,7 @@ class ServingMetrics:
                 "failed": self.failed,
                 "shed_queue_full": self.shed_queue_full,
                 "shed_deadline": self.shed_deadline,
+                "shed_memory": self.shed_memory,
                 "batches": self.batches,
                 "decode_steps": self.decode_steps,
                 "retired_early": self.retired_early,

@@ -43,6 +43,13 @@ class DeadlineExceededError(AdmissionError):
     nobody will read)."""
 
 
+class MemoryPressureError(AdmissionError):
+    """Admitting this request's tensors would push projected serving
+    memory past the configured watermark (``obs.memory.AdmissionGuard``)
+    — shed NOW, typed, instead of OOM-ing a formed batch mid-execution
+    and failing every coalesced neighbor with it."""
+
+
 class SchedulerClosedError(ServingError):
     """Submission after ``close()``."""
 

@@ -35,10 +35,15 @@ from .metrics import ServingMetrics, register_scheduler
 from .queue import RequestQueue
 from .request import (
     AdmissionError,
+    MemoryPressureError,
     Request,
     SchedulerClosedError,
     ServingError,
 )
+
+
+def _tensors_nbytes(tensors) -> int:
+    return sum(int(getattr(t, "nbytes", 0) or 0) for t in tensors)
 
 
 def _block_ready(outputs) -> None:
@@ -104,10 +109,16 @@ class Scheduler:
                  predictive_shed: bool = True,
                  name: str = "scheduler",
                  autostart: bool = True,
+                 memory_guard=None,
                  on_close: Optional[Callable[[], None]] = None):
         if (fn is None) == (executor is None):
             raise ValueError("pass exactly one of fn= or executor=")
         self.executor = executor if executor is not None else JitExecutor(fn)
+        # memory admission (obs/memory.py AdmissionGuard): projected
+        # request bytes reserve against a watermark at submit and release
+        # at completion — a saturated-memory server sheds typed instead
+        # of OOM-ing mid-batch. None = no byte gate (default).
+        self.memory_guard = memory_guard
         self.former = BatchFormer(bucket_sizes, max_wait_s,
                                   idle_linger_s=idle_linger_s)
         self.queue = RequestQueue(max_depth,
@@ -140,7 +151,41 @@ class Scheduler:
     def _on_queue_shed(self, req: Request) -> None:
         """A request's deadline expired while queued (shed at pop time —
         queue.py already failed its future with the typed error)."""
+        self._release_mem(req)
         self.metrics.record_shed(deadline=True)
+
+    # -- memory admission (obs/memory.py AdmissionGuard) --------------------
+    def _reserve_mem(self, req: Request) -> None:
+        """Reserve the request's tensor bytes against the guard's
+        watermark; sheds with a typed MemoryPressureError when the
+        projection would cross it. No guard = no-op."""
+        guard = self.memory_guard
+        if guard is None:
+            return
+        nb = _tensors_nbytes(req.tensors)
+        if not guard.reserve(nb):
+            err = MemoryPressureError(
+                f"request {req.id} shed: projected serving memory "
+                f"({guard.inflight_bytes} + {nb} bytes) would cross the "
+                f"{guard.limit_bytes}-byte watermark")
+            self.metrics.record_shed(memory=True)
+            obs_flight.record("memory", "admission_shed",
+                              {"scheduler": self.name, "request": req.id,
+                               "bytes": nb})
+            req.fail(err)
+            raise err
+        req.metrics["_mem_reserved"] = nb
+
+    def _release_mem(self, req: Request) -> None:
+        nb = req.metrics.pop("_mem_reserved", None)
+        if nb is not None and self.memory_guard is not None:
+            self.memory_guard.release(nb)
+
+    def _record_done(self, req: Request, failed: bool = False) -> None:
+        """Every request exit path funnels here: the memory reservation
+        dies with the request, whatever killed it."""
+        self._release_mem(req)
+        self.metrics.record_request_done(req, failed=failed)
 
     def close(self) -> None:
         """Stop the loop and fail everything still pending with
@@ -153,7 +198,7 @@ class Scheduler:
         err = SchedulerClosedError(f"scheduler {self.name} closed")
         for req in self.queue.drain() + self.former.drain():
             req.fail(err)
-            self.metrics.record_request_done(req, failed=True)
+            self._record_done(req, failed=True)
         if self._on_close is not None:
             self._on_close()
             self._on_close = None
@@ -185,11 +230,13 @@ class Scheduler:
                 attrs={"request_id": req.id})
             req.trace = req._span.context()
         self.metrics.record_submit()
+        self._reserve_mem(req)  # raises typed MemoryPressureError on shed
         try:
             self.queue.put(req)
         except AdmissionError as e:
             from .request import DeadlineExceededError
 
+            self._release_mem(req)
             self.metrics.record_shed(
                 deadline=isinstance(e, DeadlineExceededError))
             raise
@@ -207,7 +254,7 @@ class Scheduler:
         stranded = self.queue.drain()
         for r in stranded:
             r.fail(err)
-            self.metrics.record_request_done(r, failed=True)
+            self._record_done(r, failed=True)
         if req in stranded:
             raise err
 
@@ -268,7 +315,7 @@ class Scheduler:
                                "error": str(e)[:200]})
             for r in batch.requests:
                 r.fail(err)
-                self.metrics.record_request_done(r, failed=True)
+                self._record_done(r, failed=True)
             return
         device_s = time.monotonic() - t_start
         self.queue.observe_service_time(device_s)
@@ -296,7 +343,7 @@ class Scheduler:
             r.metrics["device_time_s"] = device_s
             r.metrics["ttft_s"] = now - r.metrics["enqueue_time"]
             r.complete(outs)
-            self.metrics.record_request_done(r)
+            self._record_done(r)
         # these clients just got results — closed-loop traffic resubmits
         # within the next max-wait window, so hold the idle-boundary
         # flush until that many rows land (or the window lapses) rather
@@ -326,8 +373,10 @@ class DecodeScheduler:
                  max_depth: int = 256,
                  predictive_shed: bool = True,
                  name: str = "decode",
-                 autostart: bool = True):
+                 autostart: bool = True,
+                 memory_guard=None):
         self.engine = engine
+        self.memory_guard = memory_guard  # see Scheduler.memory_guard
         self.queue = RequestQueue(max_depth, est_batch_rows=engine.slots,
                                   predictive_shed=predictive_shed,
                                   on_shed=self._on_queue_shed)
@@ -362,7 +411,7 @@ class DecodeScheduler:
         err = SchedulerClosedError(f"scheduler {self.name} closed")
         for req in list(self._active.values()) + self.queue.drain():
             req.fail(err)
-            self.metrics.record_request_done(req, failed=True)
+            self._record_done(req, failed=True)
         self._active.clear()
 
     # -- submission ---------------------------------------------------------
@@ -396,11 +445,13 @@ class DecodeScheduler:
                 attrs={"request_id": req.id})
             req.trace = req._span.context()
         self.metrics.record_submit()
+        self._reserve_mem(req)  # raises typed MemoryPressureError on shed
         try:
             self.queue.put(req)
         except AdmissionError as e:
             from .request import DeadlineExceededError
 
+            self._release_mem(req)
             self.metrics.record_shed(
                 deadline=isinstance(e, DeadlineExceededError))
             raise
@@ -409,6 +460,9 @@ class DecodeScheduler:
 
     _on_queue_shed = Scheduler._on_queue_shed
     _fail_if_closed_after_put = Scheduler._fail_if_closed_after_put
+    _reserve_mem = Scheduler._reserve_mem
+    _release_mem = Scheduler._release_mem
+    _record_done = Scheduler._record_done
 
     @property
     def compile_count(self) -> int:
@@ -434,7 +488,7 @@ class DecodeScheduler:
             self._free.append(slot)
             req.fail(e if isinstance(e, ServingError)
                      else ServingError(f"decode admit failed: {e}"))
-            self.metrics.record_request_done(req, failed=True)
+            self._record_done(req, failed=True)
             return
         now = time.monotonic()
         req.metrics["slot"] = slot
@@ -463,7 +517,7 @@ class DecodeScheduler:
         # nnlint: disable=NNL101 — req.tokens is a host-side python list;
         # this asarray is a list→array pack, not a device sync
         req.complete((np.asarray(req.tokens, np.int32),))
-        self.metrics.record_request_done(req)
+        self._record_done(req)
 
     def _loop(self) -> None:
         while self._running.is_set():
@@ -487,7 +541,7 @@ class DecodeScheduler:
                 logger.exception("serving %s: decode step failed", self.name)
                 for slot, req in list(self._active.items()):
                     req.fail(err)
-                    self.metrics.record_request_done(req, failed=True)
+                    self._record_done(req, failed=True)
                     self._retire_slot_only(slot)
                 continue
             device_s = time.monotonic() - t0

@@ -89,8 +89,9 @@ class TestPlanner:
         optimality fails loudly here."""
         stages = [StagePlacement(k, [k], 0, c, c, "profile")
                   for k, c in zip("abcd", (4.0, 2.0, 2.0, 1.0))]
-        load = Planner(devices=[None, None])._assign(stages, 2)
+        load, _, feasible = Planner(devices=[None, None])._assign(stages, 2)
         assert max(load) == pytest.approx(5.0)
+        assert feasible  # no byte estimates -> trivially feasible
         rr_load = [4.0 + 2.0, 2.0 + 1.0]  # [0,1,0,1]
         assert max(load) < max(rr_load)
         # deterministic: repeated assignment is identical
@@ -368,18 +369,34 @@ class TestLintHint:
 
 class TestObs:
     def test_gauges_and_snapshot(self, store):
-        pipe = make_artifact(store)
-        text = obs_metrics.render()
-        assert "nns_placement_stage_device" in text
-        assert f'pipeline="{pipe.name}"' in text
-        snaps = placement.snapshot_all()
-        mine = [s for s in snaps if s["pipeline"] == pipe.name]
-        assert mine and mine[0]["stages"]
+        make_artifact(store)
+        pipe = parse_launch(line(400), place="auto")
+        pipe.play()
+        try:
+            text = obs_metrics.render()
+            assert "nns_placement_stage_device" in text
+            assert f'pipeline="{pipe.name}"' in text
+            snaps = placement.snapshot_all()
+            mine = [s for s in snaps if s["pipeline"] == pipe.name]
+            assert mine and mine[0]["stages"]
+        finally:
+            pipe.stop()
+        # PR-10 unregister sweep: a stopped pipeline's placement rows
+        # leave the scrape immediately, not at GC time
+        assert f'pipeline="{pipe.name}"' not in obs_metrics.render()
+        assert not [s for s in placement.snapshot_all()
+                    if s["pipeline"] == pipe.name]
 
     def test_render_top_placement_section(self, store):
-        pipe = make_artifact(store)
-        text = obs_profile.render_top(
-            obs_profile.snapshot(), [], placement=placement.snapshot_all())
+        make_artifact(store)
+        pipe = parse_launch(line(400), place="auto")
+        pipe.play()
+        try:
+            text = obs_profile.render_top(
+                obs_profile.snapshot(), [],
+                placement=placement.snapshot_all())
+        finally:
+            pipe.stop()
         assert "PLACEMENT" in text
         assert pipe.name in text
 

@@ -1,0 +1,324 @@
+"""Memory-accounting bench: profiled byte estimates drive placement caps
+and serving admission (the ISSUE-10 acceptance scenarios).
+
+Three legs, one artifact:
+
+1. **capture** — a 4-stage fused device pipeline (the bench_placement
+   topology family) runs once under ``obs.profile`` + ``obs.memory``;
+   the captured ``ProfileArtifact`` carries a ``memory`` section with
+   per-stage byte estimates next to the latency digests.
+
+2. **auto-cap placement** (gated) — a ``Planner`` given ONLY the
+   artifact and a stated HBM budget (no ``max_stages_per_device``)
+   must produce a plan that is (a) byte-feasible under the budget and
+   (b) latency-optimal among ALL byte-feasible assignments of the same
+   cost table — verified by exhaustive enumeration over the plan's own
+   per-stage costs/bytes. A second planner run with a budget that
+   forbids the unconstrained latency optimum must still be feasible
+   (the cap binds) and still optimal among feasible.
+
+3. **admission overload** (gated) — a ``Scheduler`` guarded by an
+   ``AdmissionGuard`` with a deliberately tiny byte budget is flooded
+   far past it; the guard's tracked bytes must NEVER cross the
+   watermark, some requests must shed with the typed
+   ``MemoryPressureError``, and every non-shed request must complete —
+   zero client-visible errors.
+
+Emits ``MEMORY_r10.json`` (n_devices/ok/tail ledger fields plus the
+per-leg numbers).
+
+Run:  python tools/bench_memory.py [--smoke] [--frames N] [--out PATH]
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from nnstreamer_tpu.obs import memory as obs_memory  # noqa: E402
+from nnstreamer_tpu.obs import profile as obs_profile  # noqa: E402
+from nnstreamer_tpu.runtime.parse import parse_launch  # noqa: E402
+from nnstreamer_tpu.runtime.placement import Planner  # noqa: E402
+from nnstreamer_tpu.serving.request import (  # noqa: E402
+    MemoryPressureError,
+)
+from nnstreamer_tpu.serving.scheduler import Scheduler  # noqa: E402
+
+N_DEVICES_USED = 2
+# descending matmul counts per stage — the shape whose latency optimum
+# pairs heavy-with-light (same table family as bench_placement)
+STAGE_MATMULS = (4, 2, 2, 1)
+MM = "tensor_filter framework=jax model=builtin://matmul?n=256 "
+ADD = "tensor_transform mode=arithmetic option=add:0.5 "
+
+
+def launch_line(n_frames: int) -> str:
+    stages = [f"{ADD}! " + "! ".join([MM] * k) for k in STAGE_MATMULS]
+    mid = " ".join(
+        f"! {stage} ! queue name=q{i} max-size-buffers=16"
+        for i, stage in enumerate(stages[:-1]))
+    return (f"tensor_src num-buffers={n_frames} dimensions=256:16 "
+            f"types=float32 pattern=random "
+            f"{mid} ! {stages[-1]} ! tensor_sink name=out max-stored=1")
+
+
+# ---------------------------------------------------------------------------
+# leg 1: capture an artifact with byte estimates
+# ---------------------------------------------------------------------------
+
+def capture_artifact(n_frames: int) -> obs_profile.ProfileArtifact:
+    obs_profile.reset()
+    obs_memory.reset()
+    obs_profile.start()
+    obs_memory.start()
+    try:
+        pipe = parse_launch(launch_line(n_frames))
+        pipe.run(timeout=300)
+    finally:
+        obs_profile.stop()
+        obs_memory.stop()
+    art = obs_profile.ProfileArtifact.capture(pipe)
+    if not art.memory:
+        raise SystemExit("FAIL: artifact captured no memory section")
+    return art
+
+
+# ---------------------------------------------------------------------------
+# leg 2: auto-cap placement from the artifact + a stated budget
+# ---------------------------------------------------------------------------
+
+def enumerate_optimum(stages, n_dev: int, budget: int) -> tuple:
+    """(best feasible makespan, any feasible exists) by brute force over
+    the plan's own cost/byte table — the bench's independent referee."""
+    best = None
+    feasible_any = False
+    for combo in itertools.product(range(n_dev), repeat=len(stages)):
+        load = [0.0] * n_dev
+        mem = [0] * n_dev
+        for st, dev in zip(stages, combo):
+            load[dev] += st.cost_ms
+            mem[dev] += st.bytes
+        if any(m > budget for m in mem):
+            continue
+        feasible_any = True
+        if best is None or max(load) < best:
+            best = max(load)
+    return best, feasible_any
+
+
+def min_feasible_budget(stages, n_dev: int) -> int:
+    """The smallest per-device budget under which ANY assignment fits
+    (bytes-makespan optimum) — the tightest budget that still admits a
+    plan, i.e. where the byte constraint binds hardest."""
+    best = None
+    for combo in itertools.product(range(n_dev), repeat=len(stages)):
+        mem = [0] * n_dev
+        for st, dev in zip(stages, combo):
+            mem[dev] += st.bytes
+        m = max(mem)
+        if best is None or m < best:
+            best = m
+    return best or 1
+
+
+def placement_leg(art: obs_profile.ProfileArtifact, n_frames: int) -> dict:
+    store_dir = tempfile.mkdtemp(prefix="nns-memstore-")
+    store = obs_profile.ProfileStore(store_dir)
+    store.save(art)
+    devices = jax.devices()[:N_DEVICES_USED]
+    pipe = parse_launch(launch_line(n_frames))
+
+    # a generous budget first: every stage fits anywhere — the plan must
+    # be byte-feasible AND match the unconstrained latency optimum
+    total_bytes = sum(c.get("total_bytes", 0) for c in art.memory.values())
+    generous = max(total_bytes * 2, 1)
+    planner = Planner(store=store, devices=devices,
+                      hbm_budget_bytes=generous)
+    plan = planner.plan(pipe, artifact=art)
+    stages = plan.stages
+    stage_bytes = [s.bytes for s in stages]
+    if not any(stage_bytes):
+        raise SystemExit("FAIL: plan carries no per-stage byte estimates")
+    best, _ = enumerate_optimum(stages, N_DEVICES_USED, generous)
+    loose = {
+        "budget_bytes": generous,
+        "max_stage_ms": plan.balance["max_stage_ms"],
+        "enumerated_optimum_ms": best,
+        "byte_feasible": plan.balance["byte_feasible"],
+        "optimal": abs(plan.balance["max_stage_ms"] - best) < 1e-6,
+    }
+
+    # the TIGHTEST feasible budget (bytes-makespan optimum by brute
+    # force): the auto-derived cap binds hardest here — the planner must
+    # still produce a byte-feasible plan, latency-optimal among the
+    # (few) assignments that fit, and packing everything on one device
+    # must be infeasible (proof the cap actually constrains)
+    binding = min_feasible_budget(stages, N_DEVICES_USED)
+    ref_best, ref_feasible = enumerate_optimum(
+        stages, N_DEVICES_USED, binding)
+    planner2 = Planner(store=store, devices=devices,
+                       hbm_budget_bytes=binding)
+    plan2 = planner2.plan(pipe, artifact=art)
+    dev_bytes = [0] * N_DEVICES_USED
+    for st in plan2.stages:
+        dev_bytes[st.device] += st.bytes
+    tight = {
+        "budget_bytes": binding,
+        "max_stage_ms": plan2.balance["max_stage_ms"],
+        "enumerated_optimum_ms": ref_best,
+        "byte_feasible": plan2.balance["byte_feasible"],
+        "device_bytes": dev_bytes,
+        "fits": all(b <= binding for b in dev_bytes),
+        "one_device_infeasible": sum(stage_bytes) > binding,
+        "optimal": (ref_best is not None
+                    and abs(plan2.balance["max_stage_ms"] - ref_best)
+                    < 1e-6),
+    }
+
+    # synthetic rejection table: the latency optimum pairs the 4.0-cost
+    # stage with the 1.0-cost stage (max 5.0), but their bytes
+    # (100 + 100) outgrow the 110 budget — the planner must REJECT it
+    # and take the best feasible assignment (max 6.0) instead
+    from nnstreamer_tpu.runtime.placement import StagePlacement
+
+    synth = [StagePlacement(k, [k], 0, c, c, "profile", bytes=b)
+             for k, c, b in zip("abcd", (4.0, 2.0, 2.0, 1.0),
+                                (100, 10, 10, 100))]
+    load, mem, feasible = Planner(devices=devices[:2])._assign(
+        synth, 2, budgets=[110, 110])
+    rejection = {
+        "max_load": max(load), "device_bytes": mem,
+        "byte_feasible": feasible,
+        # infeasible optimum 5.0 rejected, best feasible 6.0 chosen
+        "ok": feasible and abs(max(load) - 6.0) < 1e-9
+              and all(b <= 110 for b in mem),
+    }
+
+    ok = (loose["byte_feasible"] and loose["optimal"]
+          and tight["byte_feasible"] and tight["fits"]
+          and ref_feasible and tight["optimal"]
+          and tight["one_device_infeasible"] and rejection["ok"])
+    return {"ok": ok, "stage_bytes": stage_bytes,
+            "loose_budget": loose, "tight_budget": tight,
+            "infeasible_rejection": rejection}
+
+
+# ---------------------------------------------------------------------------
+# leg 3: admission overload — shed, never OOM, zero request errors
+# ---------------------------------------------------------------------------
+
+def admission_leg(n_requests: int = 300, rows: int = 4) -> dict:
+    frame = np.zeros((rows, 64), np.float32)
+    req_bytes = frame.nbytes
+    guard = obs_memory.AdmissionGuard(
+        budget_bytes=int(req_bytes * guard_capacity_requests(rows) * 2.0),
+        watermark=0.9, overhead=2.0, name="bench")
+    sched = Scheduler(fn=lambda x: x * 2.0, bucket_sizes=(rows,),
+                      max_depth=n_requests + 8, max_wait_s=0.001,
+                      name="bench-memory", memory_guard=guard)
+    completed = shed = failed = 0
+    pending = []
+    try:
+        for _ in range(n_requests):
+            try:
+                pending.append(sched.submit([frame]))
+            except MemoryPressureError:
+                shed += 1
+        for req in pending:
+            try:
+                req.result(timeout=60.0)
+                completed += 1
+            except Exception:  # noqa: BLE001 - any non-shed failure is a
+                # client-visible error and fails the gate
+                failed += 1
+    finally:
+        sched.close()
+    snap = guard.memory_bytes()
+    ok = (failed == 0 and shed > 0
+          and snap["peak_bytes"] <= guard.limit_bytes
+          and completed + shed == n_requests
+          and guard.inflight_bytes == 0)
+    return {"ok": ok, "submitted": n_requests, "completed": completed,
+            "shed_memory": shed, "failed": failed,
+            "peak_bytes": snap["peak_bytes"],
+            "limit_bytes": guard.limit_bytes,
+            "inflight_after": guard.inflight_bytes}
+
+
+def guard_capacity_requests(rows: int) -> int:
+    # sized so the flood (hundreds of requests) must shed: ~8 requests'
+    # worth of reservations fit under the watermark
+    return 8
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--frames", type=int, default=120)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: exit 1 unless every leg passes")
+    ap.add_argument("--out", default="MEMORY_r10.json")
+    args = ap.parse_args()
+    if args.smoke:
+        args.frames = min(args.frames, 80)
+
+    t0 = time.time()
+    report = {"n_devices": N_DEVICES_USED,
+              "devices_total": len(jax.devices()),
+              "frames": args.frames}
+
+    art = capture_artifact(args.frames)
+    report["artifact_memory"] = {k: v.get("total_bytes", 0)
+                                 for k, v in sorted(art.memory.items())}
+    print(f"captured memory artifact: "
+          f"{json.dumps(report['artifact_memory'], indent=1)}")
+
+    report["placement"] = placement_leg(art, args.frames)
+    p = report["placement"]
+    print(f"placement auto-cap: loose budget optimal="
+          f"{p['loose_budget']['optimal']} feasible="
+          f"{p['loose_budget']['byte_feasible']}; tight budget "
+          f"({p['tight_budget']['budget_bytes']}B) fits="
+          f"{p['tight_budget']['fits']} optimal-among-feasible="
+          f"{p['tight_budget']['optimal']}; infeasible-optimum "
+          f"rejection={p['infeasible_rejection']['ok']} -> "
+          f"{'OK' if p['ok'] else 'FAIL'}")
+
+    report["admission"] = admission_leg()
+    a = report["admission"]
+    print(f"admission overload: {a['submitted']} submitted = "
+          f"{a['completed']} completed + {a['shed_memory']} shed "
+          f"(typed), {a['failed']} errors; peak {a['peak_bytes']}B <= "
+          f"limit {a['limit_bytes']}B -> {'OK' if a['ok'] else 'FAIL'}")
+
+    report["ok"] = bool(report["placement"]["ok"]
+                        and report["admission"]["ok"])
+    report["wall_s"] = round(time.time() - t0, 2)
+    report["tail"] = {"rc": 0 if report["ok"] else 1}
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2)
+    print(f"wrote {args.out} ({report['wall_s']}s)")
+    if args.smoke and not report["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
