@@ -19,7 +19,8 @@ launch conversion), ``tools/development/nnstreamerCodeGenCustomFilter.py``
     python -m nnstreamer_tpu obs flight             # crash flight recorder
     python -m nnstreamer_tpu obs profile --launch "a ! b"  # profile artifact
     python -m nnstreamer_tpu obs slo                # SLO burn-rate status
-    python -m nnstreamer_tpu obs top --watch 2      # live text dashboard
+    python -m nnstreamer_tpu obs top --watch --interval 2  # live dashboard
+    python -m nnstreamer_tpu obs quality            # tensor health / drift
 """
 from __future__ import annotations
 
@@ -290,10 +291,19 @@ def _obs_profile(args) -> int:
 
         pipe = parse_launch(args.launch)
         obs_profile.start()
+        if args.quality:
+            # tensor health taps alongside the profiler: the emitted
+            # artifact then carries a `quality` section usable as a
+            # drift baseline (quality.set_baseline)
+            from .obs import quality as obs_quality
+
+            obs_quality.start()
         try:
             pipe.run(timeout=args.run_timeout)
         finally:
             obs_profile.stop()
+            if args.quality:
+                obs_quality.stop()
         art = obs_profile.ProfileArtifact.capture(
             pipe, model_version=args.model_version)
         out = args.out or "profile.json"
@@ -345,13 +355,19 @@ def _obs_store(args) -> int:
 
 
 def _obs_top(args) -> int:
-    """``obs top``: one-shot (default) or ``--watch N`` refreshing text
+    """``obs top``: one-shot (default) or ``--watch`` refreshing text
     dashboard of per-element rates, queue waits/depths, fused quantiles,
-    request series, and SLO burn."""
+    request series, MEMORY/QUALITY sections, and SLO burn.
+    ``--interval N`` (seconds, default 2.0) sets the refresh cadence."""
     import time
 
     from .obs import profile as obs_profile
     from .service import ControlClient, ServiceError
+
+    if args.interval <= 0:
+        print(f"error: --interval must be > 0 seconds "
+              f"(got {args.interval})", file=sys.stderr)
+        return 2
 
     def fetch() -> dict:
         if args.endpoint:
@@ -361,26 +377,33 @@ def _obs_top(args) -> int:
                 data["memory"] = client.memory().get("memory")
             except ServiceError:
                 data["memory"] = None  # pre-PR-10 serve process
+            try:
+                data["quality"] = client.quality().get("quality")
+            except ServiceError:
+                data["quality"] = None  # pre-PR-11 serve process
             return data
         from .obs import memory as obs_memory
+        from .obs import quality as obs_quality
         from .obs import slo as obs_slo
         from .runtime import placement
 
         return {"profile": obs_profile.snapshot(),
                 "slo": obs_slo.status_all(),
                 "placement": placement.snapshot_all(),
-                "memory": obs_memory.snapshot()}
+                "memory": obs_memory.snapshot(),
+                "quality": obs_quality.snapshot()}
 
     while True:
         data = fetch()
         print(obs_profile.render_top(data.get("profile", {}),
                                      data.get("slo", []),
                                      placement=data.get("placement"),
-                                     memory=data.get("memory")))
+                                     memory=data.get("memory"),
+                                     quality=data.get("quality")))
         if not args.watch:
             return 0
         try:
-            time.sleep(args.watch)
+            time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
         print()
@@ -402,10 +425,13 @@ def _cmd_obs(args) -> int:
       a profile artifact (``--out``); ``--merge``/``--diff`` operate on
       saved artifacts;
     * ``obs slo`` — SLO status (burn rates, alerting) local or remote;
-    * ``obs top`` — one-shot/``--watch`` text dashboard (incl. MEMORY);
+    * ``obs top`` — one-shot/``--watch`` text dashboard (incl. MEMORY +
+      QUALITY; ``--interval`` sets the watch cadence);
     * ``obs memory`` — device-memory accounting snapshot (stage byte
       estimates, device watermarks, queue/serving bytes) local or
       ``--endpoint``;
+    * ``obs quality`` — data-plane quality snapshot (per-edge tensor
+      health, baseline stages, drift scores) local or ``--endpoint``;
     * ``obs store`` — list the profile-artifact store; ``--prune N``
       LRU-evicts old artifacts.
     """
@@ -438,6 +464,14 @@ def _cmd_obs(args) -> int:
                 from .obs import memory as obs_memory
 
                 snap = obs_memory.snapshot()
+            print(json.dumps(snap, indent=2, default=str))
+        elif args.verb == "quality":
+            if args.endpoint:
+                snap = ControlClient(args.endpoint).quality()["quality"]
+            else:
+                from .obs import quality as obs_quality
+
+                snap = obs_quality.snapshot()
             print(json.dumps(snap, indent=2, default=str))
         elif args.verb == "store":
             return _obs_store(args)
@@ -504,7 +538,8 @@ def _cmd_service(args) -> int:
         elif verb == "swap":
             out = c.swap(args.name, args.version)
         elif verb == "canary":
-            out = c.canary(args.name, args.version, args.fraction)
+            out = c.canary(args.name, args.version, args.fraction,
+                           quality_gate=True if args.quality_gate else None)
         elif verb == "promote":
             out = c.promote(args.name)
         else:
@@ -581,6 +616,12 @@ def main(argv=None) -> int:
     p.add_argument("--launch", default=None, help="launch line (register)")
     p.add_argument("--fraction", type=float, default=0.1,
                    help="canary traffic fraction")
+    p.add_argument("--quality-gate", action="store_true",
+                   dest="quality_gate",
+                   help="canary: arm the output-quality promotion gate "
+                        "(mirrored shadow traffic + divergence check; "
+                        "promote refuses with QualityGateError on "
+                        "divergence — docs/service.md)")
     p.add_argument("--timeout", type=float, default=30.0,
                    help="drain timeout seconds")
     p.set_defaults(fn=_cmd_service)
@@ -588,11 +629,12 @@ def main(argv=None) -> int:
     p = sub.add_parser("obs", help="observability: /metrics scrape, "
                                    "flight-recorder dump, span export, "
                                    "profiler/SLO/top, memory accounting, "
+                                   "tensor quality/drift, "
                                    "artifact-store GC "
                                    "(see docs/observability.md)")
     p.add_argument("verb", choices=["metrics", "flight", "trace",
                                     "profile", "slo", "top", "memory",
-                                    "store"])
+                                    "quality", "store"])
     p.add_argument("--endpoint", default=None,
                    help="serve control endpoint URL (omit = this process)")
     p.add_argument("--last", type=int, default=64,
@@ -615,14 +657,21 @@ def main(argv=None) -> int:
     p.add_argument("--model-version", default="",
                    help="profile: model version recorded in the artifact "
                         "key")
+    p.add_argument("--quality", action="store_true",
+                   help="profile: also run the tensor health taps during "
+                        "--launch, so the artifact carries a quality "
+                        "section (a drift baseline)")
     p.add_argument("--run-timeout", type=float, default=300.0,
                    help="profile: --launch run timeout seconds")
     p.add_argument("--merge", nargs="+", metavar="ARTIFACT",
                    help="profile: merge saved artifacts into --out")
     p.add_argument("--diff", nargs=2, metavar=("A", "B"),
                    help="profile: p50/p99 deltas between two artifacts")
-    p.add_argument("--watch", type=float, default=0.0,
-                   help="top: refresh every N seconds until interrupted")
+    p.add_argument("--watch", action="store_true",
+                   help="top: keep refreshing until interrupted")
+    p.add_argument("--interval", type=float, default=2.0, metavar="SECONDS",
+                   help="top: --watch refresh interval in seconds "
+                        "(default 2.0, must be > 0)")
     p.set_defaults(fn=_cmd_obs)
 
     p = sub.add_parser("lint", help="static pipeline-graph / source lint "

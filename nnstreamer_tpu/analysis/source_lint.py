@@ -42,16 +42,21 @@ ELEMENT_HOT = {"chain", "transform", "render", "create", "_task",
                "_chain_guarded", "push", "dispatch"}
 SERVING_HOT = {"_loop", "_execute", "_admit_one", "step", "take_ready",
                "add", "_form", "next_flush_in"}
-# obs hot paths (obs/context.py, obs/flight.py, obs/profile.py): called
-# from element chains, the serving batch loop, and fused dispatches when
-# tracing is on — `record` unconditionally; the continuous profiler's
-# recording surface (observe / record_request / record_queue_wait /
-# record_fused, plus the digest insert and tracer callbacks they hit)
-# joins the same no-sync / no-silent-swallow discipline
+# obs hot paths (obs/context.py, obs/flight.py, obs/profile.py,
+# obs/quality.py): called from element chains, the serving batch loop,
+# and fused dispatches when tracing is on — `record` unconditionally;
+# the continuous profiler's recording surface (observe / record_request
+# / record_queue_wait / record_fused, plus the digest insert and tracer
+# callbacks they hit) and the quality taps' recording surface
+# (observe_reduced / fold / record_fused_outputs / observe_outputs —
+# sampled tensor-health reductions riding the same hooks) join the same
+# no-sync / no-silent-swallow discipline
 OBS_HOT = {"record", "to_meta", "from_meta", "start_span", "record_span",
            "end", "_record_finished", "_coerce_parent",
            "observe", "record_request", "record_queue_wait",
-           "record_fused", "buffer_flow", "serving_event", "add"}
+           "record_fused", "buffer_flow", "serving_event", "add",
+           "observe_reduced", "_fold", "fold", "record_fused_outputs",
+           "observe_outputs"}
 
 _HOT_BY_SCOPE = {"element": ELEMENT_HOT, "serving": SERVING_HOT,
                  "obs": OBS_HOT}

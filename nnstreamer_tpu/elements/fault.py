@@ -17,6 +17,13 @@ gated), ``seed``. Counters ride on the element: ``.stats`` dict.
 Crash modes (supervised-restart chaos): ``crash-at-buffer`` raises on
 the Nth buffer of a run, one-shot unless ``crash-repeat`` re-arms it.
 
+Numerical-fault modes (data-plane quality chaos, ``obs/quality.py``):
+``nan-at-buffer`` / ``inf-at-buffer`` poison float tensors from the Nth
+buffer on, ``scale-drift=<factor>`` silently rescales them — failures
+the stream survives but the numbers don't, which is exactly what the
+quality taps, drift scoring, and the canary quality gate must detect
+end-to-end under the chaos harness.
+
 Network-fault modes (:data:`net_chaos`, a process-global
 :class:`NetworkChaos`) extend the same harness to the tensor-query
 TRANSPORTS — the element above injects faults INSIDE a pipeline; these
@@ -205,13 +212,29 @@ class TensorFault(Element):
         "crash_repeat": Prop(False, prop_bool,
                              "re-arm the crash on every (re)start instead "
                              "of one-shot"),
+        # numerical-fault modes (data-plane quality chaos, obs/quality.py):
+        # unlike the crash modes these are SILENT failures — the pipeline
+        # keeps flowing, only the numbers go bad — exactly what the
+        # quality taps / drift scoring / canary gate must catch E2E
+        "nan_at_buffer": Prop(-1, int,
+                              "poison float tensors with NaN from this "
+                              "0-based buffer index on (-1 = never; "
+                              "copy-on-write, shapes/dtypes preserved)"),
+        "inf_at_buffer": Prop(-1, int,
+                              "poison float tensors with Inf from this "
+                              "0-based buffer index on (-1 = never)"),
+        "scale_drift": Prop(1.0, float,
+                            "multiply every float tensor by this factor "
+                            "(1.0 = off) — silent distribution-drift "
+                            "injection"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._rng = np.random.default_rng(self.props["seed"])
         self.stats = {"passed": 0, "dropped": 0, "duplicated": 0,
-                      "corrupted": 0, "delayed": 0, "crashed": 0}
+                      "corrupted": 0, "delayed": 0, "crashed": 0,
+                      "nan_injected": 0, "inf_injected": 0, "scaled": 0}
         self._buf_index = 0
         self._crash_armed = self.props["crash_at_buffer"] >= 0
 
@@ -240,6 +263,48 @@ class TensorFault(Element):
         out = Buffer(tensors).copy_metadata_from(buf)
         return out
 
+    def _numeric_faults(self, buf: Buffer, idx: int) -> Buffer:
+        """Silent numerical poisoning (copy-on-write): NaN/Inf flood a
+        deterministic 1/16 span of every FLOAT tensor from the armed
+        index on, scale-drift multiplies whole float tensors. Integer
+        tensors pass untouched (no NaN/Inf representation; a drifted
+        int distribution is the corrupt-prob mode's job)."""
+        p = self.props
+        nan_on = 0 <= p["nan_at_buffer"] <= idx
+        inf_on = 0 <= p["inf_at_buffer"] <= idx
+        scale = p["scale_drift"]
+        if not nan_on and not inf_on and scale == 1.0:
+            return buf
+        tensors = []
+        touched = False
+        for t in buf.as_numpy().tensors:
+            a = np.asarray(t)
+            if a.dtype.kind != "f":
+                tensors.append(a)
+                continue
+            a = np.array(a, copy=True)
+            if scale != 1.0:
+                a *= np.asarray(scale, dtype=a.dtype)
+            flat = a.reshape(-1)
+            span = max(1, flat.size // 16)
+            if nan_on:
+                flat[:span] = np.nan
+            if inf_on:
+                # disjoint span so both poisons land when both are armed
+                lo = span if nan_on else 0
+                flat[lo:lo + span] = np.inf
+            tensors.append(a)
+            touched = True
+        if not touched:
+            return buf
+        if nan_on:
+            self.stats["nan_injected"] += 1
+        if inf_on:
+            self.stats["inf_injected"] += 1
+        if scale != 1.0:
+            self.stats["scaled"] += 1
+        return Buffer(tensors).copy_metadata_from(buf)
+
     def chain(self, pad: Pad, buf: Buffer) -> None:
         idx = self._buf_index
         self._buf_index += 1
@@ -260,6 +325,7 @@ class TensorFault(Element):
         if r[2] < self.props["corrupt_prob"]:
             self.stats["corrupted"] += 1
             buf = self._corrupt(buf)
+        buf = self._numeric_faults(buf, idx)
         self.stats["passed"] += 1
         self.push(buf)
         if r[3] < self.props["dup_prob"]:

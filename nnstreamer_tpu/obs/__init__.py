@@ -35,16 +35,30 @@ cross-subsystem. Three pieces, one contract (near-zero cost when idle):
   ``python -m nnstreamer_tpu obs profile|top``.
 
 * :mod:`.slo` — declarative per-service objectives (p99 latency, error
-  rate, availability) evaluated from the same windowed digests with
-  multi-window burn-rate alerting: breaches record flight events,
-  export ``nns_slo_*`` gauges, and flip the bound Service to DEGRADED
-  through the existing health path.
+  rate, availability, memory pressure, output quality) evaluated from
+  the same windowed digests with multi-window burn-rate alerting:
+  breaches record flight events, export ``nns_slo_*`` gauges, and flip
+  the bound Service to DEGRADED through the existing health path.
+
+* :mod:`.quality` — the data plane's numerical health: sampled tensor
+  taps on pad hops and fused-segment outputs (NaN/Inf/zero counts,
+  moments, a log-bucket value sketch), per-edge baselines persisted in
+  the artifact's ``quality`` section, PSI drift scoring against them,
+  and the canary promotion quality gate (``QualityGate`` /
+  ``CanaryQuality`` — service/models.py refuses promotion with a typed
+  ``QualityGateError`` on divergence).
 
 See docs/observability.md for the span model, propagation rules,
-profiling/SLO semantics, and the metric name catalog.
+profiling/SLO/quality semantics, and the metric name catalog.
 """
-from . import context, flight, memory, metrics, profile, slo  # noqa: F401
+from . import context, flight, memory, metrics, profile, quality, slo  # noqa: F401
 from .memory import AdmissionGuard, MemoryAccountant  # noqa: F401
+from .quality import (  # noqa: F401
+    CanaryQuality,
+    QualityAccountant,
+    QualityGate,
+    TensorHealth,
+)
 from .context import (  # noqa: F401
     Span,
     TraceContext,
@@ -78,12 +92,16 @@ from .slo import SloEngine, SLObjective  # noqa: F401
 
 __all__ = [
     "AdmissionGuard",
+    "CanaryQuality",
     "Counter",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "MemoryAccountant",
     "MetricError",
+    "QualityAccountant",
+    "QualityGate",
+    "TensorHealth",
     "ProfileArtifact",
     "ProfileStore",
     "Profiler",
@@ -104,6 +122,7 @@ __all__ = [
     "memory",
     "metrics",
     "profile",
+    "quality",
     "record_span",
     "render",
     "slo",

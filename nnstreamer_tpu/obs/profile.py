@@ -634,11 +634,19 @@ class ProfileArtifact:
     static byte estimates under the SAME stage keys the duration scopes
     use; its merge semantics are **max-watermark** per field — a
     footprint is a high-water mark, so merged replicas report the worst
-    observed footprint, never a sum."""
+    observed footprint, never a sum.
+
+    The ``quality`` section (PR 11, :mod:`.quality`) carries per-edge
+    tensor-health cells (NaN/Inf/zero counts, moments, a log-bucket
+    value sketch) under the same keys; its merge is **additive** with
+    exact histogram merge — a health sketch is a sample population.
+    Artifacts with a quality section are the baselines
+    ``quality.set_baseline`` scores live drift against."""
 
     def __init__(self, key: dict, entries: Dict[str, Dict[str, dict]],
                  pipeline: str = "", created: Optional[float] = None,
-                 memory: Optional[Dict[str, dict]] = None):
+                 memory: Optional[Dict[str, dict]] = None,
+                 quality: Optional[Dict[str, dict]] = None):
         self.key = {"topology": str(key.get("topology", "")),
                     "caps": str(key.get("caps", "")),
                     "model_version": str(key.get("model_version", ""))}
@@ -647,6 +655,8 @@ class ProfileArtifact:
         self.entries = entries
         # memory: {stage: {"kind": str, <byte fields>, "total_bytes": int}}
         self.memory: Dict[str, dict] = dict(memory or {})
+        # quality: {stage: TensorHealth cell — obs/quality.py to_cell()}
+        self.quality: Dict[str, dict] = dict(quality or {})
         self.pipeline = pipeline
         self.created = time.time() if created is None else created
 
@@ -681,11 +691,18 @@ class ProfileArtifact:
         mem = {name[len(prefix):]: cell
                for name, cell in obs_memory.accountant()
                .stages(prefix).items()}
+        # tensor-health cells ride the same key + prefix strip, so a
+        # captured artifact doubles as a drift baseline
+        from . import quality as obs_quality
+
+        qual = {name[len(prefix):]: cell
+                for name, cell in obs_quality.accountant()
+                .stages(prefix).items()}
         return cls(
             {"topology": topology_hash(pipeline),
              "caps": _negotiated_caps(pipeline) if caps is None else caps,
              "model_version": model_version},
-            entries, pipeline=pipeline.name, memory=mem)
+            entries, pipeline=pipeline.name, memory=mem, quality=qual)
 
     # -- persistence ---------------------------------------------------------
     def to_dict(self) -> dict:
@@ -704,6 +721,8 @@ class ProfileArtifact:
             },
             "memory": {name: dict(cell)
                        for name, cell in sorted(self.memory.items())},
+            "quality": {name: dict(cell)
+                        for name, cell in sorted(self.quality.items())},
         }
 
     def save(self, path: str) -> str:
@@ -729,7 +748,9 @@ class ProfileArtifact:
         return cls(d["key"], entries, pipeline=d.get("pipeline", ""),
                    created=d.get("created"),
                    memory={str(n): dict(c)
-                           for n, c in (d.get("memory") or {}).items()})
+                           for n, c in (d.get("memory") or {}).items()},
+                   quality={str(n): dict(c)
+                            for n, c in (d.get("quality") or {}).items()})
 
     @classmethod
     def load(cls, path: str) -> "ProfileArtifact":
@@ -778,6 +799,17 @@ class ProfileArtifact:
             if any(f in mine for f in obs_memory.FIELDS):
                 mine["total_bytes"] = sum(int(mine.get(f, 0) or 0)
                                           for f in obs_memory.FIELDS)
+        # quality is additive: counts sum and the value sketches merge
+        # exactly (obs/quality.py merge_cells) — two replicas' health
+        # cells pool into the health of the pooled samples
+        from . import quality as obs_quality
+
+        for name, cell in other.quality.items():
+            mine = self.quality.get(name)
+            if mine is None:
+                self.quality[name] = dict(cell)
+            else:
+                obs_quality.merge_cells(mine, cell)
         self.created = max(self.created, other.created)
         return self
 
@@ -826,6 +858,13 @@ class ProfileArtifact:
         if self.memory:
             out["memory"] = {name: dict(cell)
                              for name, cell in sorted(self.memory.items())}
+        if self.quality:
+            out["quality"] = {
+                name: {"buffers": cell.get("buffers", 0),
+                       "elems": cell.get("elems", 0),
+                       "nan": cell.get("nan", 0),
+                       "inf": cell.get("inf", 0)}
+                for name, cell in sorted(self.quality.items())}
         return out
 
 
@@ -952,13 +991,16 @@ class ProfileStore:
 
 def render_top(profile_snap: dict, slo_status: List[dict],
                placement: Optional[List[dict]] = None,
-               memory: Optional[dict] = None) -> str:
+               memory: Optional[dict] = None,
+               quality: Optional[dict] = None) -> str:
     """The ``obs top`` one-shot/watch dashboard: per-element rates,
     queue waits + depths, fused quantiles, request series, SLO burn,
     a MEMORY section (device watermarks, stage byte estimates, queue
     occupancy — :mod:`.memory`) when a memory snapshot is supplied,
-    and — when a placement plan is installed — per-stage device
-    assignment + balance (runtime/placement.py)."""
+    a QUALITY section (per-edge tensor health + drift — :mod:`.quality`)
+    when a quality snapshot is supplied, and — when a placement plan is
+    installed — per-stage device assignment + balance
+    (runtime/placement.py)."""
     lines = [f"nns obs top — profiling "
              f"{'ON' if profile_snap.get('active') else 'off'}"]
     for plan in placement or []:
@@ -1010,6 +1052,10 @@ def render_top(profile_snap: dict, slo_status: List[dict],
         from . import memory as obs_memory
 
         lines.extend(obs_memory.render_section(memory))
+    if quality:
+        from . import quality as obs_quality
+
+        lines.extend(obs_quality.render_section(quality))
     if slo_status:
         lines.append("")
         lines.append("SLO (burn = bad-fraction / error budget)")

@@ -52,7 +52,7 @@ from . import flight as obs_flight
 from . import metrics as obs_metrics
 from . import profile as obs_profile
 
-_KINDS = ("latency", "error_rate", "availability", "memory")
+_KINDS = ("latency", "error_rate", "availability", "memory", "quality")
 
 # default multi-window pairs (short_s, long_s, burn_threshold), sized to
 # fit the profiler's default 900 s series horizon; production configs
@@ -68,13 +68,17 @@ class SLObjective:
     """One declarative objective over a request series."""
 
     name: str
-    kind: str = "latency"     # latency | error_rate | availability | memory
+    kind: str = "latency"     # latency | error_rate | availability |
+    #                           memory | quality
     series: str = ""                 # e.g. "serving:svc" / "fabric:pool"
     target: float = 0.99             # required good fraction
     threshold_s: float = 0.1         # latency: good = sample <= this;
     #                                  memory: max used-fraction (headroom
     #                                  = 1 - threshold; the engine samples
-    #                                  worst-device used/budget each tick)
+    #                                  worst-device used/budget each tick);
+    #                                  quality: max drift score (the engine
+    #                                  samples the worst per-edge PSI drift
+    #                                  each tick — obs/quality.worst_score)
     windows: Tuple[Tuple[float, float, float], ...] = DEFAULT_WINDOWS
     service: str = ""                # Service to flip DEGRADED on breach
     description: str = ""
@@ -96,6 +100,13 @@ class SLObjective:
                     f"(max used fraction), got {self.threshold_s}")
             if not self.series:
                 self.series = "memory:devices"
+        elif self.kind == "quality":
+            if self.threshold_s <= 0.0:
+                raise ValueError(
+                    f"quality objectives need threshold_s > 0 (max drift "
+                    f"score), got {self.threshold_s}")
+            if not self.series:
+                self.series = "quality:stages"
         elif not self.series:
             raise ValueError(f"objective '{self.name}' needs a series=")
         if not self.windows:
@@ -203,6 +214,8 @@ class SloEngine:
             self._sample_availability(obj, now)
         elif obj.kind == "memory":
             self._sample_memory(obj, now)
+        elif obj.kind == "quality":
+            self._sample_quality(obj, now)
         budget = max(1e-9, 1.0 - obj.target)
         windows = []
         any_pair_breach = False
@@ -249,10 +262,11 @@ class SloEngine:
         """(burn rate, bad fraction, sample count) over one window."""
         digest, ok, err = self._profiler.request_window(
             obj.series, window_s, now=now)
-        if obj.kind in ("latency", "memory"):
-            # memory samples are used-fractions: "bad" = a tick whose
-            # worst-device used/budget crossed the headroom threshold —
-            # same count_above machinery as latency over seconds
+        if obj.kind in ("latency", "memory", "quality"):
+            # memory samples are used-fractions and quality samples are
+            # drift scores: "bad" = a tick whose worst device/edge
+            # crossed the threshold — same count_above machinery as
+            # latency over seconds
             total = digest.count
             bad = digest.count_above(obj.threshold_s)
         else:
@@ -280,6 +294,23 @@ class SloEngine:
         self._profiler.record_request(obj.series,
                                       obs_memory.used_fraction(),
                                       ok=True, now=now)
+
+    def _sample_quality(self, obj: SLObjective, now: float) -> None:
+        """Quality objectives sample themselves each tick, like memory:
+        the worst per-edge drift score (obs/quality.py — fresh NaN/Inf
+        score NONFINITE_SCORE, drifted distributions their PSI vs the
+        baseline, clean or idle edges 0.0) lands in the objective's
+        series; the burn math reads threshold crossings, and recovery
+        follows automatically once fresh samples come back clean."""
+        from . import quality as obs_quality
+
+        self._profiler.record_request(
+            obj.series,
+            # per-objective consumer key: each objective owns its own
+            # fresh-sample window, so two quality objectives on one
+            # engine (or across engines) never starve each other
+            obs_quality.worst_score(consumer=f"slo:{self.name}:{obj.name}"),
+            ok=True, now=now)
 
     # -- actions -------------------------------------------------------------
     def _service(self, name: str):

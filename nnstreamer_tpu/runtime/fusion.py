@@ -50,6 +50,7 @@ from ..obs import context as obs_context
 from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
+from ..obs import quality as obs_quality
 from ..utils import trace
 from ..utils.log import logger
 from .element import Element
@@ -434,6 +435,13 @@ class FusedSegment:
         st = self.stats
         st["dispatches"] += 1
         st["total_s"] += dt
+        if obs_quality.ACTIVE and \
+                st["dispatches"] % obs_quality.SAMPLE_EVERY == 0:
+            # data-plane health tap (obs/quality.py): one small jitted
+            # reduce per sampled output tensor, device-side — the fused
+            # chain is observed without defusing and without pulling
+            # the full output to the host
+            obs_quality.record_fused_outputs(self._profile_key, outs)
         probed = st["dispatches"] % self.PROBE_EVERY == 0
         if probed:
             for o in outs:

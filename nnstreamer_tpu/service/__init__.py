@@ -61,7 +61,7 @@ from .manager import (  # noqa: F401
     ServiceSpec,
     ServiceState,
 )
-from .models import ModelSlots, SwapError  # noqa: F401
+from .models import ModelSlots, QualityGateError, SwapError  # noqa: F401
 from .supervisor import CrashReport, RestartPolicy, Supervisor  # noqa: F401
 
 __all__ = [
@@ -73,6 +73,7 @@ __all__ = [
     "HealthMonitor",
     "ModelSlots",
     "NoReplicaAvailable",
+    "QualityGateError",
     "Replica",
     "ReplicaPool",
     "ReplicaState",

@@ -24,6 +24,10 @@ Routes (JSON unless noted):
                                           (stage estimates, device
                                           watermarks, queue/serving
                                           bytes — obs/memory.py)
+    GET    /quality                       data-plane quality snapshot
+                                          (per-edge tensor health,
+                                          baseline stages, drift scores
+                                          — obs/quality.py)
     GET    /services                      list (name/state/ready/restarts)
     GET    /services/<name>               full health snapshot
     POST   /services                      register {name, launch, ...}
@@ -33,8 +37,10 @@ Routes (JSON unless noted):
     DELETE /services/<name>               unregister (stops first)
     GET    /models                        slot table
     POST   /models/<slot>/swap            {"version": v}
-    POST   /models/<slot>/canary          {"version": v, "fraction": f}
-    POST   /models/<slot>/promote
+    POST   /models/<slot>/canary          {"version": v, "fraction": f,
+                                           "quality_gate": true | {...}}
+    POST   /models/<slot>/promote         (409 QualityGateError when the
+                                          armed quality gate refuses)
     POST   /models/<slot>/cancel
 
 Errors return ``{"error": "..."}`` with 4xx/5xx.
@@ -194,6 +200,10 @@ def _make_handler(manager: ServiceManager):
                 from ..obs import memory as obs_memory
 
                 return {"memory": obs_memory.snapshot()}
+            if parts == ["quality"] and method == "GET":
+                from ..obs import quality as obs_quality
+
+                return {"quality": obs_quality.snapshot()}
             if parts == ["services"]:
                 if method == "GET":
                     return {"services": m.list()}
@@ -227,8 +237,10 @@ def _make_handler(manager: ServiceManager):
                 if verb == "swap":
                     return m.models.swap(slot, str(body["version"]))
                 if verb == "canary":
-                    return m.models.canary(slot, str(body["version"]),
-                                           float(body["fraction"]))
+                    return m.models.canary(
+                        slot, str(body["version"]),
+                        float(body["fraction"]),
+                        quality_gate=body.get("quality_gate"))
                 if verb == "promote":
                     return m.models.promote_canary(slot)
                 if verb == "cancel":
@@ -337,6 +349,11 @@ class ControlClient:
         """GET /memory — the device-memory accounting snapshot."""
         return self._call("GET", "/memory")
 
+    def quality(self) -> dict:
+        """GET /quality — the data-plane quality snapshot (per-edge
+        tensor health, baseline stages, drift scores)."""
+        return self._call("GET", "/quality")
+
     def list(self) -> dict:
         return self._call("GET", "/services")
 
@@ -369,9 +386,12 @@ class ControlClient:
         return self._call("POST", f"/models/{slot}/swap",
                           {"version": version})
 
-    def canary(self, slot: str, version: str, fraction: float) -> dict:
-        return self._call("POST", f"/models/{slot}/canary",
-                          {"version": version, "fraction": fraction})
+    def canary(self, slot: str, version: str, fraction: float,
+               quality_gate=None) -> dict:
+        body = {"version": version, "fraction": fraction}
+        if quality_gate is not None:
+            body["quality_gate"] = quality_gate
+        return self._call("POST", f"/models/{slot}/canary", body)
 
     def promote(self, slot: str) -> dict:
         return self._call("POST", f"/models/{slot}/promote", {})

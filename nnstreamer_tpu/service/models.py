@@ -38,6 +38,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..analysis.sanitizer import named_lock
+from ..obs import flight as obs_flight
+from ..obs import quality as obs_quality
 from ..registry.models import register_local_model, unregister_local_model
 from ..utils.log import logger
 
@@ -46,17 +48,38 @@ class SwapError(RuntimeError):
     """A hot swap failed and was rolled back (old version still serving)."""
 
 
+class QualityGateError(SwapError):
+    """Canary promotion refused by the output-quality gate: the
+    candidate's output sketch diverges from the primary's (or it emits
+    NaN/Inf, or it raised on mirrored live inputs). The canary stays
+    live — gather more samples, fix the model, or ``cancel_canary``."""
+
+    def __init__(self, message: str, report: Optional[dict] = None):
+        super().__init__(message)
+        self.report = report or {}
+
+
 class _CanaryBackend:
     """Deterministic fractional router between the live backend and a
     candidate. Invoke ``i`` routes to the canary when the running product
     ``floor((i+1)*f) > floor(i*f)`` — exact long-run fraction, no rng.
     Everything except ``invoke`` proxies to the primary (negotiation,
-    model info, events)."""
+    model info, events).
 
-    def __init__(self, primary, canary, fraction: float):
+    With a quality monitor attached (``canary(..., quality_gate=...)``)
+    the router also records output health into the monitor's
+    primary/canary sketches and MIRRORS a deterministic sample of
+    primary traffic through the candidate (shadow invoke: output
+    discarded, never served) — so even a tiny-fraction canary gathers
+    enough candidate samples for the promote gate, and a candidate that
+    crashes on live inputs fails the gate with zero client-visible
+    request errors."""
+
+    def __init__(self, primary, canary, fraction: float, quality=None):
         self.primary = primary
         self.canary = canary
         self.fraction = float(fraction)
+        self.quality = quality  # shared obs_quality.CanaryQuality or None
         self._lock = named_lock("CanaryBackend._lock")
         self._n = 0                 # guarded-by: _lock
         self.primary_invokes = 0    # guarded-by: _lock
@@ -74,8 +97,28 @@ class _CanaryBackend:
             return hit
 
     def invoke(self, inputs):
-        target = self.canary if self._pick_canary() else self.primary
-        return target.invoke(inputs)
+        q = self.quality
+        if self._pick_canary():
+            # routed-canary outputs are NOT recorded in the gate
+            # sketches: the router's deterministic split can correlate
+            # with input structure (alternating frame types at
+            # fraction=0.5 sends every B-frame to the canary), and
+            # sketches built over different input populations would
+            # diverge by input mix alone
+            return self.canary.invoke(inputs)
+        out = self.primary.invoke(inputs)
+        if q is not None and q.should_mirror():
+            # the gate compares ONLY mirrored pairs: both sides observe
+            # the SAME live input, so the two sketches are built over
+            # an identical input population and directly comparable
+            q.observe_primary(out)
+            try:
+                q.observe_canary(self.canary.invoke(inputs),
+                                 mirrored=True)
+            except Exception as e:  # noqa: BLE001 - a shadow failure is
+                # a GATE verdict, never a client-visible error
+                q.mirror_failed(e)
+        return out
 
     def fusion_callable(self):
         """Never traceable: per-invoke routing is the whole point. Must be
@@ -150,6 +193,8 @@ class ModelSlots:
         if canary is not None:
             version, router = canary
             out["canary"] = {"version": version, **router.routing_stats()}
+            if router.quality is not None:
+                out["canary"]["quality"] = router.quality.report()
         return out
 
     def names(self) -> List[str]:
@@ -266,10 +311,19 @@ class ModelSlots:
                 "returned no outputs")
 
     # -- canary --------------------------------------------------------------
-    def canary(self, name: str, version: str, fraction: float) -> dict:
+    def canary(self, name: str, version: str, fraction: float,
+               quality_gate=None) -> dict:
         """Route ``fraction`` of each bound filter's invokes to ``version``
         (prepared + warmed like a swap), keeping the active version on the
         rest. One canary per slot.
+
+        ``quality_gate`` arms the output-quality gate (``True`` for the
+        defaults, a dict of :class:`~..obs.quality.QualityGate` fields,
+        or a ready instance): routers then mirror a deterministic sample
+        of primary traffic through the candidate and record both sides'
+        output health, and :meth:`promote_canary` refuses with a typed
+        :class:`QualityGateError` when the candidate's output sketch
+        diverges beyond the gate (docs/service.md#canary-quality-gate).
 
         A canary is a LIVE-TRAFFIC experiment, not durable state: it lasts
         until promoted or canceled. Stopping/restarting a bound service
@@ -279,6 +333,9 @@ class ModelSlots:
         """
         if not 0.0 < fraction < 1.0:
             raise ValueError(f"canary fraction {fraction} must be in (0,1)")
+        gate = obs_quality.QualityGate.from_config(quality_gate)
+        monitor = obs_quality.CanaryQuality(gate) if gate is not None \
+            else None
         uri = self.uri(name, version)
         with self._lock:
             if self._slot(name)["canary"] is not None:
@@ -292,24 +349,52 @@ class ModelSlots:
         prepared = self._prepare_all(bound, uri, name, version,
                                      what=f"canary '{version}'")
         for el, backend in prepared:
-            router = _CanaryBackend(el.backend, backend, fraction)
+            # ONE monitor shared by every bound filter's router: the
+            # gate's verdict covers the slot, not one element
+            router = _CanaryBackend(el.backend, backend, fraction,
+                                    quality=monitor)
             el.commit_model(router, el.props["model"])  # model ref unchanged
             routers.append(router)
         with self._lock:
             self._slot(name)["canary"] = (version, routers[0])
-        logger.info("slot %s: canary %s at %.0f%% across %d filters",
-                    name, version, fraction * 100, len(routers))
+        logger.info("slot %s: canary %s at %.0f%% across %d filters%s",
+                    name, version, fraction * 100, len(routers),
+                    " (quality gate armed)" if monitor is not None else "")
         return {"slot": name, "canary": version, "fraction": fraction,
-                "filters": len(routers)}
+                "filters": len(routers),
+                "quality_gate": gate.spec() if gate is not None else None}
 
     def promote_canary(self, name: str) -> dict:
         """Canary graduates: its backend becomes the active one everywhere,
-        the old primary retires, and the slot's active version advances."""
+        the old primary retires, and the slot's active version advances.
+
+        With a quality gate armed, promotion is checked FIRST: a
+        candidate whose output sketch diverges from the primary's (PSI
+        drift, new NaN/Inf, or a mirrored-invoke crash) is refused with
+        a typed :class:`QualityGateError` — a ``quality`` flight event
+        and the ``nns_quality_gate_refusals_total`` counter record the
+        refusal, and the canary stays live for more samples or a
+        ``cancel_canary``."""
         with self._lock:
             canary = self._slot(name)["canary"]
         if canary is None:
             raise SwapError(f"slot '{name}' has no canary to promote")
-        version, _router = canary
+        version, router = canary
+        monitor = router.quality
+        if monitor is not None:
+            ok, reason, report = monitor.verdict()
+            if not ok:
+                obs_quality.GATE_REFUSALS.inc()
+                obs_flight.record(
+                    "quality", "gate_refused",
+                    {"slot": name, "version": version, "reason": reason,
+                     "divergence": report.get("divergence"),
+                     "mirrors": report.get("mirrors")})
+                logger.warning("slot %s: canary '%s' promotion REFUSED "
+                               "by quality gate: %s", name, version, reason)
+                raise QualityGateError(
+                    f"slot '{name}': canary '{version}' failed the "
+                    f"quality gate: {reason}", report=report)
         flipped = 0
         for _svc, el in self.bound_filters(name):
             router = el.backend
@@ -331,8 +416,11 @@ class ModelSlots:
             self._slot(name)["active"] = version
             self._slot(name)["canary"] = None
         self._publish(name)
-        return {"slot": name, "version": version, "promoted": True,
-                "flipped": flipped}
+        out = {"slot": name, "version": version, "promoted": True,
+               "flipped": flipped}
+        if monitor is not None:
+            out["quality"] = monitor.report()
+        return out
 
     def cancel_canary(self, name: str) -> dict:
         """Abort the canary: candidate backends close, the primary keeps

@@ -320,6 +320,16 @@ class Scheduler:
         device_s = time.monotonic() - t_start
         self.queue.observe_service_time(device_s)
         self.metrics.record_batch(batch.rows, batch.padded_rows, device_s)
+        from ..obs import quality as obs_quality
+
+        if obs_quality.ACTIVE:
+            # data-plane health tap: sampled batch-output reduction into
+            # the "serving:<scheduler>" series (one module-global check
+            # when the taps are off)
+            obs_quality.observe_outputs(
+                f"serving:{self.name}",
+                outputs if isinstance(outputs, (list, tuple))
+                else (outputs,))
         from ..utils import trace as _trace
 
         if _trace.ACTIVE:

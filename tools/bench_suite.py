@@ -18,6 +18,19 @@ through the FULL pipeline topology including aggregator + queues
 (VERDICT r3 #6; the reference's per-frame operating point,
 tensor_filter.c:366-510 invoke statistics).
 
+Bench-regression sentinel (``--diff``): run the PROFILE_r08 sentinel
+pipeline (3-stage fused 64x64x3 chain, CPU) under the continuous
+profiler, capture a ProfileArtifact, and compare it against a committed
+baseline via ``ProfileArtifact.diff`` — exit non-zero when any shared
+entry's p99 regressed beyond ``--max-p99-regress`` (best-of-two, same
+co-tenant-jitter stance as microbench_overhead). ``--out`` records the
+fresh artifact (the BENCH_r11.json trajectory point)::
+
+  python tools/bench_suite.py --diff                       # vs PROFILE_r08
+  python tools/bench_suite.py --diff --baseline BENCH_r11.json \
+      --max-p99-regress 0.5 --out BENCH_r12.json           # tight same-rig
+  python tools/bench_suite.py --diff --smoke               # CI leg
+
 Run:  python tools/bench_suite.py            (TPU when up, CPU fallback)
       BENCHS_FRAMES=64 BENCHS_BATCH=8 ...    (size knobs; CPU defaults
       are small so the whole suite finishes in a few minutes)
@@ -391,6 +404,138 @@ def _marginal_step(gen, params, prompt, S: int, reps: int):
     return max(tS - t1, 1e-9) / (S - 1), t1, tS
 
 
+# -- bench-regression sentinel (--diff) --------------------------------------
+
+# the EXACT launch line PROFILE_r08.json was captured from (named
+# elements: entry names/topology hash must line up with the baseline)
+_SENTINEL = (
+    "tensor_src name=src num-buffers={n} framerate=0 dimensions=3:64:64 "
+    "types=float32 "
+    "! tensor_transform name=stage1 mode=arithmetic option=add:1 "
+    "! tensor_transform name=stage2 mode=arithmetic option=mul:2 "
+    "! tensor_transform name=stage3 mode=arithmetic option=add:3 "
+    "! queue name=q ! tensor_sink name=out max-stored=1")
+
+#: entries with fewer samples than this on either side are not gated
+#: (a p99 over a handful of frames is noise)
+_DIFF_MIN_COUNT = 50
+
+
+def _capture_sentinel(frames: int, model_version: str):
+    from nnstreamer_tpu.obs import profile as obs_profile
+    from nnstreamer_tpu.runtime.parse import parse_launch
+
+    obs_profile.start()
+    try:
+        pipe = parse_launch(_SENTINEL.format(n=frames))
+        pipe.run(timeout=300)
+    finally:
+        obs_profile.stop()
+    art = obs_profile.ProfileArtifact.capture(
+        pipe, model_version=model_version)
+    obs_profile.reset()
+    return art
+
+
+def _regressions(baseline, fresh, max_regress: float) -> list:
+    """Shared entries whose fresh p99 exceeds baseline p99 by more than
+    ``max_regress`` (fractional). Compared by (scope, name) —
+    ``ProfileArtifact.diff`` tolerates different keys, so a new-rig run
+    diffs against the committed dev-rig artifact."""
+    out = []
+    for scope, names in baseline.diff(fresh).items():
+        for name, row in names.items():
+            a, b = row.get("a"), row.get("b")
+            if a is None or b is None:
+                continue
+            if (a["count"] < _DIFF_MIN_COUNT
+                    or b["count"] < _DIFF_MIN_COUNT):
+                continue
+            if a["p99_ms"] <= 0:
+                continue
+            frac = b["p99_ms"] / a["p99_ms"] - 1.0
+            if frac > max_regress:
+                out.append({"scope": scope, "name": name,
+                            "baseline_p99_ms": round(a["p99_ms"], 4),
+                            "fresh_p99_ms": round(b["p99_ms"], 4),
+                            "regress_frac": round(frac, 3)})
+    return out
+
+
+def diff_main(argv=None) -> int:
+    import argparse
+
+    import jax
+
+    from nnstreamer_tpu.obs import profile as obs_profile
+
+    ap = argparse.ArgumentParser(
+        description="bench-regression sentinel: fresh profiled run vs a "
+                    "committed ProfileArtifact baseline")
+    ap.add_argument("--diff", action="store_true", help="(mode marker)")
+    ap.add_argument("--baseline", default=None, metavar="ARTIFACT",
+                    help="baseline artifact (default: PROFILE_r08.json "
+                         "next to the repo root)")
+    ap.add_argument("--max-p99-regress", type=float, default=3.0,
+                    metavar="FRAC",
+                    help="fail when a shared entry's p99 exceeds the "
+                         "baseline by more than this fraction (default "
+                         "3.0 = 4x — lenient across rigs; tighten for "
+                         "same-rig trajectories)")
+    ap.add_argument("--frames", type=int, default=2000,
+                    help="sentinel frames (matches the r08 capture)")
+    ap.add_argument("--out", default=None, metavar="ARTIFACT",
+                    help="write the fresh artifact (the BENCH_r1x "
+                         "trajectory record)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI leg: fewer frames, same gate")
+    args = ap.parse_args(argv)
+
+    # the committed baselines are CPU artifacts — the sentinel must
+    # measure the same platform (same stance as microbench_overhead)
+    jax.config.update("jax_platforms", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baseline_path = args.baseline or os.path.join(repo, "PROFILE_r08.json")
+    baseline = obs_profile.ProfileArtifact.load(baseline_path)
+    frames = 600 if args.smoke and args.frames == 2000 else args.frames
+
+    fresh = None
+    regressions = []
+    # best-of-two: a co-tenant CPU spike must not fail the gate — a real
+    # regression shows on BOTH attempts (microbench_overhead stance)
+    for attempt in range(2):
+        fresh = _capture_sentinel(frames, model_version="r11")
+        regressions = _regressions(baseline, fresh,
+                                   args.max_p99_regress)
+        if not regressions:
+            break
+        _log(f"--diff attempt {attempt + 1}: {len(regressions)} "
+             f"regression(s), {'retrying' if attempt == 0 else 'final'}")
+
+    if args.out:
+        fresh.save(args.out)
+        _log(f"wrote fresh artifact {args.out}")
+    print(json.dumps({
+        "baseline": baseline_path,
+        "baseline_key": baseline.key,
+        "fresh_key": fresh.key,
+        "frames": frames,
+        "max_p99_regress": args.max_p99_regress,
+        "regressions": regressions,
+        "summary": {
+            scope: {name: row.get("delta_p99_ms")
+                    for name, row in names.items()
+                    if "delta_p99_ms" in row}
+            for scope, names in baseline.diff(fresh).items()},
+    }, indent=2))
+    if regressions:
+        _log(f"FAIL: {len(regressions)} entry(ies) regressed past "
+             f"{args.max_p99_regress * 100:.0f}% p99 on both attempts")
+        return 1
+    _log("OK: no p99 regression past the gate")
+    return 0
+
+
 def main() -> None:
     import numpy as np  # noqa: F401
 
@@ -710,6 +855,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--diff" in sys.argv[1:]:
+        rc = diff_main(sys.argv[1:])
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
     main()
     sys.stdout.flush()
     sys.stderr.flush()

@@ -32,6 +32,9 @@ per buffer). The memory accounting plane (obs/memory.py) gets the same
 leg family: with accounting stopped the fused-dispatch/filter hooks are
 one module-global check, gated <= 2%; enabled mode (one AOT lowering
 per trace generation + static-estimate records) is reported alongside.
+The data-plane quality taps (obs/quality.py) get the same leg on the
+fused device chain: taps off = one module-global check, gated <= 2%;
+taps on (sampled device-side health reductions) reported alongside.
 
 Usage:
   python tools/microbench_overhead.py [n_frames]      # full report
@@ -241,6 +244,50 @@ def memory_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     }
 
 
+def quality_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
+    """Tensor-health-tap cost on an 8-element fused DEVICE chain (the
+    taps ride the pad tracer hook AND the fused dispatch), same
+    three-state protocol and min-of-pairs gate as the tracing/profiler/
+    memory legs:
+
+    * ``baseline`` — taps never enabled in this leg's pair;
+    * ``enabled``  — ``obs.quality.start()`` (pad tracer + sampled
+      device-side reductions every SAMPLE_EVERY buffers) — REPORTED,
+      not gated;
+    * ``disabled`` — after ``stop()``: back to the one-module-global
+      check, gated at <= 2% vs its paired baseline.
+    """
+    import statistics
+
+    from nnstreamer_tpu.obs import quality as obs_quality
+
+    measure(8, max(200, n_bufs // 4), DEVICE_ELEM)  # warmup
+    baselines, disableds, enabled = [], [], None
+    for _ in range(attempts):
+        baselines.append(measure(8, n_bufs, DEVICE_ELEM))
+        obs_quality.start()
+        try:
+            if enabled is None:
+                enabled = measure(8, n_bufs, DEVICE_ELEM)
+        finally:
+            obs_quality.stop()
+            obs_quality.reset()
+        disableds.append(measure(8, n_bufs, DEVICE_ELEM))
+    ratios = [d / b for b, d in zip(baselines, disableds)]
+    baseline = min(baselines)
+    return {
+        "n_frames": n_bufs,
+        "attempts": attempts,
+        "baseline_us_per_frame": baseline * 1e6,
+        "enabled_us_per_frame": enabled * 1e6,
+        "disabled_us_per_frame": min(disableds) * 1e6,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "disabled_overhead_frac": min(ratios) - 1.0,
+        "disabled_overhead_frac_median": statistics.median(ratios) - 1.0,
+        "enabled_overhead_frac": enabled / baseline - 1.0,
+    }
+
+
 def placement_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     """Placement cost on an 8-element fused DEVICE chain: per-buffer
     steady state with a plan applied vs ``place`` off, same min-of-pairs
@@ -310,10 +357,12 @@ def main() -> None:
                 break
         placement = placement_overhead_report(n_bufs=1500, attempts=4)
         memory = memory_overhead_report(n_bufs=1500, attempts=4)
+        quality = quality_overhead_report(n_bufs=1500, attempts=4)
         best["tracing_overhead"] = tracing
         best["profiler_overhead"] = profiling
         best["placement_overhead"] = placement
         best["memory_overhead"] = memory
+        best["quality_overhead"] = quality
         print(json.dumps(best, indent=2))
         ok = best["speedup_marginal"] >= 2.0
         print(f"smoke: fused marginal speedup {best['speedup_marginal']:.1f}x "
@@ -347,13 +396,22 @@ def main() -> None:
               f"{memory['disabled_overhead_frac'] * 100:+.2f}% vs "
               f"baseline (gate <= 2%), enabled mode "
               f"{memory['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
+        qual_ok = quality["disabled_overhead_frac"] <= 0.02
+        verdict = ("OK" if qual_ok
+                   else "REGRESSION — disabled quality taps are not "
+                        "free anymore")
+        print(f"smoke: quality-taps-disabled fast path "
+              f"{quality['disabled_overhead_frac'] * 100:+.2f}% vs "
+              f"baseline (gate <= 2%), enabled mode "
+              f"{quality['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
         sys.exit(0 if ok and trc_ok and prof_ok and plc_ok and mem_ok
-                 else 1)
+                 and qual_ok else 1)
 
     n_bufs = args.n_frames
     report = {"n_frames": n_bufs, "host_chain": [], "device_chain": None,
               "tracing_overhead": None, "profiler_overhead": None,
-              "placement_overhead": None, "memory_overhead": None}
+              "placement_overhead": None, "memory_overhead": None,
+              "quality_overhead": None}
     # before any other measurement: the baseline leg requires a process
     # where tracing has never been enabled
     report["tracing_overhead"] = tracing_overhead_report(
@@ -385,6 +443,15 @@ def main() -> None:
         n_bufs=min(n_bufs, 2000))
     t = report["memory_overhead"]
     print("— memory-accounting overhead (8-element fused device chain) —")
+    print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
+          f"enabled {t['enabled_us_per_frame']:8.1f} "
+          f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
+          f"disabled {t['disabled_us_per_frame']:8.1f} "
+          f"({t['disabled_overhead_frac'] * 100:+.2f}%, gate <= 2%)")
+    report["quality_overhead"] = quality_overhead_report(
+        n_bufs=min(n_bufs, 2000))
+    t = report["quality_overhead"]
+    print("— quality-tap overhead (8-element fused device chain) —")
     print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
           f"enabled {t['enabled_us_per_frame']:8.1f} "
           f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
