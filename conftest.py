@@ -92,7 +92,8 @@ def _tsan_check(request):
 # have an explicit stop+join path now, so a survivor is a real leak
 _JOINED_THREAD_PREFIXES = (
     "svc:", "svc-http:", "serving:", "queue:", "src:", "qserver:",
-    "mqtt-broker:", "broker:", "fabric:", "slo:",
+    "mqtt-broker:", "broker:", "fabric:", "slo:", "autoscaler:",
+    "procreplica:",
 )
 
 

@@ -15,6 +15,8 @@ launch conversion), ``tools/development/nnstreamerCodeGenCustomFilter.py``
     python -m nnstreamer_tpu lint --strict nnstreamer_tpu/  # source lint
     python -m nnstreamer_tpu serve svc.json         # service control plane
     python -m nnstreamer_tpu service list           # talk to a serve process
+    python -m nnstreamer_tpu replica --stage "..." --caps "..."  # one
+                                                    # process-isolated replica
     python -m nnstreamer_tpu obs metrics            # Prometheus scrape/dump
     python -m nnstreamer_tpu obs flight             # crash flight recorder
     python -m nnstreamer_tpu obs profile --launch "a ! b"  # profile artifact
@@ -386,12 +388,14 @@ def _obs_top(args) -> int:
         from .obs import quality as obs_quality
         from .obs import slo as obs_slo
         from .runtime import placement
+        from .service import autoscaler as svc_autoscaler
 
         return {"profile": obs_profile.snapshot(),
                 "slo": obs_slo.status_all(),
                 "placement": placement.snapshot_all(),
                 "memory": obs_memory.snapshot(),
-                "quality": obs_quality.snapshot()}
+                "quality": obs_quality.snapshot(),
+                "autoscale": svc_autoscaler.snapshot_all()}
 
     while True:
         data = fetch()
@@ -399,7 +403,8 @@ def _obs_top(args) -> int:
                                      data.get("slo", []),
                                      placement=data.get("placement"),
                                      memory=data.get("memory"),
-                                     quality=data.get("quality")))
+                                     quality=data.get("quality"),
+                                     autoscale=data.get("autoscale")))
         if not args.watch:
             return 0
         try:
@@ -600,6 +605,14 @@ def main(argv=None) -> int:
     p.add_argument("--start-all", action="store_true",
                    help="start every registered service immediately")
     p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("replica", help="run ONE process-isolated query-"
+                                       "server replica (spawned by "
+                                       "ProcReplicaSet / the autoscaler; "
+                                       "see docs/autoscaling.md)")
+    from .service.procreplica import add_replica_args
+
+    add_replica_args(p)
 
     p = sub.add_parser("service", help="control verbs against a running "
                                        "serve endpoint")

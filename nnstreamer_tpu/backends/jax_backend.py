@@ -107,6 +107,30 @@ def _builtin_models() -> Dict[str, Callable[[dict], Callable]]:
         w = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
         return lambda x: (x @ w,)
 
+    def sleeper(params):
+        # a model with a KNOWN fixed service time (host callback sleeps
+        # inside the jitted computation, so it costs per INVOKE, not per
+        # trace): the deterministic capacity limiter the autoscaler
+        # load-ramp chaos/bench legs saturate — ms of real work per
+        # request without burning CPU (tools/chaos.py load-ramp)
+        import time as _time
+
+        import jax
+
+        ms = float(params.get("ms", 5.0))
+        f = float(params.get("factor", 1.0))
+
+        def one(x):
+            def host(v):
+                _time.sleep(ms / 1e3)
+                return v
+
+            y = jax.pure_callback(
+                host, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            return y * jnp.asarray(f, x.dtype)
+
+        return lambda *xs: tuple(one(x) for x in xs)
+
     return {
         "passthrough": passthrough,
         "scaler": scaler,
@@ -114,6 +138,7 @@ def _builtin_models() -> Dict[str, Callable[[dict], Callable]]:
         "average": average,
         "argmax": argmax,
         "matmul": matmul,
+        "sleeper": sleeper,
     }
 
 

@@ -365,6 +365,9 @@ def _collect_serving(reg: Registry) -> None:
     shedm = reg.counter("nns_serving_shed_memory_total",
                         "requests shed: projected memory watermark",
                         ("scheduler",))
+    shedo = reg.counter("nns_serving_shed_overload_total",
+                        "requests shed: overload guard (autoscaler at "
+                        "ceiling)", ("scheduler",))
     batches = reg.counter("nns_serving_batches_total",
                           "device batches executed", ("scheduler",))
     depth = reg.gauge("nns_serving_queue_depth",
@@ -378,8 +381,8 @@ def _collect_serving(reg: Registry) -> None:
                     ("scheduler",))
     # snapshot mirrors: repopulated from live schedulers each scrape, so
     # a garbage-collected scheduler's series disappears with it
-    for inst in (subm, comp, fail, shedf, shedd, shedm, batches, depth,
-                 occ, wait, p99):
+    for inst in (subm, comp, fail, shedf, shedd, shedm, shedo, batches,
+                 depth, occ, wait, p99):
         inst.clear()
     for name, sched in serving_metrics.iter_schedulers():
         try:
@@ -392,6 +395,7 @@ def _collect_serving(reg: Registry) -> None:
         shedf.set_total(snap.get("shed_queue_full", 0), scheduler=name)
         shedd.set_total(snap.get("shed_deadline", 0), scheduler=name)
         shedm.set_total(snap.get("shed_memory", 0), scheduler=name)
+        shedo.set_total(snap.get("shed_overload", 0), scheduler=name)
         batches.set_total(snap.get("batches", 0), scheduler=name)
         depth.set(snap.get("queue_depth", 0), scheduler=name)
         occ.set(snap.get("batch_occupancy", 0.0), scheduler=name)

@@ -43,6 +43,7 @@ HTTP endpoint + CLI: ``python -m nnstreamer_tpu serve`` /
 docs/service.md).
 """
 from .api import ControlClient, ControlServer  # noqa: F401
+from .autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401
 from .fabric import (  # noqa: F401
     FabricError,
     NoReplicaAvailable,
@@ -62,10 +63,17 @@ from .manager import (  # noqa: F401
     ServiceState,
 )
 from .models import ModelSlots, QualityGateError, SwapError  # noqa: F401
+from .procreplica import (  # noqa: F401
+    ProcReplica,
+    ProcReplicaError,
+    ProcReplicaSet,
+)
 from .supervisor import CrashReport, RestartPolicy, Supervisor  # noqa: F401
 
 __all__ = [
     "AdmissionRejected",
+    "Autoscaler",
+    "AutoscalerConfig",
     "ControlClient",
     "ControlServer",
     "CrashReport",
@@ -73,6 +81,9 @@ __all__ = [
     "HealthMonitor",
     "ModelSlots",
     "NoReplicaAvailable",
+    "ProcReplica",
+    "ProcReplicaError",
+    "ProcReplicaSet",
     "QualityGateError",
     "Replica",
     "ReplicaPool",

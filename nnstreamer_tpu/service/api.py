@@ -47,8 +47,10 @@ Errors return ``{"error": "..."}`` with 4xx/5xx.
 """
 from __future__ import annotations
 
+import http.client
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -192,10 +194,12 @@ def _make_handler(manager: ServiceManager):
                 from ..obs import profile as obs_profile
                 from ..obs import slo as obs_slo
                 from ..runtime import placement
+                from . import autoscaler as svc_autoscaler
 
                 return {"profile": obs_profile.snapshot(),
                         "slo": obs_slo.status_all(),
-                        "placement": placement.snapshot_all()}
+                        "placement": placement.snapshot_all(),
+                        "autoscale": svc_autoscaler.snapshot_all()}
             if parts == ["memory"] and method == "GET":
                 from ..obs import memory as obs_memory
 
@@ -281,50 +285,90 @@ def _make_handler(manager: ServiceManager):
 # -- client ------------------------------------------------------------------
 
 class ControlClient:
-    """Thin urllib client for the endpoint (used by the CLI verbs)."""
+    """Thin urllib client for the endpoint (used by the CLI verbs).
 
-    def __init__(self, endpoint: str, timeout: float = 60.0):
+    GET routes retry: a control endpoint restarting with its replica
+    (subprocess replicas — docs/autoscaling.md) can reset a connection
+    mid-read, and a health/metrics poll must ride that window out
+    instead of reporting a live replica dead. Retries are BOUNDED
+    (``retries``, default 2 re-attempts with a short doubling pause) and
+    idempotent-only: POST/DELETE never retry — a verb that may have
+    executed must not run twice."""
+
+    #: transient transport failures a GET may retry through: connection
+    #: refused/reset (URLError wraps ConnectionError/OSError) and an
+    #: HTTP response that died mid-read (IncompleteRead,
+    #: RemoteDisconnected — http.client exceptions)
+    _RETRY_PAUSE_S = 0.1
+
+    def __init__(self, endpoint: str, timeout: float = 60.0,
+                 retries: int = 2):
         self.endpoint = endpoint.rstrip("/")
         self.timeout = timeout
+        self.retries = max(0, int(retries))
 
     def _call(self, method: str, path: str, body: Optional[dict] = None,
               timeout: Optional[float] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            self.endpoint + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout) as resp:
-                return json.loads(resp.read().decode() or "{}")
-        except urllib.error.HTTPError as e:
+        attempts = 1 + (self.retries if method == "GET" else 0)
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            if attempt:
+                time.sleep(self._RETRY_PAUSE_S * (2 ** (attempt - 1)))
+            req = urllib.request.Request(
+                self.endpoint + path, data=data, method=method,
+                headers={"Content-Type": "application/json"})
             try:
-                payload = json.loads(e.read().decode() or "{}")
-            except Exception:  # noqa: BLE001
-                payload = {}
-            raise ServiceError(
-                payload.get("error", f"HTTP {e.code} from {path}")) from e
-        except (urllib.error.URLError, OSError) as e:
-            # connection refused / socket timeout: typed, so the CLI
-            # reports it instead of dying with a traceback
-            raise ServiceError(
-                f"control endpoint unreachable ({method} {path}): "
-                f"{getattr(e, 'reason', e)}") from e
+                with urllib.request.urlopen(
+                        req, timeout=timeout or self.timeout) as resp:
+                    return json.loads(resp.read().decode() or "{}")
+            except urllib.error.HTTPError as e:
+                # the server ANSWERED: a definitive verdict, never retried
+                try:
+                    payload = json.loads(e.read().decode() or "{}")
+                except Exception:  # noqa: BLE001
+                    payload = {}
+                raise ServiceError(
+                    payload.get("error", f"HTTP {e.code} from {path}")) from e
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                last = e
+                continue
+        # connection refused / reset / socket timeout beyond the retry
+        # budget: typed, so the CLI reports it instead of a traceback
+        raise ServiceError(
+            f"control endpoint unreachable ({method} {path}"
+            f"{f', {attempts} attempts' if attempts > 1 else ''}): "
+            f"{getattr(last, 'reason', last)}") from last
 
     # verbs
     def healthz(self) -> dict:
         return self._call("GET", "/healthz")
 
     def metrics_text(self) -> str:
-        """GET /metrics — raw Prometheus text (not JSON)."""
-        req = urllib.request.Request(self.endpoint + "/metrics")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.read().decode()
-        except (urllib.error.URLError, OSError) as e:
-            raise ServiceError(
-                f"control endpoint unreachable (GET /metrics): "
-                f"{getattr(e, 'reason', e)}") from e
+        """GET /metrics — raw Prometheus text (not JSON). Retries like
+        every other GET: a scrape must survive a replica restart window."""
+        last: Optional[BaseException] = None
+        for attempt in range(1 + self.retries):
+            if attempt:
+                time.sleep(self._RETRY_PAUSE_S * (2 ** (attempt - 1)))
+            req = urllib.request.Request(self.endpoint + "/metrics")
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as resp:
+                    return resp.read().decode()
+            except urllib.error.HTTPError as e:
+                # the server ANSWERED (HTTPError is a URLError subclass
+                # — catch it FIRST): definitive, never retried
+                raise ServiceError(
+                    f"HTTP {e.code} from /metrics") from e
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                last = e
+                continue
+        raise ServiceError(
+            f"control endpoint unreachable (GET /metrics): "
+            f"{getattr(last, 'reason', last)}") from last
 
     def flight(self, last: int = 256,
                pipeline: Optional[str] = None,

@@ -270,11 +270,16 @@ class ReplicaPool:
         self._points: List[int] = []              # guarded-by: _lock
         self._inflight_total = 0                  # guarded-by: _lock
         self._canary: Optional[Tuple[str, float, str]] = None  # guarded-by: _lock
+        # overload shed cutoff (None = disarmed): armed by the autoscaler
+        # when the replica set is at its ceiling — requests with
+        # priority >= cutoff are refused with a typed OverloadShedError
+        # BEFORE they touch the wire (docs/autoscaling.md)
+        self._shed_min_priority: Optional[int] = None  # guarded-by: _lock
         self._keyless_seq = itertools.count()
         self.stats = {"requests": 0, "retries": 0, "hedges": 0,
                       "hedge_wins": 0, "request_errors": 0,
                       "evictions": 0, "readmissions": 0,
-                      "spills": 0}                # guarded-by: _lock
+                      "spills": 0, "shed_overload": 0}  # guarded-by: _lock
         self._threads = ThreadRegistry()
         self._health_stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
@@ -433,6 +438,18 @@ class ReplicaPool:
                 continue
             self._readmit(r)
 
+    def evict(self, replica_id: str, reason: str) -> None:
+        """External eviction verdict — e.g. a subprocess replica whose
+        PROCESS exited (``procreplica.ProcReplicaSet.reap_dead``): the
+        pool must stop routing to it NOW instead of waiting for
+        ``fail_threshold`` request corpses. Unknown ids are ignored
+        (the replica may have been removed concurrently). Quarantine +
+        probed readmission proceed exactly as for internal evictions."""
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+        if replica is not None:
+            self._evict(replica, reason)
+
     def _evict(self, replica: Replica, reason: str) -> None:
         with self._lock:
             if replica.state is not ReplicaState.ACTIVE:
@@ -569,23 +586,58 @@ class ReplicaPool:
             self._inflight_total -= 1
             self._cond.notify_all()  # drain waiters watch inflight
 
+    # -- overload shedding (autoscaler at the ceiling) ------------------------
+    def set_overload_shed(self, min_priority: int) -> None:
+        """Arm graceful degradation: :meth:`request` calls with
+        ``priority >= min_priority`` (LOWER values are more important)
+        are refused immediately with a typed
+        :class:`~..serving.request.OverloadShedError` instead of joining
+        a queue that cannot drain. Armed by the autoscaler when the
+        replica set cannot grow (max replicas / no memory headroom)."""
+        with self._lock:
+            self._shed_min_priority = int(min_priority)
+
+    def clear_overload_shed(self) -> None:
+        with self._lock:
+            self._shed_min_priority = None
+
+    def overload_shed(self) -> Optional[int]:
+        """The armed priority cutoff, or None while disarmed."""
+        with self._lock:
+            return self._shed_min_priority
+
     # -- the request path ----------------------------------------------------
     def request(self, tensors, key=None, timeout: float = 5.0,
                 deadline: Optional[float] = None,
-                meta: Optional[dict] = None) -> Buffer:
+                meta: Optional[dict] = None,
+                priority: int = 0) -> Buffer:
         """Send one request through the fabric; returns the answer Buffer.
 
         ``key`` — idempotency/affinity key: same key, same replica
         (modulo load spill), and failed attempts RETRY on another
         replica. ``deadline`` (absolute ``time.monotonic()``) overrides
         ``timeout``; whatever remains is propagated to every attempt and
-        rides the frame meta. Raises :class:`NoReplicaAvailable` /
-        :class:`RequestFailed` only after the budget is exhausted."""
+        rides the frame meta. ``priority`` (lower = more important) only
+        matters while the overload guard is armed: sheddable classes
+        then fail fast with a typed error. Raises
+        :class:`NoReplicaAvailable` / :class:`RequestFailed` only after
+        the budget is exhausted."""
         if deadline is None:
             deadline = time.monotonic() + timeout
         h = self._key_hash(key)
         with self._lock:
             self.stats["requests"] += 1
+            shed_cutoff = self._shed_min_priority
+        if shed_cutoff is not None and priority >= shed_cutoff:
+            from ..serving.request import OverloadShedError
+
+            with self._lock:
+                self.stats["shed_overload"] += 1
+            raise OverloadShedError(
+                f"pool '{self.name}' at capacity: request "
+                f"(priority {priority}) shed by the overload guard "
+                f"(cutoff {shed_cutoff}) — the autoscaler cannot grow "
+                "the replica set")
         span = None
         if obs_context.TRACING:
             # root span for THIS request — or a child, when the caller
@@ -873,6 +925,7 @@ class ReplicaPool:
                            {"replica": self._canary[0],
                             "fraction": self._canary[1],
                             "version": self._canary[2]}),
+                "overload_shed": self._shed_min_priority,
                 **self.stats,
             }
         # service probes outside the pool lock (they take Service._lock)
@@ -915,18 +968,23 @@ class ServiceFabric:
         self.restart = restart
         self.pool = ReplicaPool(name, caps, **pool_kwargs)
         self._services: List = []
+        # replica ids, aligned with _services: scale_out appends with a
+        # MONOTONIC index (never reused), scale_in pops — so a regrown
+        # replica can never collide with a removed one's pool entry
+        self._rids: List[str] = []
+        self._next_index = 0
         self._started = False
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServiceFabric":
         if self._started:
             return self
-        for i in range(self.n_replicas):
-            self._spawn_replica(i)
+        for _ in range(self.n_replicas):
+            self._spawn_replica(self._next_index)
         self._started = True
         return self
 
-    def _spawn_replica(self, index: int):
+    def _spawn_replica(self, index: int, warm: bool = False):
         qid = next(_fabric_qid)
         launch = (
             f"tensor_query_serversrc name=qsrc id={qid} host={self.host} "
@@ -938,12 +996,109 @@ class ServiceFabric:
             description=f"fabric '{self.name}' replica {index}")
         svc.start()
         rid = f"{self.name}-r{index}"
+        try:
+            port = self._bound_port(svc)
+        except FabricError:
+            # the service is registered + started but NOT yet tracked in
+            # _services — unregister it here or stop() can never reach it
+            try:
+                self.manager.unregister(svc.name)
+            except Exception:  # noqa: BLE001 - surface the bind failure
+                logger.exception("fabric %s: unregister of unbound replica "
+                                 "%s failed", self.name, svc.name)
+            raise
+        if warm:
+            self._warm_replica(port)
         self._services.append(svc)
+        self._rids.append(rid)
+        self._next_index = max(self._next_index, index + 1)
         self.pool.add_endpoint(
-            self.host, self._bound_port(svc), replica_id=rid, service=svc,
+            self.host, port, replica_id=rid, service=svc,
             resolver=lambda s=svc: (self.host,
                                     self._bound_port(s, timeout=1.0)))
         return svc
+
+    def _warm_replica(self, port: int, timeout: float = 60.0) -> None:
+        """One zero-tensor inference through the query wire BEFORE the
+        replica joins the ring, so a scaled-out replica never serves its
+        jit compile to a live request (the subprocess runner's
+        self-warmup, in-process edition). Flexible caps skip; a warmup
+        failure only logs — the replica still joins and warms on first
+        traffic, which is the pre-warm behavior."""
+        import numpy as np
+
+        from ..core import Buffer
+        from ..core.caps import tensors_info_from_caps
+        from ..query.client import QueryClient
+
+        try:
+            info = tensors_info_from_caps(self.pool.caps)
+            zeros = [np.zeros(tuple(s.shape), dtype=s.dtype.np_dtype)
+                     for s in info.specs]
+        except Exception:  # noqa: BLE001 - flexible/partial caps
+            return
+        try:
+            client = QueryClient(self.host, port, timeout=timeout)
+            try:
+                client.connect(self.pool.caps)
+                client.request(Buffer(zeros), timeout=timeout)
+            finally:
+                client.close()
+        except Exception as e:  # noqa: BLE001 - warm is best-effort
+            logger.warning("fabric %s: replica warmup on port %d failed "
+                           "(%s); it will warm on first traffic",
+                           self.name, port, e)
+
+    # -- elastic scaling (autoscaler actuation) -------------------------------
+    def replica_count(self) -> int:
+        return len(self._services)
+
+    def scale_out(self) -> str:
+        """Grow the replica set by one: register + start a fresh replica
+        service, WARM it through the query wire, and only then admit it
+        to the ring (a replica added under load must take load, not
+        serve its own cold start). Returns the new replica id."""
+        index = self._next_index
+        self._spawn_replica(index, warm=True)
+        logger.info("fabric %s: scaled OUT to %d replicas", self.name,
+                    len(self._services))
+        return self._rids[-1]
+
+    def scale_in(self, drain_timeout_s: float = 10.0) -> str:
+        """Shrink by one: drain the newest non-canary replica (no new
+        routes, in-flight flushes), remove it from the ring, and
+        unregister its service. Returns the removed replica id."""
+        if not self._services:
+            raise FabricError(f"fabric '{self.name}': no replica to remove")
+        canary = self.pool.snapshot().get("canary")
+        canary_rid = canary["replica"] if canary else None
+        idx = len(self._services) - 1
+        if self._rids[idx] == canary_rid:
+            if idx == 0:
+                raise FabricError(
+                    f"fabric '{self.name}': only the canary replica is "
+                    "left — cancel or promote the canary before scaling in")
+            idx -= 1
+        rid = self._rids[idx]
+        svc = self._services[idx]
+        try:
+            self.pool.drain_replica(rid, timeout=drain_timeout_s)
+        except FabricError:
+            # a drain timeout must not park the replica half-removed;
+            # remove() below closes its links and retries fail over
+            logger.warning("fabric %s: scale-in drain of %s timed out; "
+                           "removing anyway", self.name, rid)
+        self.pool.remove(rid)
+        del self._services[idx]
+        del self._rids[idx]
+        try:
+            self.manager.unregister(svc.name)
+        except Exception:  # noqa: BLE001 - the ring is already consistent
+            logger.exception("fabric %s: unregister %s failed", self.name,
+                             svc.name)
+        logger.info("fabric %s: scaled IN to %d replicas (removed %s)",
+                    self.name, len(self._services), rid)
+        return rid
 
     def _bound_port(self, svc, timeout: float = 5.0) -> int:
         """The replica's CURRENT listen port (ephemeral: changes across
@@ -978,6 +1133,7 @@ class ServiceFabric:
                 logger.exception("fabric %s: unregister %s failed",
                                  self.name, svc.name)
         self._services = []
+        self._rids = []
         self._started = False
 
     # -- chaos hooks ---------------------------------------------------------
@@ -1067,7 +1223,7 @@ class ServiceFabric:
 
     def _rid_for(self, svc) -> str:
         try:
-            return f"{self.name}-r{self._services.index(svc)}"
+            return self._rids[self._services.index(svc)]
         except ValueError:
             raise FabricError(f"fabric '{self.name}': unknown service "
                               f"{svc.name}")

@@ -32,6 +32,7 @@ from .queue import RequestQueue  # noqa: F401
 from .request import (  # noqa: F401
     AdmissionError,
     DeadlineExceededError,
+    OverloadShedError,
     QueueFullError,
     Request,
     SchedulerClosedError,

@@ -77,6 +77,7 @@ class ServingMetrics:
         self.shed_queue_full = 0
         self.shed_deadline = 0
         self.shed_memory = 0
+        self.shed_overload = 0
         self.batches = 0
         self.batched_rows = 0      # real rows executed
         self.padded_rows = 0       # rows incl. bucket padding
@@ -95,10 +96,13 @@ class ServingMetrics:
             self.submitted += n
 
     def record_shed(self, deadline: bool = False,
-                    memory: bool = False) -> None:
+                    memory: bool = False,
+                    overload: bool = False) -> None:
         with self._lock:
             if memory:
                 self.shed_memory += 1
+            elif overload:
+                self.shed_overload += 1
             elif deadline:
                 self.shed_deadline += 1
             else:
@@ -155,6 +159,7 @@ class ServingMetrics:
                 "shed_queue_full": self.shed_queue_full,
                 "shed_deadline": self.shed_deadline,
                 "shed_memory": self.shed_memory,
+                "shed_overload": self.shed_overload,
                 "batches": self.batches,
                 "decode_steps": self.decode_steps,
                 "retired_early": self.retired_early,

@@ -10,7 +10,12 @@ serve within budget. Three admission gates, each a typed error
 * predictive — estimated wait (EWMA of batch service time × queue depth
   ahead, normalized by batch capacity) exceeds the request's remaining
   deadline budget → :class:`DeadlineExceededError` NOW instead of
-  executing a result nobody will read.
+  executing a result nobody will read;
+* overload — when an external controller (the autoscaler at its replica
+  ceiling — ``service/autoscaler.py``) has armed
+  :meth:`~RequestQueue.set_overload`, requests whose priority is at or
+  past the cutoff → :class:`OverloadShedError` (graceful degradation:
+  the lowest classes shed typed, the rest keep their p99).
 
 Expired requests still in the queue are shed at pop time (they are
 completed with the typed error, never silently dropped).
@@ -23,7 +28,12 @@ import time
 from typing import List, Optional, Tuple
 
 from ..analysis.sanitizer import named_condition, named_lock
-from .request import DeadlineExceededError, QueueFullError, Request
+from .request import (
+    DeadlineExceededError,
+    OverloadShedError,
+    QueueFullError,
+    Request,
+)
 
 _tiebreak = itertools.count()
 
@@ -51,8 +61,29 @@ class RequestQueue:
         self._heap: List[Tuple[int, int, Request]] = []  # guarded-by: _lock
         # EWMA of one batch's service time
         self._service_ewma_s = 0.0  # guarded-by: _lock
+        # overload cutoff: requests with priority >= this are refused
+        # (None = disarmed). Armed/cleared by the autoscaler when the
+        # replica set cannot grow past the ceiling.
+        self._overload_min_priority: Optional[int] = None  # guarded-by: _lock
         self.shed_full = 0      # guarded-by: _lock
         self.shed_deadline = 0  # guarded-by: _lock
+        self.shed_overload = 0  # guarded-by: _lock
+
+    # -- overload hook -------------------------------------------------------
+    def set_overload(self, min_priority: int) -> None:
+        """Arm graceful shedding: admission refuses requests with
+        ``priority >= min_priority`` (LOWER priority values are more
+        important) with a typed :class:`OverloadShedError`."""
+        with self._lock:
+            self._overload_min_priority = int(min_priority)
+
+    def clear_overload(self) -> None:
+        with self._lock:
+            self._overload_min_priority = None
+
+    def overload_min_priority(self) -> Optional[int]:
+        with self._lock:
+            return self._overload_min_priority
 
     # -- service-time feedback ----------------------------------------------
     def observe_service_time(self, batch_s: float) -> None:
@@ -86,7 +117,14 @@ class RequestQueue:
         now = time.monotonic()
         with self._lock:
             err: Optional[Exception] = None
-            if len(self._heap) >= self.max_depth:
+            if (self._overload_min_priority is not None
+                    and req.priority >= self._overload_min_priority):
+                self.shed_overload += 1
+                err = OverloadShedError(
+                    f"serving at capacity: request {req.id} "
+                    f"(priority {req.priority}) shed by the overload guard "
+                    f"(cutoff {self._overload_min_priority})")
+            elif len(self._heap) >= self.max_depth:
                 self.shed_full += 1
                 err = QueueFullError(
                     f"serving queue at max_depth={self.max_depth}; "

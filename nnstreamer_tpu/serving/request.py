@@ -50,6 +50,16 @@ class MemoryPressureError(AdmissionError):
     and failing every coalesced neighbor with it."""
 
 
+class OverloadShedError(AdmissionError):
+    """The serving plane is at capacity and cannot grow (replica ceiling
+    reached or memory headroom forbids another replica —
+    ``service/autoscaler.py``), so requests at or below the armed
+    priority cutoff are refused immediately with THIS typed error
+    instead of queueing into a p99 collapse for everyone. Higher-priority
+    traffic keeps flowing; callers see a deliberate shed they can back
+    off from, never a timeout."""
+
+
 class SchedulerClosedError(ServingError):
     """Submission after ``close()``."""
 

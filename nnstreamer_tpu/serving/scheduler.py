@@ -234,11 +234,12 @@ class Scheduler:
         try:
             self.queue.put(req)
         except AdmissionError as e:
-            from .request import DeadlineExceededError
+            from .request import DeadlineExceededError, OverloadShedError
 
             self._release_mem(req)
             self.metrics.record_shed(
-                deadline=isinstance(e, DeadlineExceededError))
+                deadline=isinstance(e, DeadlineExceededError),
+                overload=isinstance(e, OverloadShedError))
             raise
         self._fail_if_closed_after_put(req)
         return req
@@ -459,11 +460,12 @@ class DecodeScheduler:
         try:
             self.queue.put(req)
         except AdmissionError as e:
-            from .request import DeadlineExceededError
+            from .request import DeadlineExceededError, OverloadShedError
 
             self._release_mem(req)
             self.metrics.record_shed(
-                deadline=isinstance(e, DeadlineExceededError))
+                deadline=isinstance(e, DeadlineExceededError),
+                overload=isinstance(e, OverloadShedError))
             raise
         self._fail_if_closed_after_put(req)
         return req

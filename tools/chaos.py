@@ -23,11 +23,21 @@ Scenarios
                    bounded by the healthy replicas.
 ``rolling-swap``   registry:// hot swap rolled across all replicas
                    (drain → flip → readmit each) under traffic.
+``load-ramp``      offered load ramps up then back down against an
+                   AUTOSCALED fabric (service/autoscaler.py): the
+                   replica count must track load in BOTH directions,
+                   steady-state p99 after scale-out must hold within
+                   the SLO, and the whole ramp costs zero errors.
+``proc-replica-kill``  SIGKILL a live SUBPROCESS replica
+                   (service/procreplica.py) under traffic: evict →
+                   autoscaler respawn → readmit, zero client-visible
+                   errors.
 
 Usage::
 
     python tools/chaos.py                 # all scenarios, JSON report
-    python tools/chaos.py --smoke         # CI: replica-kill + conn-kill
+    python tools/chaos.py --smoke         # CI: replica-kill + conn-kill +
+                                          # load-ramp + proc-replica-kill
     python tools/chaos.py --scenario partition
     NNS_TSAN=1 python tools/chaos.py      # under the lock sanitizer
 
@@ -257,6 +267,187 @@ def slow_replica(mgr, duration: float) -> dict:
         fab.stop()
 
 
+@_scenario("load-ramp")
+def load_ramp(mgr, duration: float) -> dict:
+    """Closed-loop autoscaling gate: a 1-replica fabric (sleeper model —
+    fixed ms of REAL service time per request, so capacity is
+    deterministic) takes a low → high → low load ramp. The autoscaler
+    must grow the replica set while the short burn window is hot, hold
+    post-scale-out p99 within the SLO, and shrink back to min once
+    every window cools — all at zero client-visible request errors."""
+    import numpy as np
+
+    from nnstreamer_tpu.service import Autoscaler, AutoscalerConfig
+    from nnstreamer_tpu.service.fabric import ServiceFabric
+
+    slo_s = 0.25
+    fab = ServiceFabric(
+        mgr, "chaos-ramp",
+        "tensor_filter framework=jax model=builtin://sleeper?ms=40&factor=2",
+        CAPS, replicas=1, quarantine_base_s=0.2, health_poll_s=0.05)
+    fab.start()
+    cfg = AutoscalerConfig(
+        min_replicas=1, max_replicas=3,
+        latency_slo_s=0.1, target=0.9,
+        short_window_s=2.0, long_window_s=6.0,
+        scale_out_burn=3.0, scale_in_burn=0.8, min_samples=6,
+        scale_out_cooldown_s=1.5, scale_in_cooldown_s=3.0,
+        tick_s=0.25)
+    scaler = Autoscaler(fab, cfg, name="chaos-ramp")
+    lat_lock = threading.Lock()
+    latencies: list = []      # (t_done, seconds)
+    errors: list = []
+    stop_evt = threading.Event()
+    high_evt = threading.Event()
+
+    def worker(i: int, low_period: float) -> None:
+        n = 0
+        while not stop_evt.is_set():
+            if i > 0 and not high_evt.is_set():
+                # extra workers only push during the high phase
+                high_evt.wait(0.1)
+                continue
+            n += 1
+            t0 = time.monotonic()
+            try:
+                fab.request([np.full(4, float(n % 13), np.float32)],
+                            key=f"w{i}:{n}", timeout=10.0)
+                with lat_lock:
+                    latencies.append((time.monotonic(),
+                                      time.monotonic() - t0))
+            except Exception as e:  # noqa: BLE001 - every error gates
+                with lat_lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+            if not high_evt.is_set():
+                stop_evt.wait(low_period)
+
+    try:
+        _warmup(fab, 4)
+        scaler.start()
+        workers = [threading.Thread(target=worker, args=(i, 0.06),
+                                    name=f"fabric:ramp:{i}", daemon=True)
+                   for i in range(8)]
+        max_seen = 1
+        for t in workers:
+            t.start()
+
+        def watch(seconds: float) -> int:
+            nonlocal max_seen
+            deadline = time.monotonic() + seconds
+            while time.monotonic() < deadline:
+                max_seen = max(max_seen, fab.replica_count())
+                time.sleep(0.1)
+            return fab.replica_count()
+
+        low1 = max(3.0, duration)
+        high = max(9.0, 2.0 * duration)
+        watch(low1)                      # phase 1: 1 worker trickle
+        high_evt.set()                   # phase 2: all 8, closed loop
+        t_high0 = time.monotonic()
+        watch(high)
+        t_high1 = time.monotonic()
+        high_evt.clear()                 # phase 3: back to the trickle
+        # scale-in needs the LONG window to cool + the cooldown to pass
+        scaled_in_to_min = False
+        deadline = time.monotonic() + max(25.0, cfg.long_window_s
+                                          + 4 * cfg.scale_in_cooldown_s)
+        while time.monotonic() < deadline:
+            if fab.replica_count() <= cfg.min_replicas:
+                scaled_in_to_min = True
+                break
+            time.sleep(0.2)
+        stop_evt.set()
+        high_evt.set()  # unblock parked extra workers so they can exit
+        for t in workers:
+            t.join(timeout=12.0)
+        with lat_lock:
+            # steady-state AFTER scale-out: the last 40% of the high
+            # phase (the ramp transient before capacity arrived is what
+            # TRIGGERED the scaling, not what the gate judges)
+            t_late = t_high1 - 0.4 * (t_high1 - t_high0)
+            late = sorted(s for (td, s) in latencies
+                          if t_late <= td <= t_high1)
+            all_n = len(latencies)
+            errs = list(errors)
+        p99_late = late[int(0.99 * (len(late) - 1))] if late else 0.0
+        snap = scaler.snapshot()
+        return {"requests": all_n, "errors": errs,
+                "max_replicas_seen": max_seen,
+                "final_replicas": fab.replica_count(),
+                "scaled_in_to_min": scaled_in_to_min,
+                "scale_out_events": snap["scale_out"],
+                "scale_in_events": snap["scale_in"],
+                "p99_steady_high_s": round(p99_late, 4),
+                "slo_s": slo_s,
+                "samples_steady_high": len(late),
+                "ok": (not errs and all_n > 0
+                       and max_seen >= 2
+                       and snap["scale_out"] >= 1
+                       and snap["scale_in"] >= 1
+                       and scaled_in_to_min
+                       and len(late) > 10
+                       and p99_late <= slo_s)}
+    finally:
+        scaler.stop()
+        stop_evt.set()
+        high_evt.set()
+        fab.stop()
+
+
+@_scenario("proc-replica-kill")
+def proc_replica_kill(mgr, duration: float) -> dict:
+    """SIGKILL a live SUBPROCESS replica under traffic: the pool must
+    evict it the moment its exit is observed, the autoscaler must
+    respawn a fresh process under the same ring identity with backoff,
+    and the pool must readmit it — zero client-visible errors while
+    retries mask the whole window. (``mgr`` is unused: subprocess
+    replicas own their manager in their own interpreter.)"""
+    from nnstreamer_tpu.service import Autoscaler, AutoscalerConfig
+    from nnstreamer_tpu.service.procreplica import ProcReplicaSet
+
+    ps = ProcReplicaSet(
+        "chaos-proc", "tensor_filter framework=jax model=registry://chaos",
+        CAPS, replicas=2,
+        models={"chaos": {"versions": {"1": "builtin://scaler?factor=2"},
+                          "active": "1"}},
+        quarantine_base_s=0.2, health_poll_s=0.05)
+    cfg = AutoscalerConfig(
+        min_replicas=2, max_replicas=2, tick_s=0.2,
+        respawn_backoff_base_s=0.3, max_respawns=4,
+        scale_out_cooldown_s=60.0, scale_in_cooldown_s=60.0)
+    scaler = Autoscaler(ps, cfg, name="chaos-proc")
+    try:
+        ps.start()
+        _warmup(ps, 4)
+        scaler.start()
+        with Traffic(ps, timeout=10.0) as tr:
+            time.sleep(duration / 2)
+            killed = ps.kill_replica(0)
+            evicted = _wait_counter(ps.pool, "evictions", 1)
+            # autoscaler tick: reap -> respawn (fresh pid, new port)
+            deadline = time.monotonic() + 60.0
+            respawned = 0
+            while time.monotonic() < deadline and not respawned:
+                respawned = scaler.snapshot()["respawns"]
+                time.sleep(0.1)
+            readmitted = _wait_counter(ps.pool, "readmissions", 1,
+                                       timeout=20.0)
+            time.sleep(duration / 2)
+        snap = ps.snapshot()
+        procs_alive = sum(1 for p in snap["processes"] if p["alive"])
+        return {"requests": tr.ok, "errors": tr.errors,
+                "killed": killed, "evictions": evicted,
+                "respawns": respawned, "readmissions": readmitted,
+                "processes_alive": procs_alive,
+                "retries": snap["retries"],
+                "ok": (not tr.errors and tr.ok > 0 and evicted >= 1
+                       and respawned >= 1 and readmitted >= 1
+                       and procs_alive == 2)}
+    finally:
+        scaler.stop()
+        ps.stop()
+
+
 @_scenario("rolling-swap")
 def rolling_swap(mgr, duration: float) -> dict:
     """Roll the model slot across all replicas under traffic; zero
@@ -331,7 +522,8 @@ def main() -> int:
 
         sanitizer.enable(hold_warn_s=5.0)
     if args.smoke:
-        scenarios = ["replica-kill", "conn-kill"]
+        scenarios = ["replica-kill", "conn-kill", "load-ramp",
+                     "proc-replica-kill"]
         duration = args.duration or 2.0
     elif args.scenario:
         scenarios = [args.scenario]
