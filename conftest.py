@@ -93,7 +93,7 @@ def _tsan_check(request):
 _JOINED_THREAD_PREFIXES = (
     "svc:", "svc-http:", "serving:", "queue:", "src:", "qserver:",
     "mqtt-broker:", "broker:", "fabric:", "slo:", "autoscaler:",
-    "procreplica:",
+    "procreplica:", "fleet:",
 )
 
 

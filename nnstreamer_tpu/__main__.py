@@ -23,12 +23,15 @@ launch conversion), ``tools/development/nnstreamerCodeGenCustomFilter.py``
     python -m nnstreamer_tpu obs slo                # SLO burn-rate status
     python -m nnstreamer_tpu obs top --watch --interval 2  # live dashboard
     python -m nnstreamer_tpu obs quality            # tensor health / drift
+    python -m nnstreamer_tpu obs fleet              # fleet-merged planes
+    python -m nnstreamer_tpu obs flight --follow --fleet   # merged tail
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+from typing import Optional
 
 
 def _cmd_launch(args) -> int:
@@ -383,7 +386,12 @@ def _obs_top(args) -> int:
                 data["quality"] = client.quality().get("quality")
             except ServiceError:
                 data["quality"] = None  # pre-PR-11 serve process
+            try:
+                data["fleet"] = client.fleet().get("fleet")
+            except ServiceError:
+                data["fleet"] = None  # pre-PR-13 serve process
             return data
+        from .obs import fleet as obs_fleet
         from .obs import memory as obs_memory
         from .obs import quality as obs_quality
         from .obs import slo as obs_slo
@@ -395,7 +403,8 @@ def _obs_top(args) -> int:
                 "placement": placement.snapshot_all(),
                 "memory": obs_memory.snapshot(),
                 "quality": obs_quality.snapshot(),
-                "autoscale": svc_autoscaler.snapshot_all()}
+                "autoscale": svc_autoscaler.snapshot_all(),
+                "fleet": obs_fleet.snapshot_all()}
 
     while True:
         data = fetch()
@@ -404,7 +413,8 @@ def _obs_top(args) -> int:
                                      placement=data.get("placement"),
                                      memory=data.get("memory"),
                                      quality=data.get("quality"),
-                                     autoscale=data.get("autoscale")))
+                                     autoscale=data.get("autoscale"),
+                                     fleet=data.get("fleet")))
         if not args.watch:
             return 0
         try:
@@ -412,6 +422,93 @@ def _obs_top(args) -> int:
         except KeyboardInterrupt:
             return 0
         print()
+
+
+def _follow_flight(fetch, interval: float, max_polls: Optional[int] = None,
+                   out=None) -> int:
+    """The ``obs flight --follow`` tail loop: ``fetch(after)`` returns
+    (events, new_cursor); each new event prints as one JSON line.
+    ``max_polls`` bounds the loop (tests); None = until interrupted."""
+    import time
+
+    out = out if out is not None else sys.stdout
+    cursor = None
+    polls = 0
+    while max_polls is None or polls < max_polls:
+        if polls:
+            try:
+                time.sleep(interval)
+            except KeyboardInterrupt:
+                return 0
+        polls += 1
+        try:
+            events, cursor = fetch(cursor)
+        except KeyboardInterrupt:
+            return 0
+        for ev in events:
+            print(json.dumps(ev, default=str), file=out, flush=True)
+    return 0
+
+
+def _obs_flight(args) -> int:
+    """``obs flight``: one-shot dump, or ``--follow`` tail mode (poll
+    with a seq cursor, print only NEW events as JSON lines). ``--fleet``
+    follows the fleet-MERGED stream (replica-tagged, interleaved by
+    timestamp) instead of one process's recorder."""
+    from .service import ControlClient, ServiceError
+
+    if args.interval <= 0:
+        print(f"error: --interval must be > 0 seconds "
+              f"(got {args.interval})", file=sys.stderr)
+        return 2
+
+    def fetch(cursor):
+        # a CURSORED pull must not cap below the ring size: the cursor
+        # still advances to the newest seq, so a burst bigger than
+        # --last would otherwise be silently skipped by the tail.
+        # --last only positions the FIRST poll (and one-shot dumps).
+        last = args.last if cursor is None else 1_000_000
+        if args.endpoint:
+            client = ControlClient(args.endpoint)
+            if args.fleet:
+                doc = client.fleet_flight(
+                    last=last, after=cursor,
+                    category=args.category, pipeline=args.pipeline)
+                events = doc["events"]
+                key = "fleet_seq"
+            else:
+                events = client.flight(
+                    last=last, pipeline=args.pipeline,
+                    category=args.category, after=cursor)["events"]
+                key = "seq"
+        elif args.fleet:
+            from .obs import fleet as obs_fleet
+
+            v = obs_fleet.view()
+            if v is None:
+                raise ServiceError("no live fleet view in this process "
+                                   "(use --endpoint against a serve "
+                                   "process that runs one)")
+            events = v.flight(last=last, after=cursor,
+                              category=args.category,
+                              pipeline=args.pipeline)
+            key = "fleet_seq"
+        else:
+            from .obs import flight as obs_flight
+
+            events = obs_flight.dump(last=last,
+                                     pipeline=args.pipeline,
+                                     category=args.category, after=cursor)
+            key = "seq"
+        if events:
+            cursor = max(ev[key] for ev in events)
+        return events, cursor
+
+    if args.follow:
+        return _follow_flight(fetch, args.interval)
+    events, _cursor = fetch(None)
+    print(json.dumps(events, indent=2, default=str))
+    return 0
 
 
 def _cmd_obs(args) -> int:
@@ -422,7 +519,12 @@ def _cmd_obs(args) -> int:
       (useful under ``python -c``/tests; a fresh CLI process has no
       pipelines, so local mode mostly shows the obs plane itself);
     * ``obs flight`` — the crash flight recorder's recent events
-      (``--pipeline`` filters on the event's pipeline tag);
+      (``--pipeline`` filters on the event's pipeline tag; ``--follow``
+      tails with a seq cursor, ``--fleet`` reads the fleet-merged
+      replica-tagged stream);
+    * ``obs fleet`` — fleet-view snapshots: per-replica scrape health
+      plus the merged profile/memory/quality planes (obs/fleet.py),
+      local or ``--endpoint``;
     * ``obs trace`` — export recorded spans as Perfetto/chrome-trace
       JSON (``--out``, default nns_spans.json);
     * ``obs profile`` — continuous-profiler snapshot (local or
@@ -451,17 +553,15 @@ def _cmd_obs(args) -> int:
 
                 print(obs_metrics.render(), end="")
         elif args.verb == "flight":
+            return _obs_flight(args)
+        elif args.verb == "fleet":
             if args.endpoint:
-                events = ControlClient(args.endpoint).flight(
-                    last=args.last, pipeline=args.pipeline,
-                    category=args.category)["events"]
+                snaps = ControlClient(args.endpoint).fleet()["fleet"]
             else:
-                from .obs import flight as obs_flight
+                from .obs import fleet as obs_fleet
 
-                events = obs_flight.dump(last=args.last,
-                                         pipeline=args.pipeline,
-                                         category=args.category)
-            print(json.dumps(events, indent=2, default=str))
+                snaps = obs_fleet.snapshot_all()
+            print(json.dumps(snaps, indent=2, default=str))
         elif args.verb == "memory":
             if args.endpoint:
                 snap = ControlClient(args.endpoint).memory()["memory"]
@@ -647,7 +747,7 @@ def main(argv=None) -> int:
                                    "(see docs/observability.md)")
     p.add_argument("verb", choices=["metrics", "flight", "trace",
                                     "profile", "slo", "top", "memory",
-                                    "quality", "store"])
+                                    "quality", "store", "fleet"])
     p.add_argument("--endpoint", default=None,
                    help="serve control endpoint URL (omit = this process)")
     p.add_argument("--last", type=int, default=64,
@@ -657,6 +757,14 @@ def main(argv=None) -> int:
     p.add_argument("--category", default=None,
                    help="flight: only events of this kind (memory, slo, "
                         "pipeline, serving, ...)")
+    p.add_argument("--follow", action="store_true",
+                   help="flight: tail mode — poll with a seq cursor and "
+                        "print only NEW events (JSON lines) until "
+                        "interrupted")
+    p.add_argument("--fleet", action="store_true",
+                   help="flight: read the fleet-MERGED event stream "
+                        "(replica-tagged, timestamp-interleaved — "
+                        "obs/fleet.py) instead of one process's recorder")
     p.add_argument("--root", default=None,
                    help="store: artifact directory (default "
                         "NNS_PROFILE_STORE)")

@@ -48,10 +48,32 @@ cross-subsystem. Three pieces, one contract (near-zero cost when idle):
   ``CanaryQuality`` — service/models.py refuses promotion with a typed
   ``QualityGateError`` on divergence).
 
+* :mod:`.fleet` — the cross-PROCESS join: a :class:`~.fleet.FleetView`
+  scrapes every subprocess replica's control endpoint on a tick thread
+  and merges the planes (digests exactly, memory max-watermark, quality
+  additively, flight by timestamp), stitches distributed traces across
+  the process boundary into one Perfetto document, and serves the SLO
+  engine / autoscaler fleet-merged burn windows. ``nns_fleet_*``
+  gauges, ``GET /fleet``, ``obs fleet``. :mod:`.promtext` is the shared
+  Prometheus text-format parser the scraper and the benches read
+  ``GET /metrics`` with.
+
 See docs/observability.md for the span model, propagation rules,
-profiling/SLO/quality semantics, and the metric name catalog.
+profiling/SLO/quality semantics, the fleet scrape/merge contract, and
+the metric name catalog.
 """
-from . import context, flight, memory, metrics, profile, quality, slo  # noqa: F401
+from . import (  # noqa: F401
+    context,
+    fleet,
+    flight,
+    memory,
+    metrics,
+    profile,
+    promtext,
+    quality,
+    slo,
+)
+from .fleet import FleetView  # noqa: F401
 from .memory import AdmissionGuard, MemoryAccountant  # noqa: F401
 from .quality import (  # noqa: F401
     CanaryQuality,
@@ -94,6 +116,7 @@ __all__ = [
     "AdmissionGuard",
     "CanaryQuality",
     "Counter",
+    "FleetView",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -114,6 +137,7 @@ __all__ = [
     "WindowedSeries",
     "context",
     "default_registry",
+    "fleet",
     "disable_tracing",
     "enable_tracing",
     "export_chrome_trace",
@@ -122,6 +146,7 @@ __all__ = [
     "memory",
     "metrics",
     "profile",
+    "promtext",
     "quality",
     "record_span",
     "render",

@@ -239,6 +239,38 @@ def spans_for_trace(trace_id: str) -> List[Span]:
     return [s for s in _finished if s.trace_id == trace_id]
 
 
+def mono_to_wall_offset() -> float:
+    """``time.time() - time.monotonic()`` right now: the per-process
+    clock offset that converts span start times (monotonic) to wall
+    clock. Exported alongside spans so a DIFFERENT process (the fleet
+    scraper) can place them on one shared timeline — monotonic epochs
+    are process-private, wall clock is not."""
+    return time.time() - time.monotonic()
+
+
+def export_spans(trace_id: Optional[str] = None,
+                 last: Optional[int] = None) -> dict:
+    """Serializable span export for cross-process stitching (the
+    ``GET /spans`` route — service/api.py). Each span dict additionally
+    carries ``start_wall_s`` (wall-clock start, one offset applied to
+    the whole batch) so the fleet view can interleave spans from many
+    processes; ``pid`` identifies the exporting process in the joined
+    Perfetto document."""
+    offset = mono_to_wall_offset()
+    spans = (spans_for_trace(trace_id) if trace_id is not None
+             else finished_spans())
+    if last is not None:
+        spans = spans[-last:]
+    out = []
+    for s in spans:
+        d = s.to_dict()
+        d["start_wall_s"] = s.start_s + offset
+        d["tid"] = s.tid
+        out.append(d)
+    return {"pid": os.getpid(), "tracing": TRACING,
+            "mono_to_wall": offset, "spans": out}
+
+
 def stats() -> dict:
     return {"finished_total": _finished_total, "retained": len(_finished),
             "tracing": TRACING}

@@ -55,16 +55,23 @@ class FlightRecorder:
 
     def dump(self, last: Optional[int] = None,
              pipeline: Optional[str] = None,
-             category: Optional[str] = None) -> List[dict]:
+             category: Optional[str] = None,
+             after: Optional[int] = None) -> List[dict]:
         """The retained events, oldest first; ``last`` keeps only the
         newest N, ``pipeline`` filters on the event's pipeline tag, and
         ``category`` on the event kind (``memory``, ``slo``,
         ``pipeline``, ``serving``, ... — mirrors the pipeline filter, so
-        a postmortem can pull one subsystem's channel)."""
+        a postmortem can pull one subsystem's channel). ``after`` keeps
+        only events with ``seq > after`` — the tail-follow cursor
+        (``obs flight --follow``, the fleet scraper's incremental
+        pulls): a caller that remembers the last seq it saw gets each
+        event exactly once, ring-overwrite permitting."""
         entries = sorted((s for s in list(self._slots) if s is not None),
                          key=lambda s: s[0])
         out = []
         for seq, t, kind, name, data, pipe in entries:
+            if after is not None and seq <= after:
+                continue
             if pipeline is not None and pipe != pipeline:
                 continue
             if category is not None and kind != category:

@@ -277,6 +277,22 @@ class WindowedSeries:
             "max_ms": (dig.max if dig.count else 0.0) * 1e3,
         }
 
+    def export_state(self) -> dict:
+        """Raw serializable form for cross-process aggregation (the
+        ``GET /profile?raw=1`` route the fleet scraper reads): every
+        live cell's digest + ok/err counts plus the cumulative total
+        digest. Because digest merge is exact, a consumer that merges
+        these cells gets bit-for-bit the digest of the pooled samples —
+        the fleet p99 IS the pooled p99 (obs/fleet.py)."""
+        with self._lock:
+            cells = [{"epoch": c[0], "digest": c[1].to_dict(),
+                      "ok": c[2], "err": c[3]}
+                     for c in self._cells if c is not None]
+            total = self.total.to_dict()
+            errors = self.errors
+        return {"alpha": self.alpha, "resolution_s": self.resolution_s,
+                "cells": cells, "total": total, "errors": errors}
+
 
 class _Series:
     """One duration-attribution channel: cumulative digest + rate anchors."""
@@ -398,6 +414,34 @@ class Profiler:
             "durations": out,
             # WindowedSeries.snapshot() locks per series internally
             "requests": {name: ws.snapshot()
+                         for name, ws in sorted(requests.items())},
+        }
+
+    def export_state(self) -> dict:
+        """Raw serializable export of every series (the fleet-scrape
+        contract — docs/observability.md#fleet): duration digests as
+        their bucket dicts and request series as windowed cells, plus
+        the process's monotonic→wall clock offset so a scraper in
+        ANOTHER process can align the cell epochs onto wall time.
+        Everything is copied under the profiler lock (digest bucket
+        dicts mutate under concurrent ``observe``)."""
+        durations: Dict[str, dict] = {}
+        with self._lock:
+            for (scope, name), s in sorted(self._durations.items()):
+                durations.setdefault(scope, {})[name] = {
+                    "count": s.count,
+                    "total_s": s.total_s,
+                    "digest": s.digest.to_dict(),
+                }
+            requests = dict(self._requests)
+        from . import context as obs_context
+
+        return {
+            "mono_to_wall": obs_context.mono_to_wall_offset(),
+            "alpha": self.alpha,
+            "durations": durations,
+            # WindowedSeries.export_state locks per series internally
+            "requests": {name: ws.export_state()
                          for name, ws in sorted(requests.items())},
         }
 
@@ -567,6 +611,12 @@ def reset() -> None:
 
 def snapshot() -> dict:
     return default_profiler.snapshot()
+
+
+def export_state() -> dict:
+    """Raw digest export of the default profiler (the fleet-scrape
+    contract; ``GET /profile?raw=1``)."""
+    return default_profiler.export_state()
 
 
 # hot call sites (queue pop, fused dispatch, request completion) — each
@@ -993,7 +1043,8 @@ def render_top(profile_snap: dict, slo_status: List[dict],
                placement: Optional[List[dict]] = None,
                memory: Optional[dict] = None,
                quality: Optional[dict] = None,
-               autoscale: Optional[List[dict]] = None) -> str:
+               autoscale: Optional[List[dict]] = None,
+               fleet: Optional[List[dict]] = None) -> str:
     """The ``obs top`` one-shot/watch dashboard: per-element rates,
     queue waits + depths, fused quantiles, request series, SLO burn,
     a MEMORY section (device watermarks, stage byte estimates, queue
@@ -1006,6 +1057,10 @@ def render_top(profile_snap: dict, slo_status: List[dict],
     (runtime/placement.py)."""
     lines = [f"nns obs top — profiling "
              f"{'ON' if profile_snap.get('active') else 'off'}"]
+    if fleet:
+        from . import fleet as obs_fleet
+
+        lines.extend(obs_fleet.render_section(fleet))
     for a in autoscale or []:
         last = a.get("last_decision") or {}
         lines.append("")

@@ -454,6 +454,15 @@ class QualityAccountant:
 default_accountant = QualityAccountant()
 
 
+def export_state() -> dict:
+    """Raw serialized health cells for cross-process aggregation (the
+    ``GET /quality?raw=1`` route the fleet scraper reads): the same
+    ``to_cell`` shape the artifact ``quality`` section persists, so the
+    fleet merge reuses :func:`merge_cells` — additive counts + exact
+    histogram merge, a replica fleet's pooled sample population."""
+    return {"cells": default_accountant.stages()}
+
+
 def accountant() -> QualityAccountant:
     return default_accountant
 

@@ -430,7 +430,12 @@ class TensorQueryServerSink(SinkElement):
         if client_id is None:
             logger.warning("%s: answer without client_id meta dropped", self.name)
             return
-        self._server().send(client_id, buf)
+        # pop the EXACT serve mark for this frame: a frame-dropping
+        # element between serversrc and serversink would otherwise shift
+        # every later answer's span/latency onto the wrong request via
+        # the in-order counter fallback
+        self._server().send(client_id, buf,
+                            mark_idx=buf.meta.get("_qserve_idx"))
 
     def stop(self) -> None:
         super().stop()

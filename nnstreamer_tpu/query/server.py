@@ -8,6 +8,7 @@ to the right client (tensor_query_serversrc.c:299-315, GstMetaQuery).
 """
 from __future__ import annotations
 
+import collections
 import queue as _queue
 import socket
 import threading
@@ -17,9 +18,37 @@ from typing import Callable, Dict, List, Optional
 from ..core import Buffer, Caps, parse_caps_string
 from ..core.serialize import pack_tensors, unpack_tensors
 from ..obs import context as obs_context
+from ..obs import profile as obs_profile
 from ..utils.log import logger
 from ..utils.threads import ThreadRegistry
 from .protocol import MsgType, recv_msg, send_msg
+
+#: the request series a served query records under (obs/profile.py) —
+#: one deployment-shaped name, NOT per-port, so every replica of one
+#: fleet exports the SAME series and the fleet merge pools them
+#: (obs/fleet.py ``serving:``-head names are never prefix-stripped)
+SERVE_SERIES = "serving:query"
+
+
+class _ServeTrack:
+    """Per-client serve attribution (see ``QueryServer._inflight``).
+
+    ``recv``/``sent`` count EVERY data frame / answer on the
+    connection (two int adds — kept on even when observability is
+    off), so each pending mark carries the frame INDEX its answer will
+    have. Popping matches indices instead of trusting a bare FIFO:
+    frames received while tracing/profiling was off, silently-shed
+    frames, and marks dropped by the deque bound can therefore never
+    shift a later answer's span/latency onto the wrong request — an
+    unmatched answer simply goes unattributed."""
+
+    __slots__ = ("marks", "recv", "sent")
+
+    def __init__(self):
+        # guarded-by: QueryServer._lock (reader appends, senders pop)
+        self.marks: collections.deque = collections.deque(maxlen=256)
+        self.recv = 0   # written by the one client reader thread
+        self.sent = 0   # guarded-by: QueryServer._lock
 
 
 def _shutdown_close(sock: socket.socket) -> None:
@@ -65,6 +94,18 @@ class QueryServer:
         self._running = threading.Event()
         self._accepting = False
         self._serving = False
+        # in-flight serve attribution per client, index-matched
+        # (answers route back in request order on one connection; see
+        # :class:`_ServeTrack` for why indices, not a bare FIFO). Each
+        # mark is (frame_idx, recv_t0, span). The span half is the
+        # cross-PROCESS trace story — a trace context arriving in the
+        # frame meta (fabric attempt / remote client root) mints a
+        # ``query.serve`` child span HERE, so this process's
+        # GET /spans export stitches into the caller's trace
+        # (obs/fleet.py); the t0 half records the serve latency as the
+        # ``serving:query`` request series every replica of a fleet
+        # shares. guarded-by: _lock (table; see _ServeTrack for fields)
+        self._inflight: Dict[int, _ServeTrack] = {}
         self._client_threads = ThreadRegistry()
         # accept/serve threads ride a registry (like client-connection
         # workers), so stop() joins them uniformly and SURFACES any
@@ -129,9 +170,23 @@ class QueryServer:
         self._serving = True
         self.start()
 
-        def _error_reply(client_id: int, err: BaseException) -> None:
+        def _error_reply(client_id: int, err: BaseException,
+                         idx: Optional[int] = None) -> None:
             with self._lock:
                 conn = self._clients.get(client_id)
+                # a typed ERROR is this request's answer: pop its mark
+                # too (exact by frame index — sheds overtake earlier
+                # in-flight frames, see _pop_mark_locked)
+                mark, stale = self._pop_mark_locked(client_id, idx)
+            for sp in stale:
+                sp.end("error:unanswered")
+            if mark is not None:
+                _idx, t0, span = mark
+                if span is not None:
+                    span.end(f"error:{type(err).__name__}")
+                if obs_profile.ACTIVE:
+                    obs_profile.record_request(
+                        SERVE_SERIES, time.monotonic() - t0, ok=False)
             if conn is not None:
                 try:
                     send_msg(conn, MsgType.ERROR,
@@ -139,13 +194,14 @@ class QueryServer:
                 except OSError:
                     pass
 
-        def _answer(client_id: int, req) -> None:
+        def _answer(client_id: int, req,
+                    idx: Optional[int] = None) -> None:
             if req.error is not None:
-                _error_reply(client_id, req.error)
+                _error_reply(client_id, req.error, idx)
                 return
             out = Buffer(list(req.result()))
             out.meta["serving"] = dict(req.metrics)
-            self.send(client_id, out)
+            self.send(client_id, out, mark_idx=idx)
 
         def _serve_loop() -> None:
             from ..serving import AdmissionError, ServingError
@@ -181,11 +237,13 @@ class QueryServer:
                 if obs_context.TRACING:
                     trace_ctx = obs_context.TraceContext.from_meta(
                         item.meta.get("trace"))
+                serve_idx = item.meta.get("_qserve_idx")
                 try:
                     scheduler.submit(
                         tuple(item.tensors), priority=priority,
                         deadline_s=eff_deadline, trace=trace_ctx,
-                        on_done=lambda req, cid=client_id: _answer(cid, req))
+                        on_done=lambda req, cid=client_id, i=serve_idx:
+                            _answer(cid, req, i))
                 except AdmissionError:
                     pass  # on_done already delivered the typed ERROR
                 except ServingError as err:
@@ -193,7 +251,7 @@ class QueryServer:
                     # Request exists so no on_done fires — answer here and
                     # keep serving, so every later frame also gets the
                     # typed ERROR instead of a dead thread's silence
-                    _error_reply(client_id, err)
+                    _error_reply(client_id, err, serve_idx)
 
         t = threading.Thread(
             target=_serve_loop, name=f"qserver:{self.port}:serve",
@@ -212,6 +270,7 @@ class QueryServer:
                 client_id = self._next_id
                 self._next_id += 1
                 self._clients[client_id] = conn
+                self._inflight[client_id] = _ServeTrack()
             t = threading.Thread(
                 target=self._client_loop, args=(client_id, conn),
                 name=f"qserver:{self.port}:c{client_id}", daemon=True
@@ -257,6 +316,34 @@ class QueryServer:
                         continue
                     buf = unpack_tensors(payload)
                     buf.meta["client_id"] = client_id
+                    track = self._inflight.get(client_id)
+                    if track is not None:
+                        idx = track.recv
+                        track.recv += 1  # EVERY frame, obs on or off
+                        # the frame's index rides the meta so an answer
+                        # producer that completes OUT of request order
+                        # (scheduler bridge: an admission shed replies
+                        # before an earlier in-flight frame) can pop its
+                        # EXACT mark instead of trusting answer order
+                        buf.meta["_qserve_idx"] = idx
+                        if obs_context.TRACING or obs_profile.ACTIVE:
+                            span = None
+                            if obs_context.TRACING:
+                                ctx = obs_context.TraceContext.from_meta(
+                                    buf.meta.get("trace"))
+                                if ctx is not None:
+                                    span = obs_context.start_span(
+                                        f"query.serve:c{client_id}",
+                                        kind="serving", parent=ctx,
+                                        attrs={"port": self.port,
+                                               "client": client_id})
+                            # under _lock: sender threads iterate this
+                            # deque in _pop_mark_locked, and an unlocked
+                            # append can surface there as "deque mutated
+                            # during iteration"
+                            with self._lock:
+                                track.marks.append(
+                                    (idx, time.monotonic(), span))
                     self.inbox.put(buf)
                 elif msg_type is MsgType.EOS:
                     self.inbox.put(("eos", client_id))
@@ -266,26 +353,85 @@ class QueryServer:
             with self._lock:
                 self._clients.pop(client_id, None)
                 self._client_caps.pop(client_id, None)
+                track = self._inflight.pop(client_id, None)
+            for _idx, _t0, span in (track.marks if track else ()):
+                if span is not None:  # unanswered at disconnect
+                    span.end("error:client-dropped")
             try:
                 conn.close()
             except OSError:
                 pass
 
     # -- answer routing -----------------------------------------------------
-    def send(self, client_id: int, buf: Buffer) -> bool:
+    def _pop_mark_locked(self, client_id: int,
+                         idx: Optional[int] = None):
+        """(mark_for_this_answer, stale_spans). Call under ``_lock``.
+
+        ``idx=None`` (in-order answer path — pipeline serversink):
+        advances the client's answer index and pops the mark whose
+        frame index matches it; marks walked PAST (frames that never
+        got an answer: silent sheds, marks dropped by the deque bound)
+        are discarded and their spans returned for the caller to end
+        OUTSIDE the lock.
+
+        ``idx`` given (scheduler bridge): answers can complete OUT of
+        request order (an admission shed replies immediately while an
+        earlier frame is still in a batch), so pop EXACTLY the mark
+        with that frame index and leave the rest in flight — the
+        counter scheme would shift every reordered answer's span and
+        latency onto the wrong request."""
+        track = self._inflight.get(client_id)
+        if track is None:
+            return None, ()
+        marks = track.marks
+        if idx is not None:
+            for m in marks:
+                if m[0] == idx:
+                    marks.remove(m)
+                    return m, ()
+            return None, ()
+        idx = track.sent
+        track.sent += 1
+        mark = None
+        stale = []
+        while marks and marks[0][0] <= idx:
+            m = marks.popleft()
+            if m[0] == idx:
+                mark = m
+                break
+            if m[2] is not None:
+                stale.append(m[2])
+        return mark, stale
+
+    def send(self, client_id: int, buf: Buffer,
+             mark_idx: Optional[int] = None) -> bool:
         with self._lock:
             conn = self._clients.get(client_id)
+            mark, stale = self._pop_mark_locked(client_id, mark_idx)
+        for sp in stale:
+            sp.end("error:unanswered")
         if conn is None:
             logger.warning("query server: no client %d for answer", client_id)
+            if mark is not None and mark[2] is not None:
+                mark[2].end("error:client-gone")
             return False
-        meta = {k: v for k, v in buf.meta.items() if k != "client_id"}
+        meta = {k: v for k, v in buf.meta.items()
+                if k not in ("client_id", "_qserve_idx")}
         out = buf.with_tensors(buf.as_numpy().tensors)
         out.meta = meta
         try:
             send_msg(conn, MsgType.DATA, pack_tensors(out))
-            return True
+            ok = True
         except OSError:
-            return False
+            ok = False
+        if mark is not None:
+            _idx, t0, span = mark
+            if span is not None:
+                span.end("ok" if ok else "error:send-failed")
+            if obs_profile.ACTIVE:
+                obs_profile.record_request(
+                    SERVE_SERIES, time.monotonic() - t0, ok=ok)
+        return ok
 
 
 # Shared per-id server table (reference tensor_query_server.c:76-117):

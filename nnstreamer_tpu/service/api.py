@@ -17,9 +17,21 @@ Routes (JSON unless noted):
                                           docs/observability.md)
     GET    /flight                        flight-recorder tail
                                           (?last=N&pipeline=NAME
-                                          &category=KIND)
+                                          &category=KIND&after=SEQ —
+                                          ``after`` is the tail-follow /
+                                          fleet-scrape cursor)
     GET    /profile                       continuous-profiler snapshot +
-                                          SLO status (obs profile / top)
+                                          SLO status (obs profile / top);
+                                          ?raw=1 adds the raw digest
+                                          export the fleet scraper merges
+                                          (obs/fleet.py)
+    GET    /spans                         wall-clock-annotated span export
+                                          for cross-process trace
+                                          stitching (?trace=ID&last=N)
+    GET    /fleet                         fleet-view snapshots (merged
+                                          replica planes — obs/fleet.py)
+    GET    /fleet/flight                  the fleet-MERGED flight stream
+                                          (?after=SEQ&last=N&name=FLEET)
     GET    /memory                        device-memory accounting plane
                                           (stage estimates, device
                                           watermarks, queue/serving
@@ -27,7 +39,9 @@ Routes (JSON unless noted):
     GET    /quality                       data-plane quality snapshot
                                           (per-edge tensor health,
                                           baseline stages, drift scores
-                                          — obs/quality.py)
+                                          — obs/quality.py); ?raw=1 adds
+                                          the serialized health cells the
+                                          fleet merge folds additively
     GET    /services                      list (name/state/ready/restarts)
     GET    /services/<name>               full health snapshot
     POST   /services                      register {name, launch, ...}
@@ -49,6 +63,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -187,19 +202,70 @@ def _make_handler(manager: ServiceManager):
                     last = int(params.get("last", 256))
                 except ValueError:
                     raise ValueError(f"last={params['last']!r} not an int")
-                return {"events": obs_flight.dump(
-                    last=last, pipeline=params.get("pipeline"),
-                    category=params.get("category"))}
+                after = params.get("after")
+                try:
+                    after = None if after is None else int(after)
+                except ValueError:
+                    raise ValueError(f"after={after!r} not an int")
+                # pid identifies THIS process's recorder epoch: a fleet
+                # scraper that sees it change knows the seq space (and
+                # its cursor) restarted with a respawned replica
+                return {"pid": os.getpid(),
+                        "events": obs_flight.dump(
+                            last=last, pipeline=params.get("pipeline"),
+                            category=params.get("category"), after=after)}
             if parts == ["profile"] and method == "GET":
                 from ..obs import profile as obs_profile
                 from ..obs import slo as obs_slo
                 from ..runtime import placement
                 from . import autoscaler as svc_autoscaler
 
-                return {"profile": obs_profile.snapshot(),
-                        "slo": obs_slo.status_all(),
-                        "placement": placement.snapshot_all(),
-                        "autoscale": svc_autoscaler.snapshot_all()}
+                out = {"profile": obs_profile.snapshot(),
+                       "slo": obs_slo.status_all(),
+                       "placement": placement.snapshot_all(),
+                       "autoscale": svc_autoscaler.snapshot_all()}
+                if self._query_params().get("raw") in ("1", "true"):
+                    # the fleet-scrape contract: raw digest buckets +
+                    # windowed cells + the mono→wall clock offset, so a
+                    # DIFFERENT process can merge exactly (obs/fleet.py)
+                    out["raw"] = obs_profile.export_state()
+                return out
+            if parts == ["spans"] and method == "GET":
+                from ..obs import context as obs_context
+
+                params = self._query_params()
+                last = params.get("last")
+                try:
+                    last = None if last is None else int(last)
+                except ValueError:
+                    raise ValueError(f"last={last!r} not an int")
+                return obs_context.export_spans(
+                    trace_id=params.get("trace"), last=last)
+            if parts == ["fleet"] and method == "GET":
+                from ..obs import fleet as obs_fleet
+
+                return {"fleet": obs_fleet.snapshot_all()}
+            if parts == ["fleet", "flight"] and method == "GET":
+                from ..obs import fleet as obs_fleet
+
+                params = self._query_params()
+                v = obs_fleet.view(params.get("name"))
+                if v is None:
+                    raise KeyError(
+                        f"no live fleet view"
+                        + (f" named '{params['name']}'"
+                           if params.get("name") else ""))
+                try:
+                    last = int(params.get("last", 256))
+                    after = params.get("after")
+                    after = None if after is None else int(after)
+                except ValueError as e:
+                    raise ValueError(f"bad fleet/flight params: {e}")
+                return {"fleet": v.name,
+                        "events": v.flight(
+                            last=last, after=after,
+                            category=params.get("category"),
+                            pipeline=params.get("pipeline"))}
             if parts == ["memory"] and method == "GET":
                 from ..obs import memory as obs_memory
 
@@ -207,7 +273,10 @@ def _make_handler(manager: ServiceManager):
             if parts == ["quality"] and method == "GET":
                 from ..obs import quality as obs_quality
 
-                return {"quality": obs_quality.snapshot()}
+                out = {"quality": obs_quality.snapshot()}
+                if self._query_params().get("raw") in ("1", "true"):
+                    out.update(obs_quality.export_state())
+                return out
             if parts == ["services"]:
                 if method == "GET":
                     return {"services": m.list()}
@@ -372,10 +441,13 @@ class ControlClient:
 
     def flight(self, last: int = 256,
                pipeline: Optional[str] = None,
-               category: Optional[str] = None) -> dict:
+               category: Optional[str] = None,
+               after: Optional[int] = None) -> dict:
         """Flight-recorder tail; ``pipeline`` filters on the event's
-        pipeline tag, ``category`` on the event kind (parity with
-        ``flight.dump(pipeline=, category=)``)."""
+        pipeline tag, ``category`` on the event kind, ``after`` keeps
+        only events past a seq cursor (parity with
+        ``flight.dump(pipeline=, category=, after=)`` — the
+        ``obs flight --follow`` / fleet-scrape cursor)."""
         from urllib.parse import quote
 
         path = f"/flight?last={int(last)}"
@@ -383,20 +455,63 @@ class ControlClient:
             path += f"&pipeline={quote(pipeline)}"
         if category is not None:
             path += f"&category={quote(category)}"
+        if after is not None:
+            path += f"&after={int(after)}"
         return self._call("GET", path)
 
-    def profile(self) -> dict:
-        """GET /profile — profiler snapshot + SLO status."""
-        return self._call("GET", "/profile")
+    def profile(self, raw: bool = False) -> dict:
+        """GET /profile — profiler snapshot + SLO status; ``raw=True``
+        adds the raw digest export the fleet scraper merges."""
+        return self._call("GET", "/profile?raw=1" if raw else "/profile")
+
+    def spans(self, trace: Optional[str] = None,
+              last: Optional[int] = None) -> dict:
+        """GET /spans — the process's finished spans, wall-clock
+        annotated for cross-process stitching (obs/fleet.py)."""
+        from urllib.parse import quote
+
+        params = []
+        if trace is not None:
+            params.append(f"trace={quote(trace)}")
+        if last is not None:
+            params.append(f"last={int(last)}")
+        return self._call("GET",
+                          "/spans" + ("?" + "&".join(params)
+                                      if params else ""))
+
+    def fleet(self) -> dict:
+        """GET /fleet — snapshots of every live fleet view."""
+        return self._call("GET", "/fleet")
+
+    def fleet_flight(self, last: int = 256,
+                     after: Optional[int] = None,
+                     name: Optional[str] = None,
+                     category: Optional[str] = None,
+                     pipeline: Optional[str] = None) -> dict:
+        """GET /fleet/flight — the fleet-MERGED event stream with its
+        own cursor (``obs flight --follow --fleet``)."""
+        from urllib.parse import quote
+
+        path = f"/fleet/flight?last={int(last)}"
+        if after is not None:
+            path += f"&after={int(after)}"
+        if name is not None:
+            path += f"&name={quote(name)}"
+        if category is not None:
+            path += f"&category={quote(category)}"
+        if pipeline is not None:
+            path += f"&pipeline={quote(pipeline)}"
+        return self._call("GET", path)
 
     def memory(self) -> dict:
         """GET /memory — the device-memory accounting snapshot."""
         return self._call("GET", "/memory")
 
-    def quality(self) -> dict:
+    def quality(self, raw: bool = False) -> dict:
         """GET /quality — the data-plane quality snapshot (per-edge
-        tensor health, baseline stages, drift scores)."""
-        return self._call("GET", "/quality")
+        tensor health, baseline stages, drift scores); ``raw=True``
+        adds the serialized cells the fleet merge folds additively."""
+        return self._call("GET", "/quality?raw=1" if raw else "/quality")
 
     def list(self) -> dict:
         return self._call("GET", "/services")

@@ -36,6 +36,14 @@ This module is the controller between them:
   hopeless identity is discarded CLEANLY and the surviving replicas
   keep serving.
 
+* **fleet-merged sensing** — pass ``fleet=`` (an
+  :class:`~..obs.fleet.FleetView`) and the burn windows are computed
+  over the FLEET-merged digest of the series (exact bucket-wise merge
+  across subprocess replicas, wall-clock aligned) instead of this
+  process's local recorder: a replica restart that wipes its own
+  windowed series cannot blind the controller, and serving-side series
+  recorded inside the replicas become steerable.
+
 Targets are duck-typed: anything with ``pool`` (a
 :class:`~.fabric.ReplicaPool`), ``replica_count()``, ``scale_out()``
 and ``scale_in()`` scales — :class:`~.fabric.ServiceFabric` (in-process
@@ -141,15 +149,36 @@ class Autoscaler:
                  *, name: Optional[str] = None,
                  series: Optional[str] = None,
                  profiler: Optional[obs_profile.Profiler] = None,
+                 fleet=None,
                  memory_fraction_fn=None):
         self.target = target
         self.config = config or AutoscalerConfig()
         self.name = name or getattr(target, "name", "autoscaler")
         # the latency series burn is computed from — the fabric pool's
-        # request digests by default (obs/profile.py windowed series)
-        self.series = series or f"fabric:{target.pool.name}"
-        self._profiler = (profiler if profiler is not None
-                          else obs_profile.default_profiler)
+        # request digests by default (obs/profile.py windowed series).
+        # With fleet= the default is the replicas' own serve series
+        # instead: "fabric:<pool>" lives in the PARENT's recorder only
+        # (no replica exports it), so the fleet read would silently
+        # fall back to local while claiming source=fleet
+        if series:
+            self.series = series
+        elif fleet is not None:
+            self.series = "serving:query"   # query/server.SERVE_SERIES
+        else:
+            self.series = f"fabric:{target.pool.name}"
+        # fleet= points the burn windows at a FleetView's MERGED series
+        # (obs/fleet.py request_window — the same read signature as a
+        # Profiler): scaling decisions then survive any single replica
+        # whose local recorder restarted, and a serving-side series
+        # recorded INSIDE the subprocess replicas becomes steerable
+        if fleet is not None and profiler is not None:
+            raise ValueError("pass fleet= or profiler=, not both")
+        self.fleet = fleet
+        if fleet is not None:
+            self._profiler = fleet
+        else:
+            self._profiler = (profiler if profiler is not None
+                              else obs_profile.default_profiler)
         # injectable for tests; default = worst per-device used/budget
         if memory_fraction_fn is None:
             from ..obs import memory as obs_memory
@@ -296,6 +325,8 @@ class Autoscaler:
         desired = max(cfg.min_replicas, min(cfg.max_replicas, wanted))
         decision = {
             "autoscaler": self.name, "series": self.series,
+            "source": ("fleet:" + self.fleet.name
+                       if self.fleet is not None else "local"),
             "replicas": current, "desired": desired,
             "burn_short": round(burn_short, 3),
             "burn_long": round(burn_long, 3),
@@ -481,6 +512,8 @@ class Autoscaler:
             return {
                 "name": self.name,
                 "series": self.series,
+                "source": ("fleet:" + self.fleet.name
+                           if self.fleet is not None else "local"),
                 "replicas": self.target.replica_count(),
                 "desired_replicas": self._desired,
                 "min_replicas": self.config.min_replicas,

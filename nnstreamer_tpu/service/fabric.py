@@ -124,10 +124,16 @@ class Replica:
 
     def __init__(self, replica_id: str,
                  resolver: Callable[[], Tuple[str, int]],
-                 service=None):
+                 service=None, control=None):
         self.id = replica_id
         self.resolver = resolver
         self.service = service
+        # optional control-plane endpoint behind the replica: a URL
+        # string or a zero-arg callable returning the CURRENT one (or
+        # None while the replica is down) — subprocess/remote replicas
+        # advertise theirs so the fleet scraper (obs/fleet.py) can
+        # discover every replica's obs planes straight off the pool
+        self.control = control
         self.state = ReplicaState.ACTIVE       # guarded-by: ReplicaPool._lock
         self.score = 1.0                       # guarded-by: ReplicaPool._lock
         self.samples = 0                       # guarded-by: ReplicaPool._lock
@@ -296,16 +302,19 @@ class ReplicaPool:
     def add_endpoint(self, host: str, port: int,
                      replica_id: Optional[str] = None,
                      service=None,
-                     resolver: Optional[Callable[[], Tuple[str, int]]] = None
-                     ) -> Replica:
+                     resolver: Optional[Callable[[], Tuple[str, int]]] = None,
+                     control=None) -> Replica:
         """Register a replica at a static address (or with a custom
         ``resolver`` — service replicas pass one that reads the live
         pipeline's bound port, so a restart onto a new ephemeral port is
-        transparent)."""
+        transparent). ``control`` optionally names the replica's
+        control-plane endpoint (URL or callable) for the fleet scraper
+        (:meth:`control_endpoints`)."""
         rid = replica_id or f"{host}:{port}"
         if resolver is None:
             resolver = lambda h=host, p=port: (h, p)  # noqa: E731
-        return self._add(Replica(rid, resolver, service=service))
+        return self._add(Replica(rid, resolver, service=service,
+                                 control=control))
 
     def add_discovered(self, broker_host: str, broker_port: int,
                        topic: str,
@@ -913,6 +922,25 @@ class ReplicaPool:
             self._canary = None
 
     # -- observability --------------------------------------------------------
+    def control_endpoints(self) -> Dict[str, Optional[str]]:
+        """{replica_id: control-endpoint URL or None} — the fleet-view
+        discovery contract (obs/fleet.py): replicas registered with a
+        ``control=`` URL/callable advertise it here; a callable that
+        raises (replica down, mid-respawn) reads as None, so the
+        scraper marks the replica instead of crashing its tick."""
+        with self._lock:
+            entries = [(r.id, r.control) for r in self._replicas.values()]
+        out: Dict[str, Optional[str]] = {}
+        for rid, control in entries:
+            if callable(control):
+                try:
+                    out[rid] = control()
+                except Exception:  # noqa: BLE001 - down/mid-respawn
+                    out[rid] = None
+            else:
+                out[rid] = control
+        return out
+
     def snapshot(self) -> dict:
         with self._lock:
             entries = [(r, r.snapshot_locked())

@@ -93,6 +93,8 @@ class ProcReplica:
                  models: Optional[dict] = None,
                  warmup: bool = True,
                  advertise: Optional[str] = None,
+                 trace: bool = False,
+                 obs: bool = True,
                  python: Optional[str] = None,
                  extra_args: Optional[List[str]] = None):
         self.stage = stage
@@ -101,6 +103,13 @@ class ProcReplica:
         self.models = models
         self.warmup = warmup
         self.advertise = advertise
+        # trace: the child enables request-scoped span tracing, so the
+        # spans minted for wire trace ids are exportable at GET /spans
+        # (cross-process stitching — obs/fleet.py); obs: the child keeps
+        # request-digest recording on, so GET /profile?raw=1 carries the
+        # windowed series the fleet merge reads
+        self.trace = trace
+        self.obs = obs
         self.name = name or f"replica-{os.getpid()}-{next(_proc_seq)}"
         self.python = python or sys.executable
         self.extra_args = list(extra_args or [])
@@ -121,6 +130,10 @@ class ProcReplica:
             cmd += ["--models", json.dumps(self.models)]
         if not self.warmup:
             cmd += ["--no-warmup"]
+        if self.trace:
+            cmd += ["--trace"]
+        if not self.obs:
+            cmd += ["--no-obs"]
         if self.advertise:
             cmd += ["--advertise", self.advertise]
         cmd += self.extra_args
@@ -204,15 +217,21 @@ class ProcReplica:
                 f"replica '{self.name}' has not advertised yet")
         return self.info["host"], int(self.info["query_port"])
 
+    def control_endpoint(self) -> Optional[str]:
+        """The child's control-plane URL, or None before READY — the
+        fleet scraper's per-replica address (obs/fleet.py)."""
+        if self.info is None:
+            return None
+        return f"http://{self.info['host']}:{self.info['control_port']}"
+
     def control(self, timeout: float = 10.0):
         from .api import ControlClient
 
-        if self.info is None:
+        endpoint = self.control_endpoint()
+        if endpoint is None:
             raise ProcReplicaError(
                 f"replica '{self.name}' has not advertised yet")
-        return ControlClient(
-            f"http://{self.info['host']}:{self.info['control_port']}",
-            timeout=timeout)
+        return ControlClient(endpoint, timeout=timeout)
 
     # -- teardown / chaos ----------------------------------------------------
     def kill(self) -> None:
@@ -268,6 +287,8 @@ class ProcReplicaSet:
                  spawn_timeout_s: float = 120.0,
                  python: Optional[str] = None,
                  advertise: Optional[str] = None,
+                 trace: bool = False,
+                 obs: bool = True,
                  **pool_kwargs):
         self.name = name
         self.stage = stage
@@ -278,6 +299,8 @@ class ProcReplicaSet:
         self.spawn_timeout_s = spawn_timeout_s
         self.python = python
         self.advertise = advertise
+        self.trace = trace
+        self.obs = obs
         self.n_replicas = replicas
         self.pool = ReplicaPool(name, caps, **pool_kwargs)
         self._lock = named_lock(f"ProcReplicaSet._lock:{name}")
@@ -291,7 +314,8 @@ class ProcReplicaSet:
         return ProcReplica(self.stage, self.caps_str, name=rid,
                            host=self.host, models=self.models,
                            warmup=self.warmup, python=self.python,
-                           advertise=self.advertise)
+                           advertise=self.advertise, trace=self.trace,
+                           obs=self.obs)
 
     def start(self) -> "ProcReplicaSet":
         """Spawn the initial replicas CONCURRENTLY (each pays its own
@@ -330,7 +354,8 @@ class ProcReplicaSet:
         host, port = slot.proc.address()
         self.pool.add_endpoint(
             host, port, replica_id=slot.rid,
-            resolver=lambda rid=slot.rid: self._resolve(rid))
+            resolver=lambda rid=slot.rid: self._resolve(rid),
+            control=lambda rid=slot.rid: self._control_endpoint(rid))
         obs_flight.record("fabric", "replica_spawned",
                           {"pool": self.name, "replica": slot.rid,
                            "pid": slot.proc.proc.pid, "port": port})
@@ -344,6 +369,24 @@ class ProcReplicaSet:
         if slot is None or slot.dead:
             raise ConnectionError(f"replica '{rid}' has no live process")
         return slot.proc.address()
+
+    def _control_endpoint(self, rid: str) -> Optional[str]:
+        """The CURRENT process's control URL behind a ring identity
+        (None while dead/mid-respawn) — the pool's ``control=`` hook."""
+        with self._lock:
+            slot = self._slots.get(rid)
+        if slot is None or slot.dead:
+            return None
+        return slot.proc.control_endpoint()
+
+    def control_endpoints(self) -> Dict[str, Optional[str]]:
+        """{replica_id: control URL or None} — the fleet-view discovery
+        contract (obs/fleet.py): every ring identity's CURRENT child
+        control endpoint; None marks a dead/mid-respawn replica so the
+        scraper reports it instead of hammering a gone port."""
+        with self._lock:
+            rids = list(self._order)
+        return {rid: self._control_endpoint(rid) for rid in rids}
 
     # -- elastic scaling (autoscaler actuation) -------------------------------
     def replica_count(self) -> int:
@@ -539,6 +582,22 @@ def run_replica(args) -> int:
     from .fabric import _fabric_qid
     from .supervisor import RestartPolicy
 
+    if getattr(args, "obs", True):
+        # keep the request-digest recording half on (the cheap,
+        # request-rate half — no per-hop element tracer), so the
+        # parent's fleet scraper finds windowed series at
+        # GET /profile?raw=1 even when nothing else switched the
+        # profiler on in this process
+        from ..obs import profile as obs_profile
+
+        obs_profile.enable_recording()
+    if getattr(args, "trace", False):
+        # span tracing for cross-process stitching: trace ids arriving
+        # on the query wire mint serving/fused spans HERE, exported at
+        # this replica's GET /spans for the parent's FleetView to join
+        from ..obs import context as obs_context
+
+        obs_context.enable_tracing()
     mgr = ServiceManager()
     models = {}
     if args.models:
@@ -656,8 +715,16 @@ def add_replica_args(parser) -> None:
                              "service (never|on-failure|always)")
     parser.add_argument("--no-warmup", dest="warmup", action="store_false",
                         help="skip the self-warmup inference before READY")
+    parser.add_argument("--trace", action="store_true",
+                        help="enable request-scoped span tracing in the "
+                             "replica (spans for wire trace ids export at "
+                             "GET /spans — cross-process stitching, "
+                             "docs/observability.md#fleet)")
+    parser.add_argument("--no-obs", dest="obs", action="store_false",
+                        help="disable the request-digest recording the "
+                             "fleet scraper reads at GET /profile?raw=1")
     parser.add_argument("--advertise", default=None,
                         metavar="BROKER_HOST:BROKER_PORT:TOPIC",
                         help="also advertise the query address over "
                              "MQTT-hybrid discovery (query/hybrid.py)")
-    parser.set_defaults(warmup=True, fn=run_replica)
+    parser.set_defaults(warmup=True, obs=True, fn=run_replica)

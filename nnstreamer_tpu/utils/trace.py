@@ -161,9 +161,13 @@ class ChromeTraceTracer(Tracer):
     """Complete-event trace viewable in chrome://tracing / Perfetto: one
     'X' span per element chain per buffer, thread-separated, lining up
     with ``jax_trace`` device XPlanes. Path from NNS_CHROME_TRACE
-    (default nns_trace.json); written by ``save()``, and — when
-    env-activated — automatically at every ``Pipeline.stop()``
-    (:func:`flush_chrome_traces`) and at interpreter exit.
+    (explicit file), else ``<NNS_TRACE_DIR or system tmp>/
+    nns_trace-<pid>.json`` — an ARTIFACT path, never the working
+    directory: env-activated runs used to drop ``nns_trace.json`` into
+    the repo checkout, where it churned every commit. Written by
+    ``save()``, and — when env-activated — automatically at every
+    ``Pipeline.stop()`` (:func:`flush_chrome_traces`) and at
+    interpreter exit.
 
     Concurrency: a lock guards the event list's mutations, and
     ``save()``/``flush()`` SNAPSHOT the list under it before serializing
@@ -176,7 +180,8 @@ class ChromeTraceTracer(Tracer):
     MAX_EVENTS = 1_000_000  # bound memory on endless streams
 
     def __init__(self, path: Optional[str] = None):
-        self.path = path or os.environ.get("NNS_CHROME_TRACE", "nns_trace.json")
+        self.path = (path or os.environ.get("NNS_CHROME_TRACE")
+                     or default_chrome_trace_path())
         self._events: List[dict] = []
         self._t0 = time.perf_counter()
         self._saved = False
@@ -281,6 +286,21 @@ class ChromeTraceTracer(Tracer):
     def results(self) -> dict:
         with self._elock:
             return {"events": len(self._events), "path": self.path}
+
+
+def default_chrome_trace_path() -> str:
+    """The env-activated chrome-trace output path: per-pid file under
+    ``NNS_TRACE_DIR`` (created on demand) or the system tmp dir. Per-pid
+    so subprocess replicas sharing one env never clobber each other's
+    trace; explicit ``NNS_CHROME_TRACE``/API paths always win."""
+    import tempfile
+
+    base = os.environ.get("NNS_TRACE_DIR", "").strip()
+    if base:
+        os.makedirs(base, exist_ok=True)
+    else:
+        base = tempfile.gettempdir()
+    return os.path.join(base, f"nns_trace-{os.getpid()}.json")
 
 
 _BUILTIN = {t.NAME: t for t in

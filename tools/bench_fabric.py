@@ -92,42 +92,20 @@ class _TimedTraffic:
             t.join(timeout=10.0)
 
 
+# the Prometheus text parsing/polling lives in the shared module the
+# fleet scraper uses too (obs/promtext.py) — one parser, every consumer
 def _scrape_metric(endpoint: str, name: str, **labels):
-    """One Prometheus sample from GET /metrics; None when absent."""
-    import urllib.request
+    from nnstreamer_tpu.obs import promtext
 
-    with urllib.request.urlopen(endpoint + "/metrics", timeout=5.0) as resp:
-        text = resp.read().decode()
-    want = {f'{k}="{v}"' for k, v in labels.items()}
-    for line in text.splitlines():
-        if not line.startswith(name):
-            continue
-        head, _, value = line.rpartition(" ")
-        if head.startswith(name + "{"):
-            have = set(head[len(name) + 1:].rstrip("}").split(","))
-            if not want <= have:
-                continue
-        elif head != name or want:
-            continue
-        try:
-            return float(value)
-        except ValueError:
-            return None
-    return None
+    return promtext.scrape_metric(endpoint, name, **labels)
 
 
 def _wait_metric(endpoint: str, name: str, labels: dict, want: float,
                  timeout: float = 15.0):
-    """Poll the /metrics endpoint until ``name`` reaches ``want``;
-    returns the observation time (the bench's evict/readmit clock reads
-    the same scrape surface a monitoring stack would)."""
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        v = _scrape_metric(endpoint, name, **labels)
-        if v is not None and v >= want:
-            return time.monotonic()
-        time.sleep(0.02)
-    return None
+    from nnstreamer_tpu.obs import promtext
+
+    return promtext.wait_metric(endpoint, name, labels, want,
+                                timeout=timeout)
 
 
 def bench(steady_s: float = 2.0, rate_hz: float = 120.0) -> dict:

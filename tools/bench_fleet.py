@@ -1,0 +1,260 @@
+"""Fleet observability bench: scrape, merge, stitch, chaos (ISSUE 13).
+
+One 3-subprocess-replica fleet under live traffic, three gated legs:
+
+* **stitch** — one traced request through the fabric; the parent's
+  :meth:`~nnstreamer_tpu.obs.fleet.FleetView.stitch_trace` must yield
+  ONE Perfetto document where the parent root/attempt spans and the
+  subprocess replica's serving + fused spans share the SAME trace_id,
+  on distinct per-process lanes.
+* **merge** — the fleet-merged ``serving:query`` digest must equal the
+  bucket-wise merge of the replicas' raw exports (the exactness
+  property), with every live replica contributing.
+* **chaos** — SIGKILL one of the three replicas MID-SCRAPE while
+  traffic flows: the fleet snapshot stays coherent (all three
+  memberships reported, the dead replica marked not-ok/stale within
+  the staleness bound, survivors fresh), the merged series keeps
+  serving reads, zero client-visible request errors, and the scrape
+  tick thread joins cleanly at stop (zero thread leaks — run under
+  NNS_TSAN=1 in CI for lock-order checking too).
+
+Report written to FLEET_r13.json (full mode) — the ISSUE 13 trajectory
+point.
+
+    python tools/bench_fleet.py           # full bench, JSON report
+    python tools/bench_fleet.py --smoke   # CI gate, short run
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+CAPS = "other/tensors,format=static,dimensions=4,types=float32"
+STAGE = ("tensor_filter framework=jax model=builtin://scaler?factor=2 ! "
+         "tensor_filter framework=jax model=builtin://scaler?factor=3")
+
+
+class _Traffic:
+    """Closed-loop keyed traffic across the ring; typed error buckets."""
+
+    def __init__(self, ps, workers: int = 2, timeout: float = 15.0):
+        self.ps = ps
+        self.timeout = timeout
+        self.completed = 0
+        self.errors: list = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._run, name=f"fabric:bench:{i}",
+                             daemon=True)
+            for i in range(workers)]
+
+    def _run(self) -> None:
+        import numpy as np
+
+        me = threading.current_thread().name
+        n = 0
+        while not self._stop.is_set():
+            n += 1
+            try:
+                self.ps.request([np.ones(4, np.float32)],
+                                key=f"{me}:{n}", timeout=self.timeout)
+                with self._lock:
+                    self.completed += 1
+            except Exception as e:  # noqa: BLE001
+                with self._lock:
+                    self.errors.append(f"{type(e).__name__}: {e}")
+            self._stop.wait(0.02)
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.timeout + 5.0)
+
+
+def _leg_stitch(ps, view) -> dict:
+    import numpy as np
+
+    from nnstreamer_tpu.obs import context as obs_ctx
+    from nnstreamer_tpu.obs.fleet import PARENT_REPLICA
+
+    ps.request([np.ones(4, np.float32)], key="stitch", timeout=30.0)
+    roots = [s for s in obs_ctx.finished_spans()
+             if s.kind == "fabric" and s.parent_id is None]
+    tid = roots[-1].trace_id
+    view.tick()
+    doc = view.stitch_trace(tid)
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    lanes: dict = {}
+    for e in spans:
+        lanes.setdefault(e["args"]["replica"], set()).add(e["cat"])
+    child = [r for r in lanes if r != PARENT_REPLICA]
+    one_trace = bool(spans) and \
+        {e["args"]["trace_id"] for e in spans} == {tid}
+    child_kinds = set().union(*(lanes[r] for r in child)) if child else set()
+    return {
+        "trace_id": tid,
+        "spans": len(spans),
+        "process_lanes": len({e["pid"] for e in spans}),
+        "parent_kinds": sorted(lanes.get(PARENT_REPLICA, ())),
+        "child_kinds": sorted(child_kinds),
+        "ok": (one_trace and "fabric" in lanes.get(PARENT_REPLICA, ())
+               and {"serving", "fused"} <= child_kinds
+               and len({e["pid"] for e in spans}) >= 2),
+    }
+
+
+def _leg_merge(ps, view) -> dict:
+    from nnstreamer_tpu.obs.profile import QuantileDigest
+
+    view.tick()
+    merged = view.request_total("serving:query")
+    manual = None
+    contributing = 0
+    for st in view._state_rows():
+        req = (st.profile_raw or {}).get("requests", {}).get("serving:query")
+        if not req:
+            continue
+        contributing += 1
+        d = QuantileDigest.from_dict(req["total"])
+        manual = d if manual is None else manual.merge(d)
+    exact = (merged is not None and manual is not None
+             and merged.to_dict() == manual.to_dict())
+    return {
+        "replicas_contributing": contributing,
+        "merged_count": 0 if merged is None else merged.count,
+        "merged_p50_ms": (0.0 if merged is None
+                          else round(merged.quantile(0.5) * 1e3, 3)),
+        "merged_p99_ms": (0.0 if merged is None
+                          else round(merged.quantile(0.99) * 1e3, 3)),
+        "ok": exact and contributing == len(ps.services()),
+    }
+
+
+def _leg_chaos(ps, view, settle_s: float) -> dict:
+    killed = ps.kill_replica(0)
+    t_kill = time.monotonic()
+    ps.reap_dead()  # fail-fast evict (the autoscaler's reaping half)
+    t_marked = None
+    deadline = t_kill + max(15.0, settle_s * 4)
+    while time.monotonic() < deadline:
+        view.tick()
+        rows = {r["replica"]: r for r in view.replicas()}
+        dead = rows.get(killed)
+        if dead is not None and not dead["ok"]:
+            t_marked = time.monotonic()
+            break
+        time.sleep(0.1)
+    time.sleep(settle_s)  # staleness bound elapses, survivors keep fresh
+    view.tick()
+    snap = view.snapshot()
+    rows = {r["replica"]: r for r in snap["replicas"]}
+    survivors = [r for rid, r in rows.items() if rid != killed]
+    merged_alive = "serving:query" in snap["profile"]["requests"]
+    return {
+        "killed": killed,
+        "time_to_marked_s": (None if t_marked is None
+                             else round(t_marked - t_kill, 3)),
+        "membership": len(rows),
+        "dead_stale": bool(rows.get(killed, {}).get("stale")),
+        "survivors_fresh": all(r["ok"] and not r["stale"]
+                               for r in survivors),
+        "merged_series_alive": merged_alive,
+        "ok": (t_marked is not None and len(rows) == 3
+               and bool(rows.get(killed, {}).get("stale"))
+               and all(r["ok"] and not r["stale"] for r in survivors)
+               and merged_alive),
+    }
+
+
+def run(traffic_s: float, settle_s: float) -> dict:
+    from nnstreamer_tpu.obs import context as obs_ctx
+    from nnstreamer_tpu.obs.fleet import FleetView
+    from nnstreamer_tpu.service import ProcReplicaSet
+
+    import numpy as np
+
+    stale_after_s = max(1.0, settle_s)
+    ps = ProcReplicaSet("bench-fleet", STAGE, CAPS, replicas=3,
+                        trace=True, quarantine_base_s=0.2,
+                        health_poll_s=0.05)
+    view = FleetView("bench-fleet", source=ps, tick_s=0.25,
+                     stale_after_s=stale_after_s)
+    legs: dict = {}
+    traffic = None
+    try:
+        ps.start()
+        obs_ctx.enable_tracing()
+        for i in range(4):  # warm every replica's serve path off the clock
+            ps.request([np.ones(4, np.float32)], key=f"warm{i}",
+                       timeout=30.0)
+        view.start()
+        traffic = _Traffic(ps).start()
+        time.sleep(traffic_s)
+        legs["stitch"] = _leg_stitch(ps, view)
+        print(f"[bench_fleet] stitch: "
+              f"{'ok' if legs['stitch']['ok'] else 'FAILED'}",
+              file=sys.stderr)
+        legs["merge"] = _leg_merge(ps, view)
+        print(f"[bench_fleet] merge: "
+              f"{'ok' if legs['merge']['ok'] else 'FAILED'}",
+              file=sys.stderr)
+        legs["chaos"] = _leg_chaos(ps, view, settle_s)
+        traffic.stop()
+        legs["chaos"]["request_errors"] = traffic.errors
+        legs["chaos"]["requests_completed"] = traffic.completed
+        legs["chaos"]["ok"] = legs["chaos"]["ok"] and not traffic.errors
+        print(f"[bench_fleet] chaos: "
+              f"{'ok' if legs['chaos']['ok'] else 'FAILED'}",
+              file=sys.stderr)
+    finally:
+        if traffic is not None:
+            traffic.stop()
+        obs_ctx.disable_tracing()
+        view.stop()
+        ps.stop()
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("fleet:")]
+    legs["threads"] = {"leaked_fleet_threads": leaked, "ok": not leaked}
+    print(f"[bench_fleet] threads: "
+          f"{'ok' if not leaked else 'LEAKED ' + str(leaked)}",
+          file=sys.stderr)
+    return {"bench": "fleet", "replicas": 3,
+            "stale_after_s": stale_after_s, "legs": legs,
+            "ok": all(l["ok"] for l in legs.values())}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI: short phases, gates only")
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args()
+    if args.smoke:
+        report = run(traffic_s=2.0, settle_s=1.2)
+    else:
+        report = run(traffic_s=6.0, settle_s=2.0)
+        out = args.out or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..",
+            "FLEET_r13.json")
+        with open(out, "w") as fh:
+            json.dump(report, fh, indent=2)
+        print(f"[bench_fleet] report -> {out}", file=sys.stderr)
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
