@@ -25,6 +25,9 @@ launch conversion), ``tools/development/nnstreamerCodeGenCustomFilter.py``
     python -m nnstreamer_tpu obs quality            # tensor health / drift
     python -m nnstreamer_tpu obs fleet              # fleet-merged planes
     python -m nnstreamer_tpu obs flight --follow --fleet   # merged tail
+    python -m nnstreamer_tpu aot export --launch "a ! b"  # export stage
+                                                    # compile artifacts
+    python -m nnstreamer_tpu aot list|prune N       # compile-cache GC
 """
 from __future__ import annotations
 
@@ -391,6 +394,7 @@ def _obs_top(args) -> int:
             except ServiceError:
                 data["fleet"] = None  # pre-PR-13 serve process
             return data
+        from . import aot
         from .obs import fleet as obs_fleet
         from .obs import memory as obs_memory
         from .obs import quality as obs_quality
@@ -404,7 +408,8 @@ def _obs_top(args) -> int:
                 "memory": obs_memory.snapshot(),
                 "quality": obs_quality.snapshot(),
                 "autoscale": svc_autoscaler.snapshot_all(),
-                "fleet": obs_fleet.snapshot_all()}
+                "fleet": obs_fleet.snapshot_all(),
+                "aot": aot.snapshot()}
 
     while True:
         data = fetch()
@@ -414,7 +419,8 @@ def _obs_top(args) -> int:
                                      memory=data.get("memory"),
                                      quality=data.get("quality"),
                                      autoscale=data.get("autoscale"),
-                                     fleet=data.get("fleet")))
+                                     fleet=data.get("fleet"),
+                                     aot=data.get("aot")))
         if not args.watch:
             return 0
         try:
@@ -616,6 +622,76 @@ def _cmd_obs(args) -> int:
     return 0
 
 
+def _cmd_aot(args) -> int:
+    """``aot`` verbs (docs/aot.md):
+
+    * ``aot export --launch "a ! b"`` — run the launch line with the
+      compile cache active so every fused segment / singleton filter
+      exports its shape-poly artifact; restarts, hot-swap prepares, and
+      replica spawns of the same topology then load instead of
+      compiling;
+    * ``aot list`` — the cache inventory (stage, topology, poly flag,
+      bytes);
+    * ``aot prune N`` — LRU-evict down to the newest N artifacts (the
+      GC ``NNS_AOT_CACHE_MAX`` applies automatically on save).
+    """
+    import os
+
+    from . import aot
+
+    root = args.root or os.environ.get(aot.CACHE_ENV, "").strip()
+    if not root:
+        print(f"error: no cache — pass --root DIR or set {aot.CACHE_ENV}",
+              file=sys.stderr)
+        return 2
+    if args.verb == "export":
+        if not args.launch:
+            print("error: aot export needs --launch 'a ! b'",
+                  file=sys.stderr)
+            return 2
+        from .runtime.parse import parse_launch
+
+        # the cache hooks read the env; an explicit --root must win for
+        # this run AND for any subprocess the pipeline spawns
+        os.environ[aot.CACHE_ENV] = root
+        cache = aot.default_cache()
+        before = {e["path"] for e in cache.list()}
+        pipe = parse_launch(args.launch)
+        pipe.run(timeout=args.run_timeout)
+        from .obs import profile as obs_profile
+
+        topo = obs_profile.topology_hash(pipe)
+        entries = cache.list()
+        fresh = [e for e in entries if e["path"] not in before]
+        print(f"topology {topo}: {len(fresh)} artifact(s) exported, "
+              f"{len(entries)} total in {root}")
+        for e in entries:
+            mark = "+" if e["path"] in {f['path'] for f in fresh} else " "
+            print(f" {mark} {e['stage']}  "
+                  f"{'poly' if e['poly'] else 'static'}  "
+                  f"{e['nbytes']}B  topology={e['topology']}")
+        return 0
+    cache = aot.CompileCache(root)
+    if args.verb == "prune":
+        if not args.count or args.count < 1:
+            print("error: aot prune needs a positive COUNT",
+                  file=sys.stderr)
+            return 2
+        removed = cache.prune(args.count)
+        print(f"pruned {len(removed)} artifact(s) from {root} "
+              f"(bound {args.count})")
+        for p in removed:
+            print(f"  removed {p}")
+    entries = cache.list()
+    print(f"{len(entries)} artifact(s) in {root} "
+          f"({cache.total_bytes()} bytes)")
+    for e in entries:
+        print(f"  {e['stage']}  {'poly' if e['poly'] else 'static'}  "
+              f"{e['nbytes']}B  topology={e['topology']} "
+              f"device={e['device']}")
+    return 0
+
+
 def _cmd_service(args) -> int:
     """CLI verbs against a running serve endpoint (start/stop/list/status/
     swap/drain and canary control)."""
@@ -794,6 +870,21 @@ def main(argv=None) -> int:
                    help="top: --watch refresh interval in seconds "
                         "(default 2.0, must be > 0)")
     p.set_defaults(fn=_cmd_obs)
+
+    p = sub.add_parser("aot", help="AOT compile-artifact cache: export "
+                                   "stage programs, list/prune the store "
+                                   "(see docs/aot.md)")
+    p.add_argument("verb", choices=["export", "list", "prune"])
+    p.add_argument("count", nargs="?", type=int, default=0,
+                   help="prune: keep the newest COUNT artifacts")
+    p.add_argument("--root", default=None,
+                   help="cache directory (default NNS_AOT_CACHE)")
+    p.add_argument("--launch", default=None,
+                   help="export: run this launch line with the cache "
+                        "active so its stages export artifacts")
+    p.add_argument("--run-timeout", type=float, default=300.0,
+                   help="export: --launch run timeout seconds")
+    p.set_defaults(fn=_cmd_aot)
 
     p = sub.add_parser("lint", help="static pipeline-graph / source lint "
                                     "(see docs/lint.md)")

@@ -92,6 +92,13 @@ _RULES = (
          "matching ProfileArtifact — a better plan is available via "
          "Pipeline(place=\"auto\") (runtime/placement.py); info findings "
          "never gate, not even under --strict"),
+    Rule("NNL015", Severity.INFO, "AOT artifact coverage",
+         "informational: the AOT compile cache (NNS_AOT_CACHE) holds "
+         "exported compiled artifacts matching this topology — restarts, "
+         "hot-swap prepares, and replica spawns load instead of "
+         "tracing+compiling, and a shape-polymorphic artifact covers "
+         "every serving bucket with ONE compilation (nnstreamer_tpu/aot); "
+         "info findings never gate, not even under --strict"),
     # -- source lint (pass 2) -----------------------------------------------
     Rule("NNL100", Severity.ERROR, "unlintable source file",
          "a file handed to the source lint cannot be read or parsed "

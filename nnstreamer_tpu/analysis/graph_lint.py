@@ -20,6 +20,7 @@ played, no backend is opened, no device is grabbed. Three stages:
 """
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 from ..core.caps import Caps, looks_like_caps, parse_caps_string
@@ -84,6 +85,7 @@ def lint_pipeline(pipeline) -> List[Diagnostic]:
     diags += _check_host_roundtrip(elements)
     diags += _check_fusion_plan(pipeline)
     diags += _check_placement_hint(pipeline)
+    diags += _check_aot_artifacts(pipeline)
     return diags
 
 
@@ -425,8 +427,12 @@ def _check_filter_hazards(elements, est) -> List[Diagnostic]:
             f"tensor_filter '{el.name}' receives a FLEXIBLE stream while "
             "jit compiles per input signature — every new frame shape "
             "recompiles in the hot loop", location=el.name,
-            hint="bucket shapes upstream (tensor_aggregator / pad) or "
-                 "set invoke-dynamic=true"))
+            hint="bucket shapes upstream (tensor_aggregator / pad), set "
+                 "invoke-dynamic=true, or retire the BATCH-dim half by "
+                 "construction: a shape-poly AOT artifact (NNS_AOT_CACHE, "
+                 "docs/aot.md) covers every batch size with ONE "
+                 "compilation — trailing dims stay concrete, so bucket "
+                 "those upstream first; NNL015 reports coverage"))
     return diags
 
 
@@ -571,3 +577,38 @@ def _check_placement_hint(pipeline) -> List[Diagnostic]:
         location=next(iter(pipeline.elements), ""),
         hint='enable with Pipeline(place="auto") / parse_launch(place='
              '"auto") or `launch --place auto`')]
+
+
+def _check_aot_artifacts(pipeline) -> List[Diagnostic]:
+    """NNL015 (info), sibling of NNL014: the AOT compile cache
+    (``NNS_AOT_CACHE``) holds exported artifacts covering this topology —
+    restarts and replica spawns load instead of tracing+compiling, and
+    shape-poly artifacts mean ONE compilation covers every serving
+    bucket (the constructive retirement of the NNL008 hazard). Info
+    only: never gates, absent entirely when no cache is configured, and
+    the check reads meta files only — no device is touched, no backend
+    opened, no jax import (same contract as every graph rule)."""
+    try:
+        from .. import aot
+        from ..obs import profile as obs_profile
+
+        cache = aot.default_cache()
+        if cache is None:
+            return []
+        refs = cache.stage_artifacts(obs_profile.topology_hash(pipeline))
+        if not refs:
+            return []
+        entries = [e for e in cache.list()
+                   if os.path.basename(e["path"]) in set(refs.values())]
+    except Exception:  # noqa: BLE001 - an info hint must never fail lint
+        return []
+    n_poly = sum(1 for e in entries if e.get("poly"))
+    return [make(
+        "NNL015",
+        f"AOT compile cache holds {len(refs)} artifact(s) covering this "
+        f"topology ({n_poly} shape-poly — serving buckets covered by a "
+        "single artifact per stage): restarts, hot-swap prepares, and "
+        "replica spawns load instead of compiling",
+        location=next(iter(pipeline.elements), ""),
+        hint="inspect with `python -m nnstreamer_tpu aot list` "
+             "(docs/aot.md)")]

@@ -1,0 +1,53 @@
+"""AOT compile-artifact plane: persistent compilation cache +
+shape-polymorphic StableHLO lowering (ROADMAP item 3).
+
+Rounds r02–r05 measured 13–34 s first-compile; every supervised
+restart, ``registry://`` hot-swap prepare, and fresh subprocess replica
+paid it again, and a flexible-caps stream multiplied it per serving
+bucket (the NNL008 recompile storm). This package makes compiled stage
+programs **first-class serializable artifacts**:
+
+* :mod:`.export` lowers a stage callable once through ``jax.export``
+  with a symbolic batch dim — ONE artifact covers every serving bucket;
+* :mod:`.cache` persists the serialized program keyed like a
+  ``ProfileArtifact`` (topology, caps, model version) + device signature,
+  LRU-bounded, with jax's persistent XLA compilation cache attached
+  under the same root so warm restarts skip the XLA pass too.
+
+Consumers: ``runtime/fusion.py`` (fused segments load-or-export at
+``_build``), ``backends/jax_backend.py`` (singleton filters),
+``service/procreplica.py`` (replicas warm through artifacts before
+READY), ``runtime/placement.py`` (plans embed artifact refs — the
+shippable compiled units ROADMAP item 5 needs). Everything is off
+unless ``NNS_AOT_CACHE`` names a directory. See docs/aot.md.
+"""
+from .cache import (
+    CACHE_ENV,
+    CACHE_MAX_ENV,
+    STATS,
+    CompileCache,
+    backend_key,
+    default_cache,
+    device_signature,
+    element_config_digest,
+    pipeline_key,
+    render_section,
+    reset_stats,
+    segment_identity,
+    snapshot,
+)
+from .export import (
+    ExportError,
+    LoadedArtifact,
+    export_stage,
+    fabricate_inputs,
+    load_artifact,
+)
+
+__all__ = [
+    "CACHE_ENV", "CACHE_MAX_ENV", "STATS", "CompileCache", "backend_key",
+    "default_cache", "device_signature", "element_config_digest",
+    "pipeline_key", "render_section", "reset_stats", "segment_identity",
+    "snapshot", "ExportError", "LoadedArtifact", "export_stage",
+    "fabricate_inputs", "load_artifact",
+]

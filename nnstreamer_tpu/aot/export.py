@@ -1,0 +1,216 @@
+"""Shape-polymorphic StableHLO export/import machinery (L4').
+
+The producing half of the AOT artifact plane: a jax-traceable stage
+callable (a fused segment's composed function, a singleton filter's
+invoke) is lowered ONCE through ``jax.export`` with a **symbolic batch
+dimension** and serialized to portable StableHLO bytes; the consuming
+half deserializes those bytes and serves through the exported program —
+no Python re-trace of the model, ever, and ONE artifact covers every
+serving bucket (batch 1, 2, 4, ... all satisfy the symbolic ``b``).
+
+Poly-dim rules (docs/aot.md#poly-dim-rules):
+
+* dimension 0 of every array leaf is lowered as the shared symbol ``b``
+  (one scope — all leading dims are the SAME batch); trailing dims stay
+  concrete;
+* rank-0 leaves (scalars) have no batch axis and stay fully concrete;
+* a computation whose result depends on the CONCRETE batch value (fixed
+  reshapes, ragged gathers) fails symbolic export — :func:`export_stage`
+  then falls back to a static export for the observed signature (the
+  artifact still kills the restart cold start, it just covers one
+  bucket), and a stage that cannot export at all raises — the caller
+  serves plain ``jax.jit`` and reports the failure.
+
+``LoadedArtifact.call`` is a ``jax.jit`` of the deserialized program:
+per concrete batch size XLA still specializes the StableHLO module, but
+that compile (a) involves zero Python tracing and (b) lands in the
+persistent XLA compilation cache the :class:`~.cache.CompileCache`
+attaches — so across restarts/replicas even the XLA half is a disk hit.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.log import logger
+
+#: meta-schema marker for symbolic dims in serialized aval shapes
+_SYM = "b"
+
+
+def _leaf_dtype(x) -> "np.dtype":
+    dt = getattr(x, "dtype", None)
+    return np.dtype(dt) if dt is not None else np.asarray(x).dtype
+
+
+def _poly_arg_specs(example_args: tuple):
+    """ShapeDtypeStructs mirroring ``example_args`` (a pytree of arrays)
+    with dim 0 of every rank>=1 leaf replaced by ONE shared symbolic
+    batch dim."""
+    import jax
+    from jax import export as jexp
+
+    (b,) = jexp.symbolic_shape(_SYM)
+
+    def spec(x):
+        shape = tuple(np.shape(x))
+        if shape:
+            return jax.ShapeDtypeStruct((b, *shape[1:]), _leaf_dtype(x))
+        return jax.ShapeDtypeStruct(shape, _leaf_dtype(x))
+
+    return jax.tree_util.tree_map(spec, example_args)
+
+
+def _static_arg_specs(example_args: tuple):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(tuple(np.shape(x)), _leaf_dtype(x)),
+        example_args)
+
+
+def _aval_cells(avals) -> List[dict]:
+    """Serializable (shape, dtype) cells for artifact meta; symbolic dims
+    render as their symbol string (``"b"``)."""
+    from jax import export as jexp
+
+    cells = []
+    for a in avals:
+        cells.append({
+            "shape": [str(d) if jexp.is_symbolic_dim(d) else int(d)
+                      for d in a.shape],
+            "dtype": str(np.dtype(a.dtype)),
+        })
+    return cells
+
+
+class ExportError(RuntimeError):
+    """The stage could not be exported (neither poly nor static)."""
+
+
+class LoadedArtifact:
+    """A deserialized (or freshly exported) stage program ready to serve.
+
+    ``call(*args)`` executes the exported StableHLO under ``jax.jit``
+    (jit's signature cache makes repeat dispatches one C++ hop, exactly
+    like a traced callable). ``compatible(args)`` checks a concrete
+    positional-argument tuple against the program's in_avals — structure,
+    dtypes, ranks, and every NON-symbolic dim must match; symbolic dims
+    accept any size >= 1."""
+
+    __slots__ = ("exported", "call", "poly")
+
+    def __init__(self, exported, poly: bool):
+        import jax
+
+        self.exported = exported
+        self.poly = bool(poly)
+        self.call = jax.jit(exported.call)
+
+    @property
+    def in_avals(self):
+        return self.exported.in_avals
+
+    @property
+    def out_avals(self):
+        return self.exported.out_avals
+
+    def compatible(self, args: tuple) -> bool:
+        import jax
+        from jax import export as jexp
+
+        leaves = jax.tree_util.tree_leaves(args)
+        avals = self.exported.in_avals
+        if len(leaves) != len(avals):
+            return False
+        for x, a in zip(leaves, avals):
+            shape = tuple(np.shape(x))
+            if len(shape) != len(a.shape):
+                return False
+            if _leaf_dtype(x) != np.dtype(a.dtype):
+                return False
+            for got, want in zip(shape, a.shape):
+                if jexp.is_symbolic_dim(want):
+                    if int(got) < 1:  # symbolic dims are constrained >= 1
+                        return False
+                elif int(got) != int(want):
+                    return False
+        return True
+
+    def __repr__(self):
+        return (f"LoadedArtifact<poly={self.poly} "
+                f"in={len(self.exported.in_avals)} avals>")
+
+
+def export_stage(fn: Callable, example_args: tuple, poly: bool = True
+                 ) -> Tuple[bytes, dict, "LoadedArtifact"]:
+    """Lower ``fn`` (called as ``fn(*example_args)``) to serialized
+    StableHLO. Returns ``(blob, meta, loaded)`` — ``loaded`` is the
+    freshly exported program itself, so the exporting process serves
+    through EXACTLY the module a warm restart will deserialize (and
+    primes the persistent XLA cache with the same executable).
+
+    ``poly=True`` tries the symbolic-batch lowering first and falls back
+    to a static export when the computation rejects symbolic dims; the
+    ``meta["poly"]`` flag records which one the artifact is. Raises
+    :class:`ExportError` when neither lowers.
+    """
+    import jax
+    from jax import export as jexp
+
+    jit_fn = jax.jit(fn)
+    exported = None
+    is_poly = False
+    poly_err: Optional[Exception] = None
+    if poly:
+        try:
+            exported = jexp.export(jit_fn)(*_poly_arg_specs(example_args))
+            is_poly = True
+        except Exception as e:  # noqa: BLE001 - fall back to static export
+            poly_err = e
+    if exported is None:
+        try:
+            exported = jexp.export(jit_fn)(*_static_arg_specs(example_args))
+        except Exception as e:  # noqa: BLE001 - reported as ExportError
+            raise ExportError(
+                f"stage export failed (poly: {poly_err}; static: {e})"
+            ) from e
+        if poly_err is not None:
+            logger.info("aot: symbolic-batch export rejected (%s) — "
+                        "exported static artifact instead", poly_err)
+    blob = exported.serialize()
+    meta = {
+        "poly": is_poly,
+        "in_avals": _aval_cells(exported.in_avals),
+        "out_avals": _aval_cells(exported.out_avals),
+        "platforms": list(exported.platforms),
+        "nbytes": len(blob),
+    }
+    return blob, meta, LoadedArtifact(exported, is_poly)
+
+
+def load_artifact(blob: bytes, poly: Optional[bool] = None
+                  ) -> LoadedArtifact:
+    """Deserialize StableHLO bytes into a servable program. ``poly`` is
+    the meta hint; when None it is re-derived from the in_avals."""
+    from jax import export as jexp
+
+    exported = jexp.deserialize(blob)
+    if poly is None:
+        poly = any(jexp.is_symbolic_dim(d)
+                   for a in exported.in_avals for d in a.shape)
+    return LoadedArtifact(exported, poly)
+
+
+def fabricate_inputs(meta: dict, batch: int = 1) -> List[np.ndarray]:
+    """Concrete zero arrays shaped like an artifact's recorded in_avals,
+    with every symbolic dim substituted by ``batch`` — what a replica's
+    warmup fabricates when its caps are not static (docs/aot.md#replica
+    hand-off). Returns a flat list (the wire carries flat tensor lists)."""
+    out = []
+    for cell in meta.get("in_avals", []):
+        shape = tuple(int(batch) if isinstance(d, str) else int(d)
+                      for d in cell["shape"])
+        out.append(np.zeros(shape, dtype=np.dtype(cell["dtype"])))
+    return out

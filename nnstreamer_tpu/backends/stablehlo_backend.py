@@ -67,15 +67,31 @@ class StableHloBackend(FilterBackend):
             out = (out,)
         return list(out)
 
+    def fusion_callable(self):
+        """An exported program's ``call`` IS jax-traceable (it inlines as
+        a StableHLO sub-module), so artifact-loaded filters join fused
+        device segments like any traced model — the fused-vs-host byte
+        parity contract holds for segments built over deserialized
+        programs (tests/test_aot.py)."""
+        call = self._call
+        if call is None:
+            return None
 
-def export_callable(fn, example_inputs, path: str) -> None:
-    """Helper: serialize a jax callable to a ``.jaxexport`` file loadable by
-    this backend (the artifact-producing side)."""
-    import jax
-    from jax import export
+        def stage(*xs):
+            out = call(*xs)
+            return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        return stage
 
-    args = [jax.ShapeDtypeStruct(np.asarray(a).shape, np.asarray(a).dtype)
-            for a in example_inputs]
-    exp = export.export(jax.jit(fn))(*args)
+
+def export_callable(fn, example_inputs, path: str,
+                    poly: bool = False) -> None:
+    """Helper: serialize a jax callable to a ``.jaxexport`` file loadable
+    by this backend (the artifact-producing side). ``poly=True`` lowers
+    dim 0 of every input as a shared symbolic batch dim, so one file
+    serves every batch size (nnstreamer_tpu/aot — docs/aot.md)."""
+    from ..aot import export_stage
+
+    args = tuple(np.asarray(a) for a in example_inputs)
+    blob, _meta, _loaded = export_stage(fn, args, poly=poly)
     with open(path, "wb") as fh:
-        fh.write(exp.serialize())
+        fh.write(blob)

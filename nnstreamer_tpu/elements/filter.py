@@ -519,10 +519,13 @@ class TensorFilter(TransformElement):
     def _invalidate_fused(self) -> None:
         """A model swap changed what this element computes: drop the
         segment's cached callable so the next buffer re-traces against
-        the new backend (service canary/swap path stays correct)."""
+        the new backend (service canary/swap path stays correct), and
+        evict the retiring generation's AOT artifact — the old version's
+        compiled program leaves the cache with its backend, so a stale
+        artifact can never outlive a swap (nnstreamer_tpu/aot)."""
         seg = self._fusion_member
         if seg is not None:
-            seg.invalidate()
+            seg.invalidate(evict_aot=True)
 
     # -- QoS (reference tensor_filter.c:512) --------------------------------
     def handle_src_event(self, pad: Pad, event: Event) -> None:

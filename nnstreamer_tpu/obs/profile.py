@@ -1044,7 +1044,8 @@ def render_top(profile_snap: dict, slo_status: List[dict],
                memory: Optional[dict] = None,
                quality: Optional[dict] = None,
                autoscale: Optional[List[dict]] = None,
-               fleet: Optional[List[dict]] = None) -> str:
+               fleet: Optional[List[dict]] = None,
+               aot: Optional[dict] = None) -> str:
     """The ``obs top`` one-shot/watch dashboard: per-element rates,
     queue waits + depths, fused quantiles, request series, SLO burn,
     a MEMORY section (device watermarks, stage byte estimates, queue
@@ -1130,6 +1131,12 @@ def render_top(profile_snap: dict, slo_status: List[dict],
             lines.append(
                 f"  {name:<40} {s['p50_ms']:>9.2f} {s['p99_ms']:>9.2f} "
                 f"{s['max_ms']:>9.2f} {s['count']:>8d} {s['errors']:>6d}")
+    if aot and (aot.get("active") or any(aot.get("counters", {}).values())):
+        from .. import aot as aot_plane
+
+        # AOT compile-cache section (nnstreamer_tpu/aot): hit/miss/
+        # export/eviction totals + the artifact inventory
+        lines.extend(aot_plane.render_section(aot))
     if memory:
         from . import memory as obs_memory
 

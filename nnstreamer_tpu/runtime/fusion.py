@@ -268,26 +268,49 @@ class FusedSegment:
             if type(el).fusion_gate is not Element.fusion_gate
         ]
         self._donate = _donation_safe(self.head)
+        # AOT compile cache (nnstreamer_tpu/aot): the (cache, key, stage,
+        # digest) identity of the artifact the current trace generation
+        # was built against — what invalidate(evict_aot=True) evicts when
+        # a model swap retires the generation. None = plain jit build.
+        self._aot_built = None   # guarded-by: _lock
         self.stats = {
             "elements": len(self.elements),
             "dispatches": 0,
             "retraces": 0,
             "defused": 0,
+            "aot_hits": 0,
+            "aot_exports": 0,
             "total_s": 0.0,
             "probe_device_s": 0.0,
         }
 
     # -- cache control -------------------------------------------------------
-    def invalidate(self) -> None:
+    def invalidate(self, evict_aot: bool = False) -> None:
         """Drop the cached callable: caps renegotiation, hot model swap
         (``filter.commit_model``/``reload_model``), and restart paths call
         this so the next buffer re-resolves against current state. Also
         re-arms a defused segment (a canary router swapped back to a
-        traceable primary re-fuses)."""
+        traceable primary re-fuses).
+
+        ``evict_aot=True`` (the model-swap path) additionally evicts the
+        retiring generation's AOT artifact from the compile cache — the
+        old model's compiled program leaves disk with its backend. Caps
+        events and placement changes pass False: the artifact stays for
+        the restart/replica warm path (the rebuild re-keys anyway, so a
+        kept artifact can never serve a stale model)."""
         with self._lock:
             self._gen += 1
             self._call = None
             self._defused = False
+            built = self._aot_built
+            if evict_aot:
+                self._aot_built = None
+        if evict_aot and built is not None:
+            cache, key, stage, digest = built
+            try:
+                cache.evict(key, stage, digest)
+            except OSError:  # a shared cache dir raced us; eviction is GC
+                pass
         # the same events that invalidate the trace invalidate the
         # placement decision (caps renegotiation changes tensor sizes,
         # a hot swap changes the model's cost): tell the planner so the
@@ -314,7 +337,86 @@ class FusedSegment:
         """The planner-assigned chip (None = jax default device)."""
         return self._device
 
-    def _build(self) -> Optional[Callable]:
+    def _aot_resolve(self, composed: Callable, example_args: tuple,
+                     pipe) -> Optional[Callable]:
+        """AOT compile-cache consult (nnstreamer_tpu/aot): load this
+        segment's exported program, or export the freshly composed one.
+        Either way the segment then serves THROUGH the artifact — the
+        exporting process and every warm restart run the identical
+        StableHLO module (and share its persistent XLA cache entries).
+        Returns None when the cache is off, the segment donates input
+        buffers or is pinned to a device (an exported program can honor
+        neither), or the stage refuses to lower — the caller falls back
+        to plain ``jax.jit``, which is always correct."""
+        from .. import aot
+
+        cache = aot.default_cache()
+        if cache is None:
+            return None
+        key = aot.pipeline_key(pipe) if pipe is not None else None
+        if key is None:
+            return None
+
+        def guard(loaded):
+            # serve through the artifact while it covers the buffer
+            # shape; a buffer outside its avals (trailing dims varied
+            # under flexible caps — only the batch dim is symbolic)
+            # falls back to plain jit, which retraces per shape exactly
+            # as the pre-AOT path did, instead of erroring mid-stream.
+            # The verdict is memoized per signature: the aval walk runs
+            # once per NEW shape, never per dispatch
+            import jax
+
+            fallback = None
+            verdicts: dict = {}
+
+            def serve(args):
+                nonlocal fallback
+                sig = tuple(
+                    (getattr(x, "shape", None), getattr(x, "dtype", None))
+                    for x in args)
+                ok = verdicts.get(sig)
+                if ok is None:
+                    if len(verdicts) > 512:  # flexible streams: bound it
+                        verdicts.clear()
+                    ok = verdicts[sig] = loaded.compatible((args,))
+                if ok:
+                    return loaded.call(args)
+                if fallback is None:
+                    fallback = jax.jit(composed)
+                return fallback(args)
+            # _record_memory lowers the served program for its one-shot
+            # estimate; the exported module is what actually runs, so
+            # hand its jit through (a closure has no .lower of its own)
+            serve.lower = loaded.call.lower
+            return serve
+
+        stage, digest = aot.segment_identity(self.elements)
+        loaded = cache.load(key, stage, digest)
+        if loaded is not None and loaded.compatible((example_args,)):
+            with self._lock:
+                self._aot_built = (cache, key, stage, digest)
+            self.stats["aot_hits"] += 1
+            return guard(loaded)
+        try:
+            blob, meta, fresh = aot.export_stage(
+                composed, (example_args,), poly=True)
+        except aot.ExportError as e:
+            logger.info("fused segment %s: AOT export failed (%s) — "
+                        "serving plain jit", self.name, e)
+            return None
+        cache.save(key, stage, digest, blob, meta)
+        with self._lock:
+            self._aot_built = (cache, key, stage, digest)
+        self.stats["aot_exports"] += 1
+        logger.info("fused segment %s: exported %s AOT artifact "
+                    "(%d bytes) for stage %s", self.name,
+                    "shape-poly" if meta["poly"] else "static",
+                    meta["nbytes"], stage)
+        return guard(fresh)
+
+    def _build(self, example_args: Optional[tuple] = None
+               ) -> Optional[Callable]:
         import jax
 
         # a dirty placement plan (hot swap / caps event marked it) is
@@ -350,19 +452,31 @@ class FusedSegment:
                 xs = stage(xs)
             return xs
 
-        jit_kw: dict = {}
-        if self._donate:
-            jit_kw["donate_argnums"] = (0,)
-        if device is not None:
-            # placement: the composed dispatch lowers FOR the assigned
-            # chip; explicit in_shardings also reshards committed inputs
-            # arriving from an upstream stage's device (the cross-stage
-            # hop moves device-to-device inside the jit call's C++ arg
-            # processing — no Python-side device_put on the hot path)
-            from jax.sharding import SingleDeviceSharding
+        jitted = None
+        if example_args is not None and not self._donate and device is None:
+            # AOT path: donation aliases HBM in a way a deserialized
+            # program cannot replicate, and a pinned segment must lower
+            # for its assigned chip — both keep the plain-jit path below
+            try:
+                jitted = self._aot_resolve(composed, example_args, pipe)
+            except Exception:  # noqa: BLE001 - cache trouble != data loss
+                logger.exception(
+                    "fused segment %s: AOT cache consult failed — "
+                    "serving plain jit", self.name)
+        if jitted is None:
+            jit_kw: dict = {}
+            if self._donate:
+                jit_kw["donate_argnums"] = (0,)
+            if device is not None:
+                # placement: the composed dispatch lowers FOR the assigned
+                # chip; explicit in_shardings also reshards committed inputs
+                # arriving from an upstream stage's device (the cross-stage
+                # hop moves device-to-device inside the jit call's C++ arg
+                # processing — no Python-side device_put on the hot path)
+                from jax.sharding import SingleDeviceSharding
 
-            jit_kw["in_shardings"] = SingleDeviceSharding(device)
-        jitted = jax.jit(composed, **jit_kw)
+                jit_kw["in_shardings"] = SingleDeviceSharding(device)
+            jitted = jax.jit(composed, **jit_kw)
         # publish only if no invalidation raced the build (a commit_model
         # between stage resolution and here must win)
         with self._lock:
@@ -404,7 +518,9 @@ class FusedSegment:
         if call is None:
             if self._defused:
                 return False
-            call = self._build()
+            # the first buffer's tensors are the example signature the
+            # AOT plane lowers/validates against (batch dim symbolic)
+            call = self._build(tuple(buf.tensors))
             if call is None:
                 return False
         for gate in self._gates:

@@ -159,6 +159,13 @@ class PlacementPlan:
     shard_weights: Dict[str, List[float]] = field(default_factory=dict)
     source: str = "heuristic"  # "profile" | "heuristic" | "explicit"
     balance: Dict[str, float] = field(default_factory=dict)
+    # AOT compile-cache artifact refs: {stage id: artifact file basename}
+    # for stages whose compiled program is already exported
+    # (nnstreamer_tpu/aot). A plan shipped to a remote replica thereby
+    # names the exact serialized compiled units its stages need — the
+    # host reaches READY with neither local profiling nor compilation
+    # (ROADMAP item 5 hand-off). Empty when the AOT plane is off.
+    aot: Dict[str, str] = field(default_factory=dict)
 
     def stage_for(self, stage_key: str) -> Optional[StagePlacement]:
         for st in self.stages:
@@ -179,6 +186,7 @@ class PlacementPlan:
                               in sorted(self.shard_weights.items())},
             "source": self.source,
             "balance": dict(self.balance),
+            "aot": dict(sorted(self.aot.items())),
         }
 
     @classmethod
@@ -196,6 +204,7 @@ class PlacementPlan:
                            for k, v in (d.get("shard_weights") or {}).items()},
             source=d.get("source", "explicit"),
             balance=dict(d.get("balance", {})),
+            aot={str(k): str(v) for k, v in (d.get("aot") or {}).items()},
         )
 
     def describe(self) -> str:
@@ -442,6 +451,17 @@ class Planner:
 
         self._tune_queues(pipeline, artifact, plan)
         self._shard_weights(pipeline, artifact, plan)
+        # reference the compiled units: stages whose exported AOT
+        # artifact already exists are named in the plan, so shipping the
+        # plan + the named cache files to a remote host hands over both
+        # the placement decision AND the compiled programs it places
+        from .. import aot as aot_cache
+
+        cache = aot_cache.default_cache()
+        if cache is not None:
+            refs = cache.stage_artifacts(plan.key.get("topology", ""))
+            stages = {s.stage for s in plan.stages}
+            plan.aot = {k: v for k, v in refs.items() if k in stages}
         return plan
 
     # makespan minimization (multiprocessor scheduling) is NP-hard in

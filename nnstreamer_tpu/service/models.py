@@ -12,7 +12,12 @@ new version without stopping the pipeline:
                    keeps serving every frame meanwhile);
     warmup       — invoke the new backend once on zeros shaped like the
                    negotiated input (a model that cannot serve must fail
-                   HERE, not on live traffic);
+                   HERE, not on live traffic); with the AOT compile cache
+                   active (``NNS_AOT_CACHE``, nnstreamer_tpu/aot) this
+                   warmup invoke PRE-WARMS FROM CACHE: the prepared
+                   backend deserializes the version's exported artifact
+                   instead of tracing+compiling, so prepare cost drops
+                   from seconds to an artifact load;
     atomic flip  — swap the element's backend pointer under its invoke
                    lock (one pointer store: no frame ever sees a
                    half-swapped model);
@@ -25,7 +30,11 @@ carries the cause.
 Fused-segment interaction (runtime/fusion.py): a filter running inside a
 fused device segment serves through a COMPOSED jitted callable, not its
 own backend dispatch. ``commit_model`` invalidates the segment right
-after the flip, so the next buffer re-traces against the new backend; a
+after the flip — and evicts the retired version's AOT artifact by key
+(the compile-cache digest covers the RESOLVED model each backend
+serves, so a ``registry://`` swap or canary promote always lands on a
+fresh key and can never be served a stale compiled program) — so the
+next buffer re-resolves against the new backend; a
 canary router (no traceable callable) defuses its segment for the canary
 window and the promote/cancel commit re-fuses it. Fractional **canary** routing wraps the live backend
 in a deterministic splitter that sends ``fraction`` of invokes to the

@@ -545,12 +545,56 @@ class ProcReplicaSet:
 # child side: the `python -m nnstreamer_tpu replica` runner
 # ---------------------------------------------------------------------------
 
+def _aot_warmup_inputs(pipeline) -> Optional[list]:
+    """Batch-1 zeros fabricated from a cached AOT artifact's recorded
+    in_avals for this pipeline's head device stage (symbolic batch dims
+    substitute 1) — how a flexible-caps replica warms up anyway: the
+    artifact knows the trailing dims the caps string does not declare.
+    None when the AOT plane is off or no artifact covers the topology."""
+    if pipeline is None:
+        return None
+    from .. import aot
+    from ..obs import profile as obs_profile
+
+    cache = aot.default_cache()
+    if cache is None:
+        return None
+    try:
+        # only the topology half of the key matters here (metas() wants
+        # no caps/device context — and the full pipeline_key would read
+        # negotiated caps on a pipeline that has not negotiated yet:
+        # warmup runs before the first client connect)
+        topo = obs_profile.topology_hash(pipeline)
+        # metas() returns filename-hash order; the wire input matches the
+        # HEAD device stage's in_avals, so rank each artifact by where
+        # its stage head sits in the pipeline (downstream segments'
+        # shapes would fail negotiation)
+        position = {obs_profile.canonical_base(el): idx
+                    for idx, el in enumerate(pipeline.elements.values())}
+
+        def head_rank(meta: dict) -> int:
+            head = str(meta.get("stage", "")).split("..", 1)[0]
+            return position.get(head, len(position))
+        for meta in sorted(cache.metas(topology=topo), key=head_rank):
+            inputs = aot.fabricate_inputs(meta, batch=1)
+            if inputs:
+                return inputs
+    except Exception:  # noqa: BLE001 - fabrication is best-effort
+        logger.exception("replica warmup: AOT input fabrication failed")
+    return None
+
+
 def _warmup_self(host: str, port: int, caps_str: str,
-                 timeout: float = 60.0) -> None:
+                 timeout: float = 60.0, pipeline=None) -> None:
     """One inference through the real query wire against ourselves, so
-    jit compilation and caps negotiation complete BEFORE the READY line
-    admits us to any ring. Flexible caps skip (no static shape to
-    fabricate)."""
+    compilation and caps negotiation complete BEFORE the READY line
+    admits us to any ring. Static caps fabricate zeros directly; with a
+    shape-poly AOT artifact a non-static batch dim no longer forbids
+    warmup — the cached artifact's in_avals supply the shapes and the
+    warmup request loads+runs the compiled program (docs/aot.md#replica
+    -hand-off). Only when no artifact covers the topology either does
+    the replica still skip, and that skip is now a ``replica``/
+    ``warmup_skipped`` flight event, not just a log line."""
     import numpy as np
 
     from ..core import parse_caps_string
@@ -558,19 +602,45 @@ def _warmup_self(host: str, port: int, caps_str: str,
     from ..query.client import QueryClient
 
     caps = parse_caps_string(caps_str)
+    fabricated = False
     try:
         info = tensors_info_from_caps(caps)
+        if not info.specs:
+            # format=flexible parses fine but declares zero static
+            # specs — an empty warmup buffer exercises nothing
+            raise ValueError("flexible caps declare no tensor specs")
         zeros = [np.zeros(tuple(s.shape), dtype=s.dtype.np_dtype)
                  for s in info.specs]
     except Exception as e:  # noqa: BLE001 - flexible/partial caps
-        logger.info("replica warmup skipped (caps not static: %s)", e)
-        return
+        zeros = _aot_warmup_inputs(pipeline)
+        if zeros is None:
+            obs_flight.record("replica", "warmup_skipped",
+                              {"reason": f"caps not static: {e}",
+                               "caps": caps_str, "port": port})
+            logger.info("replica warmup skipped (caps not static: %s; "
+                        "no AOT artifact to fabricate from)", e)
+            return
+        fabricated = True
+        logger.info("replica warmup: caps not static (%s) — fabricated "
+                    "batch-1 inputs from the cached AOT artifact", e)
     client = QueryClient(host, port, timeout=timeout)
     try:
-        client.connect(caps)
         from ..core import Buffer
 
-        client.request(Buffer(zeros), timeout=timeout)
+        try:
+            client.connect(caps)
+            client.request(Buffer(zeros), timeout=timeout)
+        except Exception as e:  # noqa: BLE001 - fabricated shapes may
+            # not negotiate (and flexible caps may not even connect); a
+            # failed OPTIONAL warmup must not kill the replica — the
+            # static-caps contract never reaches this branch
+            if not fabricated:
+                raise
+            obs_flight.record("replica", "warmup_skipped",
+                              {"reason": f"fabricated warmup failed: {e}",
+                               "caps": caps_str, "port": port})
+            logger.info("replica warmup: fabricated warmup failed (%s) "
+                        "— continuing without warmup", e)
     finally:
         client.close()
 
@@ -648,7 +718,13 @@ def run_replica(args) -> int:
         # inside the normal evict→probe→readmit window.
         el.props["port"] = port
         if args.warmup:
-            _warmup_self(args.host, port, args.caps)
+            # AOT plane (NNS_AOT_CACHE inherited from the parent): this
+            # warmup inference loads the topology's exported artifacts
+            # instead of tracing+compiling, so a fresh ProcReplica
+            # reaches READY compile-free — the autoscaler's
+            # time-to-capacity is an artifact load, not a cold start
+            _warmup_self(args.host, port, args.caps,
+                         pipeline=svc.pipeline)
         server = ControlServer(mgr, host=args.host,
                                port=args.control_port).start()
         if args.advertise:

@@ -401,75 +401,113 @@ class TestInvalidation:
         assert seg2.stats["dispatches"] == 6
         assert pipe.get("out").buffer_count >= 6
 
+    def _crash_restart_swap(self, mgr, slot):
+        """Shared scenario for the staleness regressions: tensor_fault
+        crash → supervised restart → registry:// hot swap mid-stream.
+        Returns (post-restart fused segment, drained first-component
+        values) — the caller asserts its plane's staleness contract."""
+        from nnstreamer_tpu.service import RestartPolicy, ServiceState
+
+        mgr.models.define(
+            slot, {"1": "builtin://scaler?factor=2"}, active="1")
+        svc = mgr.register(
+            f"fused-crash-swap-{slot}",
+            "tensor_src num-buffers=200 framerate=400 dimensions=4 "
+            "types=float32 pattern=counter "
+            "! tensor_transform mode=arithmetic option=add:0 "
+            f"! tensor_filter framework=jax model=registry://{slot} "
+            "name=f "
+            "! tensor_fault name=flt crash-at-buffer=12 "
+            "! tensor_sink name=out max-stored=512",
+            restart=RestartPolicy(mode="on-failure",
+                                  backoff_base_s=0.05, jitter=0.0))
+        svc.start()
+        # wait for the crash + restart to complete (restarts == 1)
+        deadline = time.monotonic() + 20
+        while (svc.supervisor.restarts < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert svc.supervisor.restarts == 1
+        # the restarted run serves through a FRESH fused segment:
+        # wait until it actually dispatched post-restart traffic
+        seg = None
+        while time.monotonic() < deadline:
+            segs = svc.pipeline.fused_segments
+            if segs and segs[0].stats["dispatches"] > 0:
+                seg = segs[0]
+                break
+            time.sleep(0.02)
+        assert seg is not None, "restarted run never fused/dispatched"
+        out = svc.pipeline.get("out")
+        # now hot-swap the registry slot mid-stream
+        mgr.models.add_version(slot, "2", "builtin://scaler?factor=5")
+        mgr.models.swap(slot, "2")
+        n_at_swap = out.buffer_count
+        while (out.buffer_count < n_at_swap + 10
+               and time.monotonic() < deadline
+               and svc.state is ServiceState.READY):
+            time.sleep(0.02)
+        vals = []
+        for _ in range(512):  # bounded: the pipeline may still be live
+            b = out.pull(timeout=0.2)
+            if b is None:
+                break
+            vals.append(float(np.asarray(b.tensors[0])[0]))
+        return seg, vals
+
+    @staticmethod
+    def _assert_swap_took(vals):
+        # every value is counter*2 (pre-swap) or counter*5 (post);
+        # a stale fused callable would keep emitting *2 forever
+        assert vals, "no output after restart+swap"
+        seen5 = False
+        for v in vals:
+            assert v % 2.0 == 0.0 or v % 5.0 == 0.0
+            if v != 0.0 and v % 5.0 == 0.0 and v % 2.0 != 0.0:
+                seen5 = True
+        assert seen5, f"swap never took effect in fused path: {vals[-10:]}"
+
     def test_supervised_restart_and_registry_swap_not_stale(self):
         """Satellite regression: a tensor_fault crash triggers a
         supervised restart, then a registry:// hot swap — neither may
         serve a stale fused callable (values track the ACTIVE model)."""
-        from nnstreamer_tpu.service import (
-            RestartPolicy,
-            ServiceManager,
-            ServiceState,
-        )
+        from nnstreamer_tpu.service import ServiceManager
 
         mgr = ServiceManager(jitter_seed=3)
         try:
-            mgr.models.define(
-                "fmodel", {"1": "builtin://scaler?factor=2"}, active="1")
-            svc = mgr.register(
-                "fused-crash-swap",
-                "tensor_src num-buffers=200 framerate=400 dimensions=4 "
-                "types=float32 pattern=counter "
-                "! tensor_transform mode=arithmetic option=add:0 "
-                "! tensor_filter framework=jax model=registry://fmodel "
-                "name=f "
-                "! tensor_fault name=flt crash-at-buffer=12 "
-                "! tensor_sink name=out max-stored=512",
-                restart=RestartPolicy(mode="on-failure",
-                                      backoff_base_s=0.05, jitter=0.0))
-            svc.start()
-            # wait for the crash + restart to complete (restarts == 1)
-            deadline = time.monotonic() + 20
-            while (svc.supervisor.restarts < 1
-                   and time.monotonic() < deadline):
-                time.sleep(0.02)
-            assert svc.supervisor.restarts == 1
-            # the restarted run serves through a FRESH fused segment:
-            # wait until it actually dispatched post-restart traffic
-            seg = None
-            while time.monotonic() < deadline:
-                segs = svc.pipeline.fused_segments
-                if segs and segs[0].stats["dispatches"] > 0:
-                    seg = segs[0]
-                    break
-                time.sleep(0.02)
-            assert seg is not None, "restarted run never fused/dispatched"
-            out = svc.pipeline.get("out")
-            # now hot-swap the registry slot mid-stream
-            mgr.models.add_version("fmodel", "2",
-                                   "builtin://scaler?factor=5")
-            mgr.models.swap("fmodel", "2")
-            n_at_swap = out.buffer_count
-            while (out.buffer_count < n_at_swap + 10
-                   and time.monotonic() < deadline
-                   and svc.state is ServiceState.READY):
-                time.sleep(0.02)
-            vals = []
-            while True:
-                b = out.pull(timeout=0.2)
-                if b is None:
-                    break
-                vals.append(float(np.asarray(b.tensors[0])[0]))
-            # every value is counter*2 (pre-swap) or counter*5 (post);
-            # a stale fused callable would keep emitting *2 forever
-            assert vals, "no output after restart+swap"
-            seen5 = False
-            for v in vals:
-                assert v % 2.0 == 0.0 or v % 5.0 == 0.0
-                if v != 0.0 and v % 5.0 == 0.0 and v % 2.0 != 0.0:
-                    seen5 = True
-            assert seen5, f"swap never took effect in fused path: {vals[-10:]}"
+            _seg, vals = self._crash_restart_swap(mgr, "fmodel")
+            self._assert_swap_took(vals)
         finally:
             mgr.shutdown()
+
+    def test_restart_and_swap_not_stale_with_aot_artifacts(
+            self, tmp_path, monkeypatch):
+        """The same staleness regression on the ARTIFACT plane: with the
+        AOT compile cache active, the supervised restart loads the
+        exported artifact (hit, no recompile) and the hot swap re-keys —
+        the old version's compiled program is evicted at commit and the
+        stream still tracks the active model (never a stale artifact)."""
+        from nnstreamer_tpu import aot
+        from nnstreamer_tpu.aot import cache as aot_cache
+        from nnstreamer_tpu.service import ServiceManager
+
+        monkeypatch.setenv(aot.CACHE_ENV, str(tmp_path / "aot"))
+        aot.reset_stats()
+        mgr = ServiceManager(jitter_seed=3)
+        try:
+            seg, vals = self._crash_restart_swap(mgr, "fmodel2")
+            self._assert_swap_took(vals)
+            # restart served through the cache; the swap re-exported
+            # under the new resolved-model digest and evicted the old
+            assert seg.stats["aot_hits"] >= 1, seg.stats
+            assert seg.stats["aot_exports"] >= 1, seg.stats
+            assert aot.STATS["evictions"] >= 1
+        finally:
+            mgr.shutdown()
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", None)
+            aot_cache._xla_attached = None
 
 
 # ---------------------------------------------------------------------------
