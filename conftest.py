@@ -64,6 +64,21 @@ if _TSAN:
     _sanitizer.enable(
         hold_warn_s=float(os.environ.get("NNS_TSAN_HOLD_S", "5")))
 
+# ---------------------------------------------------------------------------
+# leakcheck: NNS_LEAKCHECK=1 runs the whole session with the paired-resource
+# leak ledger enabled (calibration refcounts, spans, guard reservations,
+# tracked threads, proc replicas, metrics registrations, the AOT writer
+# lock — analysis/sanitizer.py second half). Enabled at conftest import so
+# every acquisition of the session is recorded; each test then asserts the
+# ledger returns to ITS baseline (zero NEW outstanding units) — the runtime
+# twin of the NNL3xx release-on-all-paths lint.
+# ---------------------------------------------------------------------------
+_LEAKCHECK = os.environ.get("NNS_LEAKCHECK", "") == "1"
+if _LEAKCHECK:
+    from nnstreamer_tpu.analysis import sanitizer as _leak_sanitizer
+
+    _leak_sanitizer.enable_leakcheck()
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -71,6 +86,46 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "thread_leak_ok: opt out of the per-test leaked-thread "
                    "check (intentionally long-lived fixture threads)")
+    config.addinivalue_line(
+        "markers", "leak_ok: opt out of the per-test NNS_LEAKCHECK "
+                   "zero-outstanding-resources check (intentionally "
+                   "session-lived acquisitions)")
+
+
+@pytest.fixture(autouse=True)
+def _leakcheck(request):
+    """Under NNS_LEAKCHECK=1: fail any test that ends with paired
+    resources still outstanding beyond its entry baseline. A short grace
+    window rides out teardown-time releases (joins, drain callbacks),
+    mirroring thread_leak_check."""
+    if not _LEAKCHECK:
+        yield
+        return
+    if request.node.get_closest_marker("leak_ok"):
+        yield
+        return
+
+    def keyed():
+        return {(r["kind"], r["key"]): r["count"]
+                for r in _leak_sanitizer.outstanding()}
+
+    before = keyed()
+    yield
+
+    def fresh():
+        return [
+            {"kind": k, "key": key, "count": c}
+            for (k, key), c in keyed().items()
+            if c > before.get((k, key), 0)]
+
+    deadline = time.monotonic() + 2.0
+    rest = fresh()
+    while rest and time.monotonic() < deadline:
+        time.sleep(0.05)
+        rest = fresh()
+    assert not rest, (
+        f"leakcheck: {len(rest)} paired resource(s) still outstanding "
+        f"after this test (acquire without release): {rest}")
 
 
 @pytest.fixture(autouse=True)

@@ -19,13 +19,22 @@ runtime, during caps negotiation). Two passes share one diagnostic model:
   inversions over an interprocedural lock-order graph, unguarded shared
   state (``# guarded-by:`` contracts), blocking calls under locks,
   ``Condition.wait`` without a predicate loop, threads without a join
-  path — see docs/concurrency.md for the locking model it checks.
+  path — see docs/concurrency.md for the locking model it checks;
+* **lifecycle lint** (`lint_lifecycle`, rules ``NNL3xx``): paired
+  acquire/release dataflow — releases reachable on ALL paths including
+  exception edges, refcount balance, subprocess reap paths, atomic-write
+  failure cleanup, unregister-at-stop — seeded by built-in knowledge of
+  the repo's pairs plus the ``# pairs-with: <release>`` annotation
+  convention (the resource-ownership table is in docs/lint.md).
 
-The static pass is paired with a runtime "tsan-lite" sanitizer
-(:mod:`.sanitizer`): the control plane creates its locks through
-``sanitizer.named_lock``-style factories, which return raw ``threading``
-primitives when disabled (zero overhead) and order-recording wrappers
-when enabled (``NNS_TSAN=1`` in the test suite).
+The static passes are paired with runtime sanitizers
+(:mod:`.sanitizer`): tsan-lite — the control plane creates its locks
+through ``sanitizer.named_lock``-style factories, which return raw
+``threading`` primitives when disabled (zero overhead) and
+order-recording wrappers when enabled (``NNS_TSAN=1`` in the test
+suite) — and the ``NNS_LEAKCHECK=1`` leak ledger, where the same pairs
+the lifecycle lint proves statically report their acquire/release at
+runtime and every test asserts zero outstanding units.
 
 CLI: ``python -m nnstreamer_tpu lint <pbtxt | launch-string | pkg>``
 (also ``tools/nnlint.py`` — the self-lint CI gate; ``--rules NNL2xx``
@@ -36,6 +45,7 @@ See docs/lint.md for the rule catalog.
 from .concurrency_lint import lint_concurrency  # noqa: F401
 from .diagnostics import RULES, Diagnostic, Severity  # noqa: F401
 from .graph_lint import lint_launch, lint_pbtxt, lint_pipeline  # noqa: F401
+from .lifecycle_lint import lint_lifecycle  # noqa: F401
 from .source_lint import lint_source  # noqa: F401
 
 __all__ = [
@@ -44,6 +54,7 @@ __all__ = [
     "Severity",
     "lint_concurrency",
     "lint_launch",
+    "lint_lifecycle",
     "lint_pbtxt",
     "lint_pipeline",
     "lint_source",

@@ -38,12 +38,14 @@ def add_lint_args(parser) -> None:
                         help="no value: print the rule catalog and exit; "
                              "with a value: only report matching rules — "
                              "comma-separated IDs or families "
-                             "(e.g. NNL201 or NNL2xx)")
+                             "(e.g. NNL201 or NNL3xx); 'list,FILTER' "
+                             "prints the catalog restricted to FILTER")
 
 
 def _lint_target(target: str) -> List[Diagnostic]:
     from .concurrency_lint import lint_concurrency
     from .graph_lint import lint_launch, lint_pbtxt
+    from .lifecycle_lint import lint_lifecycle
     from .source_lint import lint_source
 
     from .diagnostics import make
@@ -51,7 +53,9 @@ def _lint_target(target: str) -> List[Diagnostic]:
     p = Path(target)
     if p.is_dir() or p.suffix == ".py":
         root = str(p.parent)
-        return lint_source([p], root=root) + lint_concurrency([p], root=root)
+        return (lint_source([p], root=root)
+                + lint_concurrency([p], root=root)
+                + lint_lifecycle([p], root=root))
     if p.suffix in (".pbtxt", ".launch", ".json"):
         try:
             text = p.read_text()
@@ -85,12 +89,24 @@ def _rule_filter(spec: str):
     return match
 
 
+def _print_catalog(filter_spec: Optional[str] = None) -> None:
+    """The ``--rules`` rule-catalog listing; a family filter joins it
+    (``--rules list,NNL3xx`` prints just the lifecycle family)."""
+    match = _rule_filter(filter_spec) if filter_spec else None
+    for rule in RULES.values():
+        if match is not None and not match(rule.id):
+            continue
+        print(f"{rule.id}  {rule.severity.value:7s} {rule.title}")
+        print(f"    {rule.rationale}")
+
+
 def run_lint(args) -> int:
-    if args.rules == "list":
-        for rule in RULES.values():
-            print(f"{rule.id}  {rule.severity.value:7s} {rule.title}")
-            print(f"    {rule.rationale}")
-        return 0
+    if args.rules is not None:
+        tokens = [t.strip() for t in args.rules.split(",") if t.strip()]
+        if "list" in tokens:
+            rest = [t for t in tokens if t != "list"]
+            _print_catalog(",".join(rest) if rest else None)
+            return 0
     if not args.targets:
         # no target = the self-lint gate: strict source lint of our tree
         pkg = Path(__file__).resolve().parent.parent

@@ -40,7 +40,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .diagnostics import Diagnostic, make
-from .source_lint import _collect_pragmas, _dotted, _suppressed
+from .source_lint import _collect_pragmas, _dotted, _suppressed, skip_file
 
 # lock factory spellings: raw threading primitives and the sanitizer's
 # named factories (analysis/sanitizer.py) — the latter is what the
@@ -143,6 +143,8 @@ def lint_concurrency(paths: Sequence, *, root: Optional[str] = None
     for f in files:
         try:
             text = f.read_text()
+            if skip_file(text):
+                continue
             tree = ast.parse(text, filename=str(f))
         except (OSError, SyntaxError, ValueError) as e:
             diags.append(make("NNL100", f"cannot lint {f}: {e}",

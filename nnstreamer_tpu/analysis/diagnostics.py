@@ -147,6 +147,39 @@ _RULES = (
          "a thread is started with no reachable join in its owning class "
          "(or fire-and-forget): shutdown leaks it, and a daemon thread "
          "dying mid-operation can corrupt shared state"),
+    # -- lifecycle lint (pass 4) ----------------------------------------------
+    Rule("NNL301", Severity.ERROR, "acquire without release",
+         "a paired resource (calibration refcount, admission reservation, "
+         "live span, registered handle) is acquired but NO matching "
+         "release call is reachable — not in the function, not anywhere "
+         "in the owning class/module; every long-running process leaks "
+         "one unit per call"),
+    Rule("NNL302", Severity.WARNING, "exception path escapes holding a resource",
+         "a resource is released on the normal path only: an exception "
+         "raised between acquire and release escapes without the release "
+         "(no finally, no context manager, no release-and-reraise "
+         "handler) — one failed request leaks the unit forever"),
+    Rule("NNL303", Severity.WARNING, "refcount imbalance",
+         "a refcounted pair (begin_calibration/end_calibration, "
+         "recording enable/disable) is acquired and released an unequal "
+         "number of times across branches, loops, or early returns of "
+         "the same function — the count drifts and the OTHER users of "
+         "the shared refcount are silenced or pinned on"),
+    Rule("NNL304", Severity.WARNING, "subprocess without reap path",
+         "a subprocess.Popen handle is stored with no poll/wait/kill/"
+         "terminate/communicate call reachable in the owning scope — the "
+         "child is never reaped (zombie) and never stopped on shutdown"),
+    Rule("NNL305", Severity.WARNING, "atomic write without failure cleanup",
+         "a temp-file + os.replace/os.rename atomic-publish sequence has "
+         "no failure-path cleanup: an exception between the temp write "
+         "and the rename strands the .tmp file on disk forever (and a "
+         "retry loop strands one per attempt)"),
+    Rule("NNL306", Severity.WARNING, "registration without unregister on stop",
+         "an object registers itself into a module-level registry "
+         "(metrics weakset, ThreadRegistry, track_* scrape surfaces) "
+         "with no matching unregister/drain on its stop path — stale "
+         "entries keep publishing until GC, which for a weakref may be "
+         "never while the scrape itself holds iteration references"),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in _RULES}
@@ -163,6 +196,10 @@ class Diagnostic:
     line: Optional[int] = None    # 1-based source line (source lint)
     col: Optional[int] = None     # 0-based column (source lint)
     hint: str = ""                # optional fix suggestion
+    fix_hint: str = ""            # machine-usable fix: the exact missing
+    #                               call/edit (lifecycle rules name the
+    #                               release call); falls back to `hint`
+    #                               in to_dict() when a pass sets none
 
     @property
     def is_error(self) -> bool:
@@ -189,12 +226,14 @@ class Diagnostic:
             "line": self.line,
             "col": self.col,
             "hint": self.hint,
+            "fix_hint": self.fix_hint or self.hint,
         }
 
 
 def make(rule_id: str, message: str, *, location: str = "",
          line: Optional[int] = None, col: Optional[int] = None,
-         hint: str = "") -> Diagnostic:
+         hint: str = "", fix_hint: str = "") -> Diagnostic:
     """Build a Diagnostic with the catalog's severity for ``rule_id``."""
     return Diagnostic(rule_id, RULES[rule_id].severity, message,
-                      location=location, line=line, col=col, hint=hint)
+                      location=location, line=line, col=col, hint=hint,
+                      fix_hint=fix_hint)

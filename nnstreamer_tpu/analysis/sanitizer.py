@@ -1,4 +1,4 @@
-"""tsan-lite: opt-in runtime lock-order sanitizer for the control plane.
+"""tsan-lite + leakcheck: opt-in runtime sanitizers for the control plane.
 
 The static concurrency pass (:mod:`.concurrency_lint`) reasons about
 lock nesting it can SEE; this module records the nesting that actually
@@ -28,6 +28,31 @@ they return instrumented wrappers that
 
 Enable/disable affects locks created AFTERWARDS — wrappers already
 handed out keep recording (harmless; :func:`reset` clears the tables).
+
+**Leak sanitizer (``NNS_LEAKCHECK=1``).** The static lifecycle pass
+(:mod:`.lifecycle_lint`, rules NNL3xx) proves release-on-all-paths for
+the nesting it can SEE; this module's second half records what actually
+happens. The package's paired acquire/release protocols — calibration
+refcounts, the SLO-engine recording half, live spans, memory-guard
+reservations, ``ThreadRegistry`` tracked workers, ``ProcReplica``
+subprocesses, the AOT writer lock, metrics scrape registrations — report
+into one ledger via :func:`note_acquire` / :func:`note_release`.
+
+Disabled (the default), every ``note_*`` call is a single module-global
+check and immediate return — no allocation, no lock, nothing on any
+steady-state path (``tools/microbench_overhead.py`` gates this fast
+path at <= 2% like the tracing/profiler/memory legs). Enabled
+(:func:`enable_leakcheck`, or ``NNS_LEAKCHECK=1`` under pytest — see
+conftest.py), each acquisition lands in a per-(kind, key) ledger with
+the acquiring thread and call site; the test fixture asserts ZERO
+outstanding units at the end of every test, which turns "we released on
+every path, probably" into a gated invariant — the same treatment
+``NNS_TSAN=1`` gives lock ordering.
+
+Release without a matching acquire is ignored (the resource predates
+enabling — a mid-session ``enable_leakcheck()`` must not manufacture
+phantom leaks); ``idempotent=True`` acquisitions (weakset-style
+registrations) count once per key no matter how often re-registered.
 """
 from __future__ import annotations
 
@@ -343,3 +368,117 @@ class _TsanCondition:
 
     def notify_all(self) -> None:
         self._inner.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# NNS_LEAKCHECK — paired-resource leak ledger (see module docstring)
+# ---------------------------------------------------------------------------
+
+# module-global fast path: note_acquire/note_release check this and only
+# this when the leak sanitizer is off (the microbench leg gates it)
+LEAK = False
+
+_leak_lock = threading.Lock()   # guards the ledger tables below
+# (kind, key) -> {count, thread, site, t0, detail}
+_ledger: Dict[Tuple[str, str], dict] = {}
+_leak_totals: Dict[str, int] = {}         # kind -> total acquisitions seen
+
+
+def enable_leakcheck() -> None:
+    """Start recording paired acquisitions; clears the ledger."""
+    global LEAK
+    with _leak_lock:
+        _ledger.clear()
+        _leak_totals.clear()
+        LEAK = True
+
+
+def disable_leakcheck() -> None:
+    global LEAK
+    LEAK = False
+
+
+def leakcheck_enabled() -> bool:
+    return LEAK
+
+
+def reset_leakcheck() -> None:
+    """Drop every recorded acquisition (between test phases)."""
+    with _leak_lock:
+        _ledger.clear()
+        _leak_totals.clear()
+
+
+def note_acquire(kind: str, key: str, detail: str = "",
+                 idempotent: bool = False) -> None:
+    """Record one acquisition of a paired resource. ``idempotent=True``
+    marks set-semantics registrations (weakset add, re-track): the
+    ledger holds one unit per key no matter how often it re-registers."""
+    if not LEAK:
+        return
+    site = _site(2)
+    tname = threading.current_thread().name
+    with _leak_lock:
+        entry = _ledger.get((kind, key))
+        if entry is None:
+            entry = _ledger[(kind, key)] = {
+                "count": 0, "thread": tname, "site": site,
+                "sites": [], "t0": time.monotonic(), "detail": detail}
+        if idempotent:
+            entry["count"] = 1
+        else:
+            entry["count"] += 1
+        # a refcounted key is acquired from several callers; the leaker
+        # may not be the FIRST one, so keep every distinct site (bounded)
+        # — outstanding() reports them all
+        acq = f"{site} ({tname})"
+        if acq not in entry["sites"] and len(entry["sites"]) < 4:
+            entry["sites"].append(acq)
+        _leak_totals[kind] = _leak_totals.get(kind, 0) + 1
+
+
+def note_release(kind: str, key: str) -> None:
+    """Record one release. Unknown (kind, key) pairs are ignored — the
+    acquisition predates :func:`enable_leakcheck`, or a clamped
+    double-release (the runtime pairs clamp at zero by design)."""
+    if not LEAK:
+        return
+    with _leak_lock:
+        entry = _ledger.get((kind, key))
+        if entry is None:
+            return
+        entry["count"] -= 1
+        if entry["count"] <= 0:
+            del _ledger[(kind, key)]
+
+
+def outstanding(kind: Optional[str] = None) -> List[dict]:
+    """Currently-unreleased acquisitions, oldest first (JSON-friendly).
+    The per-test zero-outstanding assertion reads this. ``site``/
+    ``thread`` are the FIRST acquirer's; ``sites`` lists every distinct
+    acquirer seen (bounded) — for refcounted keys the leaker can be any
+    of them, and ``held_s`` measures from the first acquire."""
+    now = time.monotonic()
+    with _leak_lock:
+        rows = [
+            {"kind": k, "key": key, "count": e["count"],
+             "thread": e["thread"], "site": e["site"],
+             "sites": list(e["sites"]),
+             "held_s": round(now - e["t0"], 3), "detail": e["detail"]}
+            for (k, key), e in _ledger.items()
+            if kind is None or k == kind]
+    rows.sort(key=lambda r: -r["held_s"])
+    return rows
+
+
+def leak_report() -> dict:
+    """Everything the leak ledger knows (JSON-friendly)."""
+    with _leak_lock:
+        totals = dict(_leak_totals)
+    rows = outstanding()
+    return {
+        "enabled": LEAK,
+        "acquired_total": totals,
+        "outstanding": rows,
+        "outstanding_units": sum(r["count"] for r in rows),
+    }

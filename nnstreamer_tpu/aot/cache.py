@@ -33,6 +33,7 @@ import os
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis import sanitizer as _san
 from ..obs import metrics as obs_metrics
 from ..utils.log import logger
 from .export import LoadedArtifact, load_artifact
@@ -256,6 +257,8 @@ class CompileCache:
         flags = os.O_CREAT | os.O_EXCL | os.O_WRONLY
         try:
             os.close(os.open(lock, flags))
+            if _san.LEAK:
+                _san.note_acquire("aot_save_lock", lock)
             return True
         except FileExistsError:
             pass
@@ -264,6 +267,8 @@ class CompileCache:
                 return False
             os.remove(lock)  # crashed writer: break the stale lock
             os.close(os.open(lock, flags))
+            if _san.LEAK:
+                _san.note_acquire("aot_save_lock", lock)
             return True
         except OSError:  # raced another breaker, or lock vanished
             return False
@@ -286,16 +291,31 @@ class CompileCache:
             "sha256": hashlib.sha256(blob).hexdigest(),
             **meta,
         }
+        tmp = path + ".tmp"
+        mtmp = self._meta_path(path) + ".tmp"
         try:
-            tmp = path + ".tmp"
             with open(tmp, "wb") as fh:
                 fh.write(blob)
             os.replace(tmp, path)
-            mtmp = self._meta_path(path) + ".tmp"
             with open(mtmp, "w") as fh:
                 json.dump(doc, fh, indent=2)
             os.replace(mtmp, self._meta_path(path))
+        except BaseException:
+            # failure-path cleanup: a half-written temp must not stay on
+            # disk (one stranded file per failed export under a retry
+            # loop), and a published blob without its meta is dead weight
+            # the next load sha-evicts anyway
+            for stranded in (tmp, mtmp):
+                try:
+                    os.remove(stranded)
+                except OSError:
+                    pass
+            raise
         finally:
+            if _san.LEAK:
+                # our logical hold ends here even if the unlink below
+                # loses a race (a stale leftover is broken by mtime)
+                _san.note_release("aot_save_lock", path + ".lock")
             try:
                 os.remove(path + ".lock")
             except OSError:

@@ -28,16 +28,23 @@ def build(src: str, lib_path: str, extra_args: Sequence[str] = (),
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout)
+        if proc.returncode != 0:
+            logger.warning("native build failed (%s):\n%s",
+                           os.path.basename(src), proc.stderr)
+            return False
+        os.replace(tmp, lib_path)
+        return True
     except (OSError, subprocess.TimeoutExpired) as e:  # g++ missing/hung
         logger.warning("native build unavailable (%s): %s",
                        os.path.basename(src), e)
         return False
-    if proc.returncode != 0:
-        logger.warning("native build failed (%s):\n%s",
-                       os.path.basename(src), proc.stderr)
-        return False
-    os.replace(tmp, lib_path)
-    return True
+    finally:
+        # a failed/killed compile leaves its partial -o output behind;
+        # one stranded .tmp per rebuild attempt adds up in shared caches
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
 
 
 def load_once(src: str, lib_path: str, abi_version: int, abi_symbol: str,

@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..analysis import sanitizer as _san
 from . import flight
 
 # module-global fast path: instrumented call sites check this and only
@@ -158,6 +159,10 @@ class Span:
 
 def _record_finished(span: Span) -> None:
     global _finished_total
+    if _san.LEAK:
+        # both terminal paths (Span.end and record_span's post-hoc
+        # emission) funnel here: the span leaves the leak ledger
+        _san.note_release("span", span.span_id)
     with _count_lock:
         _finished_total = next(_finished_seq)
     _finished.append(span)
@@ -187,10 +192,14 @@ def start_span(name: str, kind: str = "span", parent=None,
     pctx = _coerce_parent(parent)
     tid = trace_id or (pctx.trace_id if pctx is not None
                        else _new_trace_id())
-    return Span(name, kind, tid, _new_span_id(),
+    span = Span(name, kind, tid, _new_span_id(),
                 pctx.span_id if pctx is not None else None,
                 time.monotonic(), attrs,
                 [(c.trace_id, c.span_id) for c in links if c is not None])
+    if _san.LEAK:
+        _san.note_acquire("span", span.span_id,
+                          detail=f"{kind}:{name}")
+    return span
 
 
 def record_span(name: str, kind: str = "span", parent=None,

@@ -60,6 +60,7 @@ import threading
 import weakref
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis import sanitizer as _san
 from ..analysis.sanitizer import named_lock
 from . import flight as obs_flight
 from . import metrics as obs_metrics
@@ -506,10 +507,16 @@ def track_pipeline(pipeline) -> None:
     ``Pipeline.stop`` untracks so a dead pipeline's rows disappear from
     the scrape immediately, not at GC time)."""
     _tracked_pipelines.add(pipeline)
+    if _san.LEAK:
+        _san.note_acquire("memory_registration",
+                          f"pipeline:{id(pipeline):x}", idempotent=True,
+                          detail=getattr(pipeline, "name", ""))
 
 
 def untrack_pipeline(pipeline) -> None:
     _tracked_pipelines.discard(pipeline)
+    if _san.LEAK:
+        _san.note_release("memory_registration", f"pipeline:{id(pipeline):x}")
 
 
 def track_serving(source) -> None:
@@ -590,7 +597,7 @@ class AdmissionGuard:
     def limit_bytes(self) -> int:
         return int(self.watermark * self.budget_bytes)
 
-    def reserve(self, nbytes: int) -> bool:
+    def reserve(self, nbytes: int) -> bool:   # pairs-with: release
         """Reserve ``nbytes × overhead``; False = would cross the
         watermark (caller sheds). Reservations above the limit in
         isolation are refused too — a single impossible request must
@@ -603,12 +610,17 @@ class AdmissionGuard:
             self._inflight += need
             if self._inflight > self._peak:
                 self._peak = self._inflight
-            return True
+        if _san.LEAK:
+            _san.note_acquire("guard_reservation", self.name,
+                              detail=f"{need} bytes")
+        return True
 
     def release(self, nbytes: int) -> None:
         need = int(nbytes * self.overhead)
         with self._lock:
             self._inflight = max(0, self._inflight - need)
+        if _san.LEAK:
+            _san.note_release("guard_reservation", self.name)
 
     @property
     def inflight_bytes(self) -> int:
@@ -671,12 +683,14 @@ def stop() -> None:
         sampler.stop()
 
 
-def begin_calibration() -> None:
+def begin_calibration() -> None:   # pairs-with: end_calibration
     """Placement-calibration window (refcounted, paired with
     :func:`end_calibration`) — the planner needs byte estimates captured
     in the same window that measures stage latency."""
     global _calibrating
     with _ctl_lock:
+        if _san.LEAK:
+            _san.note_acquire("calibration", "obs.memory")
         _calibrating += 1
         _update_active()
 
@@ -684,6 +698,8 @@ def begin_calibration() -> None:
 def end_calibration() -> None:
     global _calibrating
     with _ctl_lock:
+        if _san.LEAK:
+            _san.note_release("calibration", "obs.memory")
         _calibrating = max(0, _calibrating - 1)
         _update_active()
 

@@ -32,6 +32,8 @@ import threading
 import weakref
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..analysis import sanitizer as _san
+
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
@@ -304,6 +306,9 @@ def track_pool(pool) -> None:
 
 def track_manager(manager) -> None:
     _tracked_managers.add(manager)
+    if _san.LEAK:
+        _san.note_acquire("metrics_registration", f"manager:{id(manager):x}",
+                          idempotent=True)
 
 
 def track_pipeline(pipeline) -> None:
@@ -311,6 +316,10 @@ def track_pipeline(pipeline) -> None:
     segments, so one-dispatch chains report dispatch/retrace/defuse
     counters without any pipeline-side publishing code."""
     _tracked_pipelines.add(pipeline)
+    if _san.LEAK:
+        _san.note_acquire("metrics_registration",
+                          f"pipeline:{id(pipeline):x}", idempotent=True,
+                          detail=getattr(pipeline, "name", ""))
 
 
 def untrack_pipeline(pipeline) -> None:
@@ -320,10 +329,15 @@ def untrack_pipeline(pipeline) -> None:
     keep rendering at every scrape. A replay re-tracks via
     ``fusion.install``."""
     _tracked_pipelines.discard(pipeline)
+    if _san.LEAK:
+        _san.note_release("metrics_registration",
+                          f"pipeline:{id(pipeline):x}")
 
 
 def untrack_manager(manager) -> None:
     _tracked_managers.discard(manager)
+    if _san.LEAK:
+        _san.note_release("metrics_registration", f"manager:{id(manager):x}")
 
 
 def pools_snapshot() -> Dict[str, dict]:

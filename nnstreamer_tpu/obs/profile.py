@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis import sanitizer as _san
 from ..analysis.sanitizer import named_lock
 from . import metrics as obs_metrics
 
@@ -554,12 +555,15 @@ def start(elements: bool = True) -> Profiler:
     return default_profiler
 
 
-def enable_recording() -> None:
+def enable_recording() -> None:   # pairs-with: disable_recording
     """Queue/fused/request recording WITHOUT the per-hop element tracer —
     what the SLO engine needs. Independent of start()/stop(): a capture
     session ending does not switch a running engine's series off."""
     global _recording
     with _ctl_lock:
+        if _san.LEAK and not _recording:
+            # boolean half: ledger one unit per on→off transition
+            _san.note_acquire("recording", "obs.profile")
         _recording = True
         _update_active()
 
@@ -569,17 +573,21 @@ def disable_recording() -> None:
     this)."""
     global _recording
     with _ctl_lock:
+        if _san.LEAK and _recording:
+            _san.note_release("recording", "obs.profile")
         _recording = False
         _update_active()
 
 
-def begin_calibration() -> None:
+def begin_calibration() -> None:   # pairs-with: end_calibration
     """Placement-calibration recording (queue/fused hooks, no element
     tracer), REFCOUNTED: each ``begin`` must be paired with one ``end``,
     and concurrent calibrating pipelines keep recording alive until the
     last one finishes (runtime/placement.py)."""
     global _calibrating
     with _ctl_lock:
+        if _san.LEAK:
+            _san.note_acquire("calibration", "obs.profile")
         _calibrating += 1
         _update_active()
 
@@ -587,6 +595,8 @@ def begin_calibration() -> None:
 def end_calibration() -> None:
     global _calibrating
     with _ctl_lock:
+        if _san.LEAK:
+            _san.note_release("calibration", "obs.profile")
         _calibrating = max(0, _calibrating - 1)
         _update_active()
 

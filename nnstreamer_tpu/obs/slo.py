@@ -174,6 +174,7 @@ class SloEngine:
                                         name=f"slo:{self.name}",
                                         daemon=True)
         self._thread.start()
+        _engines.add(self)  # re-register after a stop()'s discard
         return self
 
     def stop(self) -> None:
@@ -187,6 +188,10 @@ class SloEngine:
         # unaffected either way)
         if not any(e._thread is not None for e in _engines if e is not self):
             obs_profile.disable_recording()
+        # leave the status/gauge scrape surface NOW, not when GC collects
+        # the weak ref (the PR-10 unregister-at-stop stance; start()
+        # re-adds on restart)
+        _engines.discard(self)
 
     def _loop(self) -> None:
         while not self._stop_evt.wait(self.tick_s):

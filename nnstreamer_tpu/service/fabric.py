@@ -658,6 +658,21 @@ class ReplicaPool:
                     (meta or {}).get("trace")),
                 attrs={"pool": self.name,
                        "key": None if key is None else str(key)})
+        try:
+            return self._request_traced(tensors, key, deadline, meta,
+                                        timeout, h, span)
+        finally:
+            # exception-safe span close (NNL3xx stance): the terminal
+            # paths below end the span with their own status first, so
+            # this end() is a no-op for them — it only catches an
+            # UNEXPECTED exception escaping mid-loop, which must not
+            # leak a live root span (its attempts end the same way)
+            if span is not None:
+                span.end("error:escaped")
+
+    def _request_traced(self, tensors, key, deadline: float,
+                        meta: Optional[dict], timeout: float, h,
+                        span) -> Buffer:
         t_req = time.monotonic()
         retriable = self.assume_idempotent or key is not None
         max_attempts = self.max_attempts if retriable else 1
@@ -691,27 +706,34 @@ class ReplicaPool:
                 attempt_span = obs_context.start_span(
                     f"attempt:{replica.id}", kind="fabric", parent=span,
                     attrs={"replica": replica.id, "attempt": attempts})
-            buf = self._make_buffer(
-                tensors, key, deadline, attempts, meta,
-                trace=None if attempt_span is None
-                else attempt_span.context())
-            if retriable:
-                resp, err = self._attempt_maybe_hedged(
-                    replica, h, tried, buf, tensors, key, deadline, meta,
-                    span=span, attempt_span=attempt_span)
-            else:
-                # hedging IS duplicate execution — a non-idempotent
-                # request must never fan out, same gate as retries
-                resp, err = self._attempt_and_score(replica, buf, deadline)
-            if attempt_span is not None:
-                # idempotent: a hedge win already ended the primary's
-                # span as superseded — this end() is then a no-op, so
-                # the success is never misattributed to a replica that
-                # did not answer
-                attempt_span.end(
-                    "ok" if resp is not None else
-                    f"error:{type(err).__name__}" if err is not None
-                    else "error")
+            try:
+                buf = self._make_buffer(
+                    tensors, key, deadline, attempts, meta,
+                    trace=None if attempt_span is None
+                    else attempt_span.context())
+                if retriable:
+                    resp, err = self._attempt_maybe_hedged(
+                        replica, h, tried, buf, tensors, key, deadline,
+                        meta, span=span, attempt_span=attempt_span)
+                else:
+                    # hedging IS duplicate execution — a non-idempotent
+                    # request must never fan out, same gate as retries
+                    resp, err = self._attempt_and_score(replica, buf,
+                                                        deadline)
+                if attempt_span is not None:
+                    # idempotent: a hedge win already ended the primary's
+                    # span as superseded — this end() is then a no-op, so
+                    # the success is never misattributed to a replica
+                    # that did not answer
+                    attempt_span.end(
+                        "ok" if resp is not None else
+                        f"error:{type(err).__name__}" if err is not None
+                        else "error")
+            finally:
+                # exception-safe close: the normal path above already
+                # ended with its real status (end() is idempotent)
+                if attempt_span is not None:
+                    attempt_span.end("error:escaped")
             if resp is not None:
                 dt = time.monotonic() - t_req
                 self._latency_hist.observe(dt, pool=self.name)

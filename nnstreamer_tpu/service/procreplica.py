@@ -60,6 +60,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis import sanitizer as _san
 from ..analysis.sanitizer import named_lock
 from ..obs import flight as obs_flight
 from ..utils.log import logger
@@ -141,6 +142,9 @@ class ProcReplica:
         # is what an operator tailing one journal wants); stdout is OURS:
         # the READY sentinel rides it
         self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        if _san.LEAK:
+            _san.note_acquire("proc_replica",
+                              f"{self.name}:{self.proc.pid}")
         t = threading.Thread(target=self._read_stdout,
                              name=f"procreplica:{self.name}:stdout",
                              daemon=True)
@@ -256,6 +260,10 @@ class ProcReplica:
                 proc.kill()
                 proc.wait(timeout=5.0)
         self._threads.drain(timeout_per=2.0)
+        if _san.LEAK:
+            # every forget path (set stop, discard, failed admit, the
+            # respawn replacing a dead child) funnels through terminate
+            _san.note_release("proc_replica", f"{self.name}:{proc.pid}")
         return proc.returncode
 
 
@@ -471,10 +479,19 @@ class ProcReplicaSet:
         with self._lock:
             slot = self._slots.get(rid)
             if slot is None:           # removed (scale-in) mid-respawn
-                proc.terminate(timeout=2.0)
-                return False
-            slot.proc = proc
-            slot.dead = False
+                replaced = None
+            else:
+                replaced, slot.proc = slot.proc, proc
+                slot.dead = False
+        if slot is None:
+            proc.terminate(timeout=2.0)
+            return False
+        # reap the dead child we just replaced OUTSIDE the lock: its
+        # stdout-reader thread was never joined and the Popen handle
+        # never waited — a leak per respawn cycle under crash-loop chaos
+        # (terminate on an already-dead process only drains/reaps)
+        if replaced is not None:
+            replaced.terminate(timeout=2.0)
         obs_flight.record("fabric", "replica_respawned",
                           {"pool": self.name, "replica": rid,
                            "pid": proc.proc.pid,
@@ -652,6 +669,7 @@ def run_replica(args) -> int:
     from .fabric import _fabric_qid
     from .supervisor import RestartPolicy
 
+    recording_on = False
     if getattr(args, "obs", True):
         # keep the request-digest recording half on (the cheap,
         # request-rate half — no per-hop element tracer), so the
@@ -661,6 +679,7 @@ def run_replica(args) -> int:
         from ..obs import profile as obs_profile
 
         obs_profile.enable_recording()
+        recording_on = True
     if getattr(args, "trace", False):
         # span tracing for cross-process stitching: trace ids arriving
         # on the query wire mint serving/fused spans HERE, exported at
@@ -763,6 +782,15 @@ def run_replica(args) -> int:
         if server is not None:
             server.stop()
         mgr.shutdown()
+        # nnlint: disable=NNL303 — the release condition IS the acquire
+        # condition: `recording_on` is set iff enable_recording() ran
+        # above (flag-correlated branches the path analysis cannot join)
+        if recording_on:
+            # balanced shutdown on the clean-drain exit (a SIGKILL'd
+            # replica's release is the process exit itself)
+            from ..obs import profile as obs_profile
+
+            obs_profile.disable_recording()
 
 
 def add_replica_args(parser) -> None:

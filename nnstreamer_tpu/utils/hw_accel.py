@@ -153,13 +153,18 @@ def default_platform(
     except OSError:
         result = ""
     if cache_path:
+        tmp = f"{cache_path}.tmp.{os.getpid()}"
         try:
-            tmp = f"{cache_path}.tmp.{os.getpid()}"
             with open(tmp, "w") as fh:
                 json.dump({"platform": result, "ts": time.time()}, fh)
             os.replace(tmp, cache_path)
         except (OSError, TypeError):
-            pass
+            # best-effort cache, but never strand the half-written temp
+            # (one per pid per failed probe in a shared cache dir)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
     return result
 
 

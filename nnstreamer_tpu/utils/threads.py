@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from ..analysis import sanitizer as _san
+
 
 class ThreadRegistry:
     """Tracks STARTED worker threads so a stop() path can join them.
@@ -43,19 +45,26 @@ class ThreadRegistry:
         except OSError:
             pass
 
-    def track(self, t: threading.Thread,
+    def track(self, t: threading.Thread,   # pairs-with: drain
               closer: Optional[Callable[[], None]] = None) -> None:
-        dead: List[Optional[Callable[[], None]]] = []
+        dead: List[Tuple[threading.Thread,
+                         Optional[Callable[[], None]]]] = []
         with self._lock:
             live = []
             for entry in self._entries:
                 if entry[0].is_alive():
                     live.append(entry)
                 else:
-                    dead.append(entry[1])
+                    dead.append(entry)
             live.append((t, closer))
             self._entries = live
-        for closer_fn in dead:
+        if _san.LEAK:
+            _san.note_acquire("tracked_thread",
+                              f"{id(self):x}:{id(t):x}", detail=t.name)
+            for dt, _c in dead:
+                _san.note_release("tracked_thread",
+                                  f"{id(self):x}:{id(dt):x}")
+        for _t, closer_fn in dead:
             self._close(closer_fn)
 
     def drain(self, timeout_per: float = 1.0) -> List[threading.Thread]:
@@ -67,6 +76,12 @@ class ThreadRegistry:
         never checks ``is_alive()`` hides a stuck worker forever)."""
         with self._lock:
             entries, self._entries = self._entries, []
+        if _san.LEAK:
+            # the entries left the registry: whatever survives the joins
+            # below is the CALLER's straggler report, not a ledger leak
+            for t, _closer in entries:
+                _san.note_release("tracked_thread",
+                                  f"{id(self):x}:{id(t):x}")
         for _t, closer in entries:
             self._close(closer)
         me = threading.current_thread()

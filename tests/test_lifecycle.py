@@ -1,0 +1,932 @@
+"""Lifecycle lint (pass 4, NNL3xx) + NNS_LEAKCHECK sanitizer tests.
+
+Every rule gets a good+bad fixture pair (the bad fixture MUST fire, the
+good one MUST stay clean), plus call-expansion and pragma credit, the
+``# pairs-with:`` annotation convention, the skip-file escape for
+generated scaffolds, CLI surfaces (catalog filter, ``fix_hint`` JSON
+field), leak-ledger units, and an NNS_LEAKCHECK stress run exercising
+hot swap + canary promote + autoscale scale-in + replica SIGKILL
+concurrently with a zero-outstanding verdict.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from nnstreamer_tpu.analysis import sanitizer
+from nnstreamer_tpu.analysis.lifecycle_lint import lint_lifecycle
+
+pytestmark = pytest.mark.lint
+
+
+def _lint_text(tmp_path, text, name="mod.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(text))
+    return lint_lifecycle([f])
+
+
+def _rules(diags):
+    return [d.rule for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# NNL301 — acquire without release
+# ---------------------------------------------------------------------------
+
+class TestNNL301:
+    def test_bad_calibration_never_released(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def calibrate():
+                obs_profile.begin_calibration()
+                return 1
+        """)
+        assert "NNL301" in _rules(diags)
+        assert "end_calibration" in diags[0].message
+        assert diags[0].fix_hint  # names the missing release call
+
+    def test_good_cross_method_release(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            class Window:
+                def open(self):
+                    obs_profile.begin_calibration()
+
+                def close(self):
+                    obs_profile.end_calibration()
+        """)
+        assert diags == []
+
+    def test_bad_span_never_ended(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            def handle(ctx):
+                span = ctx.start_span("req")
+                do_work()
+        """)
+        assert "NNL301" in _rules(diags)
+
+    def test_good_span_ended(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            def handle(ctx):
+                span = ctx.start_span("req")
+                try:
+                    do_work()
+                finally:
+                    span.end("ok")
+        """)
+        assert diags == []
+
+    def test_good_span_escapes_via_return(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            def mint(ctx):
+                span = ctx.start_span("req")
+                return span
+        """)
+        assert diags == []
+
+    def test_good_span_escapes_via_handoff(self, tmp_path):
+        # stored into another object / passed onward: the new owner's
+        # contract, not this function's
+        diags = _lint_text(tmp_path, """
+            def submit(ctx, req):
+                req._span = ctx.start_span("req")
+
+            def register(ctx, table):
+                s = ctx.start_span("req")
+                table.put(s)
+        """)
+        assert diags == []
+
+    def test_bad_span_stored_on_self_never_ended(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            class Holder:
+                def start(self, ctx):
+                    self._span = ctx.start_span("req")
+        """)
+        assert "NNL301" in _rules(diags)
+
+    def test_good_span_stored_on_self_ended_elsewhere(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            class Holder:
+                def start(self, ctx):
+                    self._span = ctx.start_span("req")
+
+                def stop(self):
+                    self._span.end("ok")
+        """)
+        assert diags == []
+
+    def test_good_guard_reservation_cross_method(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            class Sched:
+                def admit(self, nb):
+                    guard = self.memory_guard
+                    guard.reserve(nb)
+
+                def done(self, nb):
+                    self.memory_guard.release(nb)
+        """)
+        assert diags == []
+
+    def test_bad_guard_reservation_never_released(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            class Sched:
+                def admit(self, nb):
+                    self.memory_guard.reserve(nb)
+        """)
+        assert "NNL301" in _rules(diags)
+
+
+# ---------------------------------------------------------------------------
+# NNL302 — exception path escapes holding a resource
+# ---------------------------------------------------------------------------
+
+class TestNNL302:
+    def test_bad_release_on_normal_path_only(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def calibrate(pipe):
+                obs_profile.begin_calibration()
+                capture(pipe)
+                obs_profile.end_calibration()
+        """)
+        assert "NNL302" in _rules(diags)
+        assert "finally" in diags[0].fix_hint
+
+    def test_good_release_in_finally(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def calibrate(pipe):
+                obs_profile.begin_calibration()
+                try:
+                    capture(pipe)
+                finally:
+                    obs_profile.end_calibration()
+        """)
+        assert diags == []
+
+    def test_good_release_and_reraise_handler(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def calibrate(pipe):
+                obs_profile.begin_calibration()
+                try:
+                    capture(pipe)
+                except Exception:
+                    obs_profile.end_calibration()
+                    raise
+                obs_profile.end_calibration()
+        """)
+        assert diags == []
+
+    def test_good_no_risky_statement_between(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def toggle():
+                obs_profile.begin_calibration()
+                obs_profile.end_calibration()
+        """)
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# NNL303 — refcount imbalance
+# ---------------------------------------------------------------------------
+
+class TestNNL303:
+    def test_bad_one_branch_releases(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def finish(ok):
+                obs_profile.begin_calibration()
+                if ok:
+                    obs_profile.end_calibration()
+                else:
+                    log_failure()
+        """)
+        assert "NNL303" in _rules(diags)
+
+    def test_good_both_branches_release(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def finish(ok):
+                obs_profile.begin_calibration()
+                if ok:
+                    obs_profile.end_calibration()
+                else:
+                    obs_profile.end_calibration()
+        """)
+        assert diags == []
+
+    def test_conditional_acquire_is_not_flagged(self, tmp_path):
+        # `if enabled: begin()` is the normal conditional-activation
+        # idiom — only release asymmetry fires
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            class Eng:
+                def start(self, enabled):
+                    if enabled:
+                        obs_profile.begin_calibration()
+
+                def stop(self):
+                    obs_profile.end_calibration()
+        """)
+        assert diags == []
+
+    def test_bad_early_return_skips_release(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def run(pipe):
+                obs_profile.begin_calibration()
+                if not pipe.segments:
+                    return None
+                plan(pipe)
+                obs_profile.end_calibration()
+                return pipe
+        """)
+        assert "NNL303" in _rules(diags)
+
+    def test_bad_net_acquire_in_loop(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def run(pipes):
+                for p in pipes:
+                    obs_profile.begin_calibration()
+                obs_profile.end_calibration()
+        """)
+        assert "NNL303" in _rules(diags)
+
+
+# ---------------------------------------------------------------------------
+# NNL304 — Popen without reap path
+# ---------------------------------------------------------------------------
+
+class TestNNL304:
+    def test_bad_stored_popen_never_reaped(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            import subprocess
+
+            class Runner:
+                def spawn(self):
+                    self.proc = subprocess.Popen(["sleep", "1"])
+        """)
+        assert "NNL304" in _rules(diags)
+
+    def test_good_stored_popen_with_terminate(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            import subprocess
+
+            class Runner:
+                def spawn(self):
+                    self.proc = subprocess.Popen(["sleep", "1"])
+
+                def stop(self):
+                    self.proc.terminate()
+                    self.proc.wait()
+        """)
+        assert diags == []
+
+    def test_good_reap_via_local_alias(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            import subprocess
+
+            class Runner:
+                def spawn(self):
+                    self.proc = subprocess.Popen(["sleep", "1"])
+
+                def stop(self):
+                    proc = self.proc
+                    proc.kill()
+        """)
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# NNL305 — atomic write without failure cleanup
+# ---------------------------------------------------------------------------
+
+class TestNNL305:
+    def test_bad_no_cleanup(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            import json
+            import os
+
+            def save(path, doc):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump(doc, fh)
+                os.replace(tmp, path)
+        """)
+        assert "NNL305" in _rules(diags)
+
+    def test_good_cleanup_on_failure(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            import json
+            import os
+
+            def save(path, doc):
+                tmp = path + ".tmp"
+                try:
+                    with open(tmp, "w") as fh:
+                        json.dump(doc, fh)
+                    os.replace(tmp, path)
+                except OSError:
+                    os.remove(tmp)
+                    raise
+        """)
+        assert diags == []
+
+    def test_good_block_level_cleanup(self, tmp_path):
+        # cleanup through a loop variable still counts (block-level)
+        diags = _lint_text(tmp_path, """
+            import json
+            import os
+
+            def save(path, doc):
+                tmp = path + ".tmp"
+                mtmp = path + ".meta.tmp"
+                try:
+                    with open(tmp, "w") as fh:
+                        json.dump(doc, fh)
+                    os.replace(tmp, path)
+                    with open(mtmp, "w") as fh:
+                        json.dump(doc, fh)
+                    os.replace(mtmp, path + ".meta")
+                except OSError:
+                    for stranded in (tmp, mtmp):
+                        os.remove(stranded)
+                    raise
+        """)
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# NNL306 — registration without unregister on stop
+# ---------------------------------------------------------------------------
+
+class TestNNL306:
+    def test_bad_weakset_add_without_discard(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            import weakref
+
+            _engines = weakref.WeakSet()
+
+            class Engine:
+                def __init__(self):
+                    _engines.add(self)
+        """)
+        assert "NNL306" in _rules(diags)
+
+    def test_good_weakset_discard_on_stop(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            import weakref
+
+            _engines = weakref.WeakSet()
+
+            class Engine:
+                def __init__(self):
+                    _engines.add(self)
+
+                def stop(self):
+                    _engines.discard(self)
+        """)
+        assert diags == []
+
+    def test_annotated_weakset_detected(self, tmp_path):
+        # AnnAssign declaration form (`X: "weakref.WeakSet" = ...`)
+        diags = _lint_text(tmp_path, """
+            import weakref
+
+            _views: "weakref.WeakSet" = weakref.WeakSet()
+
+            class View:
+                def start(self):
+                    _views.add(self)
+        """)
+        assert "NNL306" in _rules(diags)
+
+    def test_bad_thread_registry_never_drained(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            import threading
+
+            from utils.threads import ThreadRegistry
+
+            class Server:
+                def __init__(self):
+                    self._threads = ThreadRegistry()
+
+                def serve(self):
+                    t = threading.Thread(target=self._run)
+                    t.start()
+                    self._threads.track(t)
+        """)
+        assert "NNL306" in _rules(diags)
+
+    def test_good_thread_registry_drained(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            import threading
+
+            from utils.threads import ThreadRegistry
+
+            class Server:
+                def __init__(self):
+                    self._threads = ThreadRegistry()
+
+                def serve(self):
+                    t = threading.Thread(target=self._run)
+                    t.start()
+                    self._threads.track(t)
+
+                def stop(self):
+                    self._threads.drain()
+        """)
+        assert diags == []
+
+    def test_bad_track_self_without_untrack(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import metrics as obs_metrics
+
+            class Manager:
+                def __init__(self):
+                    obs_metrics.track_manager(self)
+        """)
+        assert "NNL306" in _rules(diags)
+
+    def test_good_track_foreign_object_exempt(self, tmp_path):
+        # registering a FOREIGN object: its owner's stop path carries
+        # the unregister contract (fusion.install registers pipelines,
+        # Pipeline.stop untracks)
+        diags = _lint_text(tmp_path, """
+            from obs import metrics as obs_metrics
+
+            class Installer:
+                def install(self, pipeline):
+                    obs_metrics.track_pipeline(pipeline)
+        """)
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# machinery: pairs-with, call expansion, pragmas, skip-file
+# ---------------------------------------------------------------------------
+
+class TestMachinery:
+    def test_pairs_with_annotation_registers_pair(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            def begin_window():   # pairs-with: end_window
+                _state.open += 1
+
+            def end_window():
+                _state.open -= 1
+
+            def user():
+                begin_window()
+                return compute()
+        """)
+        assert "NNL301" in _rules(diags)
+        assert "end_window" in diags[0].message
+
+    def test_pairs_with_balanced_is_clean(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            def begin_window():   # pairs-with: end_window
+                _state.open += 1
+
+            def end_window():
+                _state.open -= 1
+
+            def user():
+                begin_window()
+                try:
+                    return compute()
+                finally:
+                    end_window()
+        """)
+        assert diags == []
+
+    def test_call_expansion_credits_helper_release(self, tmp_path):
+        # one-level expansion: a helper that releases credits its caller
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            class Window:
+                def run(self, pipe):
+                    obs_profile.begin_calibration()
+                    try:
+                        capture(pipe)
+                    finally:
+                        self._close()
+
+                def _close(self):
+                    obs_profile.end_calibration()
+        """)
+        assert diags == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            from obs import profile as obs_profile
+
+            def hold_forever():
+                # nnlint: disable=NNL301 — held for process lifetime
+                obs_profile.begin_calibration()
+        """)
+        assert diags == []
+
+    def test_skip_file_excludes(self, tmp_path):
+        diags = _lint_text(tmp_path, """
+            # nnlint: skip-file — generated scaffold
+            from obs import profile as obs_profile
+
+            def leak():
+                obs_profile.begin_calibration()
+        """)
+        assert diags == []
+
+    def test_generated_skeletons_lint_clean(self, tmp_path):
+        # the codegen satellite: every generated scaffold carries the
+        # skip-file marker, so `lint <generated>.py --strict` is clean
+        from nnstreamer_tpu.analysis.cli import main as lint_main
+
+        for kind in ("filter", "decoder", "converter"):
+            out = tmp_path / f"gen_{kind}.py"
+            rc = subprocess.run(
+                [sys.executable, "-m", "nnstreamer_tpu", "codegen", kind,
+                 str(out)], capture_output=True, text=True)
+            assert rc.returncode == 0, rc.stderr
+            assert "nnlint: skip-file" in out.read_text()
+            assert lint_main([str(out), "--strict"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_rules_filter_family(self, tmp_path):
+        from nnstreamer_tpu.analysis.cli import main as lint_main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            from obs import profile as obs_profile
+
+            def leak():
+                obs_profile.begin_calibration()
+        """))
+        # NNL3xx family selects the finding; NNL0xx filters it out
+        assert lint_main([str(bad), "--strict", "--rules", "NNL3xx"]) == 1
+        assert lint_main([str(bad), "--strict", "--rules", "NNL0xx"]) == 0
+
+    def test_catalog_listing_with_family_filter(self, capsys):
+        from nnstreamer_tpu.analysis.cli import main as lint_main
+
+        assert lint_main(["--rules", "list,NNL3xx"]) == 0
+        out = capsys.readouterr().out
+        assert "NNL301" in out and "NNL306" in out
+        assert "NNL101" not in out and "NNL201" not in out
+        # bare listing still prints everything
+        assert lint_main(["--rules"]) == 0
+        out = capsys.readouterr().out
+        assert "NNL101" in out and "NNL301" in out
+
+    def test_json_findings_carry_fix_hint(self, tmp_path, capsys):
+        from nnstreamer_tpu.analysis.cli import main as lint_main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            from obs import profile as obs_profile
+
+            def leak():
+                obs_profile.begin_calibration()
+
+            def swallow():
+                try:
+                    work()
+                except:
+                    pass
+        """))
+        lint_main([str(bad), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        by_rule = {d["rule"]: d for d in doc}
+        # lifecycle finding names the missing release call
+        assert "end_calibration" in by_rule["NNL301"]["fix_hint"]
+        # other passes populate the field too (fallback to hint)
+        assert by_rule["NNL103"]["fix_hint"]
+
+    def test_self_lint_gate_with_nnl3xx_armed(self):
+        """THE acceptance gate: strict self-lint over our own tree stays
+        zero-findings with the lifecycle family armed."""
+        from nnstreamer_tpu.analysis.cli import main as lint_main
+
+        pkg = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))) + "/nnstreamer_tpu"
+        assert lint_main([pkg, "--strict", "--rules", "NNL3xx"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# leak-ledger units
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def leakcheck():
+    was = sanitizer.leakcheck_enabled()
+    sanitizer.enable_leakcheck()
+    yield sanitizer
+    if was:
+        # session-level NNS_LEAKCHECK run: re-arm with a clean ledger so
+        # the autouse fixture's baseline stays truthful
+        sanitizer.enable_leakcheck()
+    else:
+        sanitizer.disable_leakcheck()
+        sanitizer.reset_leakcheck()
+
+
+class TestLeakLedger:
+    def test_acquire_release_balance(self, leakcheck):
+        sanitizer.note_acquire("demo", "k1")
+        sanitizer.note_acquire("demo", "k1")
+        assert sanitizer.outstanding("demo")[0]["count"] == 2
+        sanitizer.note_release("demo", "k1")
+        assert sanitizer.outstanding("demo")[0]["count"] == 1
+        sanitizer.note_release("demo", "k1")
+        assert sanitizer.outstanding("demo") == []
+
+    def test_release_without_acquire_ignored(self, leakcheck):
+        sanitizer.note_release("demo", "never-acquired")
+        assert sanitizer.outstanding() == []
+
+    def test_idempotent_registration(self, leakcheck):
+        sanitizer.note_acquire("reg", "obj", idempotent=True)
+        sanitizer.note_acquire("reg", "obj", idempotent=True)
+        assert sanitizer.outstanding("reg")[0]["count"] == 1
+        sanitizer.note_release("reg", "obj")
+        assert sanitizer.outstanding("reg") == []
+
+    def test_report_shape_and_site(self, leakcheck):
+        sanitizer.note_acquire("demo", "k2", detail="why")
+        rep = sanitizer.leak_report()
+        assert rep["enabled"] and rep["outstanding_units"] == 1
+        row = rep["outstanding"][0]
+        assert row["detail"] == "why" and row["thread"]
+        assert "test_lifecycle" in row["site"]
+        sanitizer.note_release("demo", "k2")
+
+    def test_refcount_key_keeps_all_acquirer_sites(self, leakcheck):
+        # two callers share one refcounted key; the first releases —
+        # the report must still show BOTH acquirers (the leaker can be
+        # either one, not just the first)
+        def caller_a():
+            sanitizer.note_acquire("demo", "shared")
+
+        def caller_b():
+            sanitizer.note_acquire("demo", "shared")
+
+        caller_a()
+        caller_b()
+        sanitizer.note_release("demo", "shared")
+        row = sanitizer.outstanding("demo")[0]
+        assert row["count"] == 1
+        assert len(row["sites"]) == 2  # both distinct call sites recorded
+        sanitizer.note_release("demo", "shared")
+
+    def test_disabled_is_noop(self):
+        if sanitizer.leakcheck_enabled():
+            pytest.skip("session runs with NNS_LEAKCHECK=1")
+        sanitizer.note_acquire("demo", "k3")
+        assert sanitizer.outstanding() == []
+
+    def test_span_pair_reports(self, leakcheck):
+        from nnstreamer_tpu.obs import context as obs_ctx
+
+        span = obs_ctx.start_span("leaktest")
+        assert any(r["kind"] == "span" for r in sanitizer.outstanding())
+        span.end()
+        assert not any(r["key"] == span.span_id
+                       for r in sanitizer.outstanding("span"))
+
+    def test_calibration_pair_reports(self, leakcheck):
+        from nnstreamer_tpu.obs import profile as obs_profile
+
+        obs_profile.begin_calibration()
+        assert sanitizer.outstanding("calibration")
+        obs_profile.end_calibration()
+        assert not sanitizer.outstanding("calibration")
+
+    def test_guard_reservation_pair_reports(self, leakcheck):
+        from nnstreamer_tpu.obs.memory import AdmissionGuard
+
+        guard = AdmissionGuard(1 << 20, name="leaktest-guard")
+        assert guard.reserve(1024)
+        assert sanitizer.outstanding("guard_reservation")
+        guard.release(1024)
+        assert not sanitizer.outstanding("guard_reservation")
+
+    def test_thread_registry_pair_reports(self, leakcheck):
+        from nnstreamer_tpu.utils.threads import ThreadRegistry
+
+        reg = ThreadRegistry()
+        t = threading.Thread(target=lambda: time.sleep(0.05))
+        t.start()
+        reg.track(t)
+        assert sanitizer.outstanding("tracked_thread")
+        reg.drain()
+        assert not sanitizer.outstanding("tracked_thread")
+
+
+# ---------------------------------------------------------------------------
+# NNS_LEAKCHECK stress: swap + canary-promote + scale-in + SIGKILL
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout_s(300)
+@pytest.mark.thread_leak_ok
+def test_leakcheck_stress_concurrent_lifecycles(tmp_path):
+    """The PR's acceptance stress: a supervised service under hot swap
+    and canary promote, a serving scheduler with a memory guard under
+    typed-shed traffic, a placement calibration window opening and
+    closing, and tracing spans — all concurrently. Verdict: the ledger
+    returns to its entry baseline (zero NEW outstanding units)."""
+    from nnstreamer_tpu.obs import context as obs_ctx
+    from nnstreamer_tpu.obs import profile as obs_profile
+    from nnstreamer_tpu.obs import memory as obs_memory
+    from nnstreamer_tpu.obs.memory import AdmissionGuard
+    from nnstreamer_tpu.serving import Scheduler
+    from nnstreamer_tpu.serving.request import AdmissionError
+    from nnstreamer_tpu.service import ServiceManager
+
+    was_enabled = sanitizer.leakcheck_enabled()
+    if not was_enabled:
+        sanitizer.enable_leakcheck()
+
+    def baseline():
+        return {(r["kind"], r["key"]): r["count"]
+                for r in sanitizer.outstanding()}
+
+    before = baseline()
+    errors = []
+    try:
+        mgr = ServiceManager()
+        mgr.models.define(
+            "leakslot",
+            {"v1": "builtin://passthrough",
+             "v2": "builtin://scaler?factor=2"}, "v1")
+        svc = mgr.register(
+            "leakstress",
+            "tensor_src num-buffers=-1 framerate=200 dimensions=4 "
+            "types=float32 ! tensor_filter framework=jax "
+            "model=registry://leakslot ! tensor_sink max-stored=2")
+        guard = AdmissionGuard(1 << 16, overhead=1.0, name="leakstress")
+        sched = Scheduler(lambda *t: t, bucket_sizes=(4,),
+                          max_wait_s=0.005, name="leakstress",
+                          memory_guard=guard)
+        stop = threading.Event()
+
+        def swapper():
+            try:
+                flip = ["v2", "v1"]
+                for i in range(4):
+                    if stop.is_set():
+                        break
+                    mgr.models.swap("leakslot", flip[i % 2])
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"swap: {e}")
+
+        def canary():
+            try:
+                mgr.models.canary("leakslot", "v2", 0.5)
+                time.sleep(0.1)
+                try:
+                    mgr.models.cancel_canary("leakslot")
+                except Exception:  # noqa: BLE001 - a concurrent swap
+                    pass           # already ended the experiment
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"canary: {e}")
+
+        def traffic():
+            try:
+                import numpy as np
+
+                for _ in range(60):
+                    if stop.is_set():
+                        break
+                    span = obs_ctx.start_span("stress.req")
+                    try:
+                        req = sched.submit(
+                            (np.zeros((1, 4), np.float32),),
+                            deadline_s=1.0)
+                        req.result(timeout=2.0)
+                    except AdmissionError:
+                        pass  # typed shed: its exit path must release
+                    finally:
+                        span.end("ok")
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"traffic: {e}")
+
+        def calibration_churn():
+            try:
+                for _ in range(20):
+                    if stop.is_set():
+                        break
+                    obs_profile.begin_calibration()
+                    obs_memory.begin_calibration()
+                    time.sleep(0.005)
+                    obs_memory.end_calibration()
+                    obs_profile.end_calibration()
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"calibration: {e}")
+
+        svc.start(wait=True)
+        threads = [threading.Thread(target=fn, name=f"leakstress:{fn.__name__}")
+                   for fn in (swapper, canary, traffic, calibration_churn)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        stop.set()
+        sched.close()
+        mgr.shutdown()
+        assert errors == []
+
+        # grace for teardown-time releases, then the verdict
+        deadline = time.monotonic() + 3.0
+        fresh = [
+            {"kind": k, "key": key, "count": c}
+            for (k, key), c in baseline().items()
+            if c > before.get((k, key), 0)]
+        while fresh and time.monotonic() < deadline:
+            time.sleep(0.05)
+            fresh = [
+                {"kind": k, "key": key, "count": c}
+                for (k, key), c in baseline().items()
+                if c > before.get((k, key), 0)]
+        assert fresh == [], (
+            f"stress left paired resources outstanding: {fresh}")
+    finally:
+        if not was_enabled:
+            sanitizer.disable_leakcheck()
+            sanitizer.reset_leakcheck()
+
+
+@pytest.mark.timeout_s(600)
+@pytest.mark.thread_leak_ok
+@pytest.mark.slow
+def test_leakcheck_stress_proc_replica_sigkill():
+    """Subprocess half of the stress: a 2-replica ProcReplicaSet under
+    traffic takes a SIGKILL + respawn + scale-in; every ProcReplica and
+    tracked stdout-reader thread returns to the ledger baseline."""
+    import numpy as np
+
+    from nnstreamer_tpu.service.procreplica import ProcReplicaSet
+
+    was_enabled = sanitizer.leakcheck_enabled()
+    if not was_enabled:
+        sanitizer.enable_leakcheck()
+
+    def baseline():
+        return {(r["kind"], r["key"]): r["count"]
+                for r in sanitizer.outstanding()
+                if r["kind"] in ("proc_replica", "tracked_thread")}
+
+    before = baseline()
+    pset = None
+    try:
+        pset = ProcReplicaSet(
+            "leakproc", "tensor_transform mode=arithmetic "
+            "option=add:0.0", "other/tensors,num_tensors=1,"
+            "dimensions=(4),types=float32,format=static",
+            replicas=2, warmup=False, spawn_timeout_s=120.0)
+        pset.start()
+        for _ in range(4):
+            pset.request((np.zeros(4, np.float32),), timeout=10.0)
+        # chaos: SIGKILL one replica, respawn under the same identity
+        rid = pset.kill_replica(0)
+        deadline = time.monotonic() + 10.0
+        while not pset.reap_dead() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pset.respawn(rid)
+        for _ in range(2):
+            pset.request((np.zeros(4, np.float32),), timeout=10.0)
+        pset.scale_in()
+    finally:
+        if pset is not None:
+            pset.stop()
+        fresh = {k: c for k, c in baseline().items()
+                 if c > before.get(k, 0)}
+        if not was_enabled:
+            sanitizer.disable_leakcheck()
+            sanitizer.reset_leakcheck()
+    assert fresh == {}, (
+        f"proc stress left replica resources outstanding: {fresh}")

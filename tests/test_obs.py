@@ -38,7 +38,9 @@ def _clean_obs():
 
 class TestTraceCore:
     def test_meta_roundtrip_and_garbage(self):
-        ctx = obs_ctx.start_span("root").context()
+        span = obs_ctx.start_span("root")
+        ctx = span.context()
+        span.end()  # NNS_LEAKCHECK: a started span must be closed
         back = obs_ctx.TraceContext.from_meta(ctx.to_meta())
         assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
         # meta is client-supplied wire data: garbage parses to None
@@ -76,6 +78,7 @@ class TestTraceCore:
         root = obs_ctx.start_span("root")
         child = obs_ctx.record_span("fused", parent=root.context().to_meta(),
                                     dur_s=0.001)
+        root.end()  # NNS_LEAKCHECK: a started span must be closed
         assert child.trace_id == root.trace_id
 
     def test_export_chrome_trace(self, tmp_path):

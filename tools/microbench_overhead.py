@@ -35,6 +35,10 @@ per trace generation + static-estimate records) is reported alongside.
 The data-plane quality taps (obs/quality.py) get the same leg on the
 fused device chain: taps off = one module-global check, gated <= 2%;
 taps on (sampled device-side health reductions) reported alongside.
+The NNS_LEAKCHECK paired-resource ledger (analysis/sanitizer.py) gets
+the same leg on the host chain: disabled = one module-global check per
+note_* call site (and NOTHING on the per-buffer path, by construction),
+gated <= 2%; enabled-mode ledger cost reported alongside.
 
 Usage:
   python tools/microbench_overhead.py [n_frames]      # full report
@@ -288,6 +292,57 @@ def quality_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     }
 
 
+def leakcheck_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
+    """NNS_LEAKCHECK ledger cost on an 8-element HOST chain — same
+    three-state protocol and min-of-pairs gate as the tracing/profiler
+    legs:
+
+    * ``baseline`` — leakcheck never enabled in this leg's pair;
+    * ``enabled``  — ``sanitizer.enable_leakcheck()`` (every
+      note_acquire/note_release lands in the ledger) — REPORTED,
+      not gated;
+    * ``disabled`` — after ``disable_leakcheck()``: back to the
+      one-module-global check, gated at <= 2% vs its paired baseline.
+
+    The pad-hop path carries NO leakcheck hooks by construction (the
+    ledger instruments control-plane pairs — calibration, spans,
+    reservations — never per-buffer code), so this leg asserts exactly
+    that: enabling the ledger must not perturb the steady-state buffer
+    path, and the disabled fast path costs nothing where it matters
+    most. Per-pair note_* cost is control-plane-rate and not measured
+    here.
+    """
+    import statistics
+
+    from nnstreamer_tpu.analysis import sanitizer as nns_sanitizer
+
+    measure(8, max(200, n_bufs // 4))  # warmup
+    baselines, disableds, enabled = [], [], None
+    for _ in range(attempts):
+        baselines.append(measure(8, n_bufs))
+        nns_sanitizer.enable_leakcheck()
+        try:
+            if enabled is None:
+                enabled = measure(8, n_bufs)
+        finally:
+            nns_sanitizer.disable_leakcheck()
+            nns_sanitizer.reset_leakcheck()
+        disableds.append(measure(8, n_bufs))
+    ratios = [d / b for b, d in zip(baselines, disableds)]
+    baseline = min(baselines)
+    return {
+        "n_frames": n_bufs,
+        "attempts": attempts,
+        "baseline_us_per_frame": baseline * 1e6,
+        "enabled_us_per_frame": enabled * 1e6,
+        "disabled_us_per_frame": min(disableds) * 1e6,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "disabled_overhead_frac": min(ratios) - 1.0,
+        "disabled_overhead_frac_median": statistics.median(ratios) - 1.0,
+        "enabled_overhead_frac": enabled / baseline - 1.0,
+    }
+
+
 def placement_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     """Placement cost on an 8-element fused DEVICE chain: per-buffer
     steady state with a plan applied vs ``place`` off, same min-of-pairs
@@ -358,11 +413,13 @@ def main() -> None:
         placement = placement_overhead_report(n_bufs=1500, attempts=4)
         memory = memory_overhead_report(n_bufs=1500, attempts=4)
         quality = quality_overhead_report(n_bufs=1500, attempts=4)
+        leakcheck = leakcheck_overhead_report(n_bufs=2000, attempts=4)
         best["tracing_overhead"] = tracing
         best["profiler_overhead"] = profiling
         best["placement_overhead"] = placement
         best["memory_overhead"] = memory
         best["quality_overhead"] = quality
+        best["leakcheck_overhead"] = leakcheck
         print(json.dumps(best, indent=2))
         ok = best["speedup_marginal"] >= 2.0
         print(f"smoke: fused marginal speedup {best['speedup_marginal']:.1f}x "
@@ -404,8 +461,16 @@ def main() -> None:
               f"{quality['disabled_overhead_frac'] * 100:+.2f}% vs "
               f"baseline (gate <= 2%), enabled mode "
               f"{quality['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
+        leak_ok = leakcheck["disabled_overhead_frac"] <= 0.02
+        verdict = ("OK" if leak_ok
+                   else "REGRESSION — disabled leakcheck is not free "
+                        "anymore")
+        print(f"smoke: leakcheck-disabled fast path "
+              f"{leakcheck['disabled_overhead_frac'] * 100:+.2f}% vs "
+              f"baseline (gate <= 2%), enabled mode "
+              f"{leakcheck['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
         sys.exit(0 if ok and trc_ok and prof_ok and plc_ok and mem_ok
-                 and qual_ok else 1)
+                 and qual_ok and leak_ok else 1)
 
     n_bufs = args.n_frames
     report = {"n_frames": n_bufs, "host_chain": [], "device_chain": None,
@@ -452,6 +517,15 @@ def main() -> None:
         n_bufs=min(n_bufs, 2000))
     t = report["quality_overhead"]
     print("— quality-tap overhead (8-element fused device chain) —")
+    print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
+          f"enabled {t['enabled_us_per_frame']:8.1f} "
+          f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
+          f"disabled {t['disabled_us_per_frame']:8.1f} "
+          f"({t['disabled_overhead_frac'] * 100:+.2f}%, gate <= 2%)")
+    report["leakcheck_overhead"] = leakcheck_overhead_report(
+        n_bufs=min(n_bufs, 2000))
+    t = report["leakcheck_overhead"]
+    print("— leakcheck overhead (8-element host chain) —")
     print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
           f"enabled {t['enabled_us_per_frame']:8.1f} "
           f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
