@@ -79,6 +79,22 @@ if _LEAKCHECK:
 
     _leak_sanitizer.enable_leakcheck()
 
+# ---------------------------------------------------------------------------
+# xfercheck: NNS_XFERCHECK=1 runs the whole session with the transfer
+# sanitizer enabled (analysis/sanitizer.py third half): the fused-dispatch
+# and backend-invoke jit regions run under transfer-guard disallow scopes
+# (any IMPLICIT device→host materialization inside them raises), and the
+# choke points (backend puts, queue hand-off, wire encode/decode, explicit
+# as_numpy pulls) feed a per-(stage,direction) byte ledger. Each test then
+# asserts zero NEW guard violations during its span — the runtime twin of
+# the NNL4xx transfer lint.
+# ---------------------------------------------------------------------------
+_XFERCHECK = os.environ.get("NNS_XFERCHECK", "") == "1"
+if _XFERCHECK:
+    from nnstreamer_tpu.analysis import sanitizer as _xfer_sanitizer
+
+    _xfer_sanitizer.enable_xfercheck()
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -90,6 +106,10 @@ def pytest_configure(config):
         "markers", "leak_ok: opt out of the per-test NNS_LEAKCHECK "
                    "zero-outstanding-resources check (intentionally "
                    "session-lived acquisitions)")
+    config.addinivalue_line(
+        "markers", "xfer_ok: opt out of the per-test NNS_XFERCHECK "
+                   "zero-implicit-D2H check (tests that exercise the "
+                   "violation path itself)")
 
 
 @pytest.fixture(autouse=True)
@@ -141,6 +161,26 @@ def _tsan_check(request):
     assert not fresh, (
         f"tsan-lite: {len(fresh)} lock-order violation(s) observed during "
         f"this test: {fresh}")
+
+
+@pytest.fixture(autouse=True)
+def _xfercheck(request):
+    """Under NNS_XFERCHECK=1: fail any test during which a guarded jit
+    region (fused dispatch, backend invoke) performed an implicit
+    device→host transfer. Explicit ``device_get`` / ``as_numpy`` pulls
+    stay legal — they are the accounted paths."""
+    if not _XFERCHECK:
+        yield
+        return
+    if request.node.get_closest_marker("xfer_ok"):
+        yield
+        return
+    before = len(_xfer_sanitizer.xfer_violations())
+    yield
+    fresh = _xfer_sanitizer.xfer_violations()[before:]
+    assert not fresh, (
+        f"xfercheck: {len(fresh)} implicit device→host transfer(s) inside "
+        f"guarded scopes during this test: {fresh}")
 
 
 # thread names owned by the control plane / serving layers — all of them

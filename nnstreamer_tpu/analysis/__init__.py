@@ -25,16 +25,28 @@ runtime, during caps negotiation). Two passes share one diagnostic model:
   exception edges, refcount balance, subprocess reap paths, atomic-write
   failure cleanup, unregister-at-stop — seeded by built-in knowledge of
   the repo's pairs plus the ``# pairs-with: <release>`` annotation
-  convention (the resource-ownership table is in docs/lint.md).
+  convention (the resource-ownership table is in docs/lint.md);
+* **transfer lint** (`lint_transfer`, rules ``NNL4xx``): device-transfer
+  and copy-discipline dataflow — values classified host/device/unknown
+  (provenance seeded from backend invoke results, jit bindings, ``jnp``
+  constructors), implicit device→host materializations in hot scopes,
+  per-frame device allocation churn, host round-trip sandwiches,
+  donation opportunities/violations, and whole-buffer byte copies on
+  the query/transport wire (the zero-copy contract in docs/lint.md).
 
 The static passes are paired with runtime sanitizers
 (:mod:`.sanitizer`): tsan-lite — the control plane creates its locks
 through ``sanitizer.named_lock``-style factories, which return raw
 ``threading`` primitives when disabled (zero overhead) and
 order-recording wrappers when enabled (``NNS_TSAN=1`` in the test
-suite) — and the ``NNS_LEAKCHECK=1`` leak ledger, where the same pairs
+suite) — the ``NNS_LEAKCHECK=1`` leak ledger, where the same pairs
 the lifecycle lint proves statically report their acquire/release at
-runtime and every test asserts zero outstanding units.
+runtime and every test asserts zero outstanding units — and the
+``NNS_XFERCHECK=1`` transfer sanitizer: ``jax.transfer_guard`` scopes
+at the fused-dispatch/backend-invoke choke points ban implicit
+device→host pulls while a per-(stage, direction) ledger byte-accounts
+every intentional transfer (surfaced via ``obs top`` / ``GET
+/profile``).
 
 CLI: ``python -m nnstreamer_tpu lint <pbtxt | launch-string | pkg>``
 (also ``tools/nnlint.py`` — the self-lint CI gate; ``--rules NNL2xx``
@@ -47,6 +59,7 @@ from .diagnostics import RULES, Diagnostic, Severity  # noqa: F401
 from .graph_lint import lint_launch, lint_pbtxt, lint_pipeline  # noqa: F401
 from .lifecycle_lint import lint_lifecycle  # noqa: F401
 from .source_lint import lint_source  # noqa: F401
+from .transfer_lint import lint_transfer  # noqa: F401
 
 __all__ = [
     "RULES",
@@ -58,4 +71,5 @@ __all__ = [
     "lint_pbtxt",
     "lint_pipeline",
     "lint_source",
+    "lint_transfer",
 ]

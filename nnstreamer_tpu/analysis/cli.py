@@ -47,6 +47,7 @@ def _lint_target(target: str) -> List[Diagnostic]:
     from .graph_lint import lint_launch, lint_pbtxt
     from .lifecycle_lint import lint_lifecycle
     from .source_lint import lint_source
+    from .transfer_lint import lint_transfer
 
     from .diagnostics import make
 
@@ -55,7 +56,8 @@ def _lint_target(target: str) -> List[Diagnostic]:
         root = str(p.parent)
         return (lint_source([p], root=root)
                 + lint_concurrency([p], root=root)
-                + lint_lifecycle([p], root=root))
+                + lint_lifecycle([p], root=root)
+                + lint_transfer([p], root=root))
     if p.suffix in (".pbtxt", ".launch", ".json"):
         try:
             text = p.read_text()

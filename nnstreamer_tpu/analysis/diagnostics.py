@@ -1,7 +1,8 @@
 """Diagnostic model and rule catalog shared by both lint passes.
 
 Every finding is a :class:`Diagnostic` carrying a stable rule ID
-(``NNL0xx`` graph rules, ``NNL1xx`` source rules), a severity, a
+(``NNL0xx`` graph, ``NNL1xx`` source, ``NNL2xx`` concurrency, ``NNL3xx``
+lifecycle, ``NNL4xx`` device-transfer rules), a severity, a
 human-readable message, and a location (element/pad name for graph
 findings, ``file:line:col`` span for source findings). The catalog in
 :data:`RULES` is the single source of truth — docs/lint.md and the CLI's
@@ -180,6 +181,36 @@ _RULES = (
          "with no matching unregister/drain on its stop path — stale "
          "entries keep publishing until GC, which for a weakref may be "
          "never while the scrape itself holds iteration references"),
+    # -- transfer lint (pass 5) -----------------------------------------------
+    Rule("NNL401", Severity.WARNING, "implicit device→host materialization in hot scope",
+         "a device-provenance value (backend invoke result, fusion_stage "
+         "output, jnp constructor) is materialized on host inside an "
+         "element/scheduler hot function — np.asarray/np.array, "
+         "float/int/bool, .tolist()/.item(), or Python iteration — "
+         "forcing one blocking device→host transfer per buffer; NNL1xx's "
+         "sync rules generalized from call names to value flow"),
+    Rule("NNL402", Severity.WARNING, "per-frame device allocation churn",
+         "a fresh jnp device array is constructed (zeros/ones/full/"
+         "arange/…) inside a per-buffer dispatch path — one device "
+         "allocation + H2D fill per frame that a hoisted constant or a "
+         "donated buffer would kill; allocation inside a nested "
+         "to-be-jitted closure is exempt (it compiles into the graph)"),
+    Rule("NNL403", Severity.WARNING, "host round-trip sandwich",
+         "one value goes device→host→device inside a single function "
+         "(materialized from a device value, then fed back to a jnp "
+         "constructor / device_put / invoke) — the intra-function twin "
+         "of graph-level NNL010; keep the intermediate on device"),
+    Rule("NNL404", Severity.WARNING, "donation opportunity / violation",
+         "a single-owner device value is passed to a jitted callable "
+         "compiled without donate_argnums (the buffer could be donated "
+         "and the output written in place), or a donated argument is "
+         "read after the call (its buffer was invalidated by XLA — "
+         "use-after-donate returns garbage or raises)"),
+    Rule("NNL405", Severity.WARNING, "byte-copy of a wire/shm buffer",
+         "bytes(buffer) / .tobytes() on a whole frame in a transport/"
+         "query hot path copies the payload the zero-copy wire contract "
+         "says must be handed off by reference (memoryview, sendmsg "
+         "gather-write, buffer-protocol file write)"),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in _RULES}

@@ -53,9 +53,33 @@ Release without a matching acquire is ignored (the resource predates
 enabling — a mid-session ``enable_leakcheck()`` must not manufacture
 phantom leaks); ``idempotent=True`` acquisitions (weakset-style
 registrations) count once per key no matter how often re-registered.
+
+**Transfer sanitizer (``NNS_XFERCHECK=1``).** The static transfer pass
+(:mod:`.transfer_lint`, rules NNL4xx) proves copy discipline for the
+dataflow it can SEE; this module's third half enforces it at runtime.
+The hot-path choke points — fused-segment dispatch, backend invoke,
+wire encode/decode, queue hand-off — do two things under the check:
+
+* the pure-jit regions (fused dispatch, backend invoke) run inside
+  :func:`no_implicit_d2h`, a ``jax.transfer_guard_device_to_host(
+  "disallow")`` scope: any IMPLICIT device→host pull (``np.asarray`` /
+  ``__array__`` on a device array) raises and is recorded as a
+  violation — explicit ``jax.device_get`` stays legal, which makes
+  "all intentional pulls go through the accounted path" checkable;
+* every intentional transfer reports its size into a per-(stage,
+  direction) byte ledger via :func:`note_transfer` — ``obs top`` and
+  ``GET /profile`` surface the per-stage bytes, giving the zero-copy
+  data-plane work (ROADMAP item 2) its before/after scoreboard.
+
+Disabled (the default), every hook is a single module-global check and
+immediate return, same contract as tsan-lite/leakcheck (microbench
+gated <= 2%). The test fixture asserts zero NEW violations per test,
+and the fused steady-state E2E asserts zero unintended device→host
+bytes per buffer.
 """
 from __future__ import annotations
 
+import contextlib
 import sys
 import threading
 import time
@@ -481,4 +505,138 @@ def leak_report() -> dict:
         "acquired_total": totals,
         "outstanding": rows,
         "outstanding_units": sum(r["count"] for r in rows),
+    }
+
+
+# ---------------------------------------------------------------------------
+# NNS_XFERCHECK — byte-accounted transfer sanitizer (see module docstring)
+# ---------------------------------------------------------------------------
+
+# module-global fast path: note_transfer/no_implicit_d2h check this and
+# only this when the transfer sanitizer is off (the microbench leg
+# gates it)
+XFER = False
+
+_xfer_lock = threading.Lock()   # guards the transfer tables below
+# (stage, direction) -> {bytes, count, site}; direction is "d2h" / "h2d"
+_xfer_ledger: Dict[Tuple[str, str], dict] = {}
+_xfer_violations: List[dict] = []
+
+
+def enable_xfercheck() -> None:
+    """Arm the transfer guards and byte ledger; clears both tables."""
+    global XFER
+    with _xfer_lock:
+        _xfer_ledger.clear()
+        del _xfer_violations[:]
+        XFER = True
+
+
+def disable_xfercheck() -> None:
+    global XFER
+    XFER = False
+
+
+def xfercheck_enabled() -> bool:
+    return XFER
+
+
+def reset_xfercheck() -> None:
+    """Drop every recorded transfer and violation (between test phases)."""
+    with _xfer_lock:
+        _xfer_ledger.clear()
+        del _xfer_violations[:]
+
+
+def note_transfer(stage: str, direction: str, nbytes: int,
+                  count: int = 1) -> None:
+    """Account one INTENTIONAL transfer of ``nbytes`` at a choke point.
+    ``direction`` is ``"d2h"`` (explicit device_get / Buffer.as_numpy)
+    or ``"h2d"`` (device_put staging, jnp upload); wire encode/decode
+    and queue hand-off account their host-side byte movement under
+    ``"wire"`` / ``"queue"`` stage names so the per-stage scoreboard
+    covers every boundary the zero-copy contract names."""
+    if not XFER:
+        return
+    site = _site(2)
+    with _xfer_lock:
+        entry = _xfer_ledger.get((stage, direction))
+        if entry is None:
+            entry = _xfer_ledger[(stage, direction)] = {
+                "bytes": 0, "count": 0, "site": site}
+        entry["bytes"] += int(nbytes)
+        entry["count"] += count
+
+
+def nbytes_of(tensors) -> int:
+    """Total byte size of a tensor/buffer sequence (device arrays,
+    numpy arrays, bytes, memoryviews — anything with ``nbytes`` or a
+    length)."""
+    total = 0
+    for t in tensors:
+        nb = getattr(t, "nbytes", None)
+        if nb is None:
+            try:
+                nb = len(t)
+            except TypeError:
+                nb = 0
+        total += int(nb)
+    return total
+
+
+@contextlib.contextmanager
+def no_implicit_d2h(stage: str):
+    """Run a pure-jit region under ``jax.transfer_guard_device_to_host(
+    "disallow")``: implicit device→host pulls raise (and are recorded
+    as violations); explicit ``jax.device_get`` stays legal. A no-op
+    (single global check) when the sanitizer is off."""
+    if not XFER:
+        yield
+        return
+    import jax
+
+    try:
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+    except Exception as e:  # noqa: BLE001 - classify, record, re-raise
+        msg = str(e)
+        if "transfer" in msg.lower():
+            with _xfer_lock:
+                _xfer_violations.append({
+                    "stage": stage, "site": _site(2),
+                    "thread": threading.current_thread().name,
+                    "error": msg[:300]})
+        raise
+
+
+def xfer_transfers() -> List[dict]:
+    """Per-(stage, direction) byte accounting rows (JSON-friendly),
+    largest first."""
+    with _xfer_lock:
+        rows = [
+            {"stage": stage, "direction": direction,
+             "bytes": e["bytes"], "count": e["count"], "site": e["site"]}
+            for (stage, direction), e in _xfer_ledger.items()]
+    rows.sort(key=lambda r: -r["bytes"])
+    return rows
+
+
+def xfer_violations() -> List[dict]:
+    """Guard trips recorded so far (implicit D2H inside a disallow
+    scope). The per-test fixture asserts no NEW entries."""
+    with _xfer_lock:
+        return list(_xfer_violations)
+
+
+def xfer_report() -> dict:
+    """Everything the transfer sanitizer knows (JSON-friendly)."""
+    rows = xfer_transfers()
+    totals: Dict[str, int] = {}
+    for r in rows:
+        totals[r["direction"]] = totals.get(r["direction"], 0) + r["bytes"]
+    return {
+        "enabled": XFER,
+        "transfers": rows,
+        "total_bytes": totals,
+        "violations": xfer_violations(),
     }

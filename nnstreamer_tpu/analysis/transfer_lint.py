@@ -1,0 +1,693 @@
+"""Pass 5: device-transfer & copy-discipline lint (rules NNL4xx).
+
+Pass 2's sync rules (NNL101/NNL102) match call *names*; this pass tracks
+value *flow*: a forward dataflow over each function classifies every
+local as ``device`` / ``host`` / ``hostdev`` (a host value materialized
+FROM a device value) / unknown, so the rules fire on what a value *is*,
+not what the call is spelled like.
+
+Device provenance seeds
+    * ``jnp.*`` / ``jax.numpy.*`` calls and ``jax.device_put``
+    * backend ``.invoke(...)`` results and ``fusion_stage`` outputs
+    * calls through a jit binding — a local ``f = jax.jit(...)`` or a
+      class attribute ``self._step = jax.jit(...)`` (``functools.partial``
+      wrappers around a jit included)
+    * one level of intra-module call expansion: a helper whose returns
+      classify as device credits its call sites (same discipline as
+      pass 2's hot-function expansion)
+
+Host provenance seeds: ``np.*`` / ``numpy.*`` constructors, ``bytes`` /
+``bytearray`` / ``memoryview``, caps/meta strings. A host value whose
+*source* was a device value (``np.asarray(dev)``, ``dev.tolist()``,
+``jax.device_get(dev)``) is ``hostdev`` — the state NNL403 watches.
+
+Rules
+    NNL401  implicit device→host materialization in a hot scope
+            (``np.asarray`` / ``float`` / ``int`` / ``bool`` /
+            ``.tolist`` / ``.item`` / iteration over a device array)
+    NNL402  per-frame device allocation churn (fresh ``jnp`` constructor
+            inside a per-buffer dispatch path; nested to-be-jitted
+            closures are exempt — their allocs compile into the graph)
+    NNL403  host round-trip sandwich at function granularity
+            (device→host→device on one value; intra-function twin of
+            graph-level NNL010)
+    NNL404  donation opportunity (single-owner device value into a jit
+            compiled without ``donate_argnums``) / donation violation
+            (donated argument read after the call)
+    NNL405  byte-copy of a wire/shm buffer (``bytes(buf)`` /
+            ``.tobytes()`` on a whole frame in transport/query paths;
+            header slices like ``bytes(blob[:4])`` are exempt)
+
+Hot scoping, pragmas (``# nnlint: disable=NNL4xx``) and ``skip-file``
+are shared with pass 2 (source_lint). The runtime twin is
+``NNS_XFERCHECK=1`` (analysis/sanitizer.py): transfer-guard scopes at
+the choke points plus a per-(stage, direction) byte ledger.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .diagnostics import Diagnostic, make
+from .source_lint import (_collect_pragmas, _dotted, _file_scope,
+                          _FunctionIndex, _method_name, _suppressed,
+                          skip_file)
+
+# value-flow states
+DEVICE = "device"
+HOST = "host"
+HOSTDEV = "hostdev"   # host value materialized from a device value
+DEVICEFN = "devicefn"  # callable returning device values (jit binding,
+#                        fusion_stage output)
+DEVICE_SEQ = "device_seq"  # host sequence OF device arrays (backend
+#                            invoke returns a list — iterating the list
+#                            is free; materializing an element is not)
+
+# fresh-allocation constructors: one device allocation (+ H2D fill for
+# the *_like/asarray forms) per call — churn when per-buffer (NNL402)
+_JNP_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange",
+                     "linspace", "eye", "zeros_like", "ones_like",
+                     "full_like", "asarray", "array"}
+
+# implicit materializers: produce a host value from a device one WITHOUT
+# going through the accounted explicit path (jax.device_get /
+# Buffer.as_numpy) — NNL401 in hot scope
+_NP_MATERIALIZERS = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array"}
+_SCALAR_PULLS = {"float", "int", "bool"}
+_METHOD_MATERIALIZERS = {"tolist", "item"}
+
+# wire-path files for NNL405: the query/transport stack plus the binary
+# tensor codec — everything the zero-copy wire contract covers
+_WIRE_DIRS = {"query", "transport", "shm"}
+_WIRE_FILES = {"serialize.py", "protocol.py"}
+
+
+def lint_transfer(paths: Sequence, *, root: Optional[str] = None
+                  ) -> List[Diagnostic]:
+    """Transfer-lint Python sources: each path is a file or a directory
+    walked recursively. ``root`` only affects display locations."""
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts))
+        else:
+            files.append(p)
+    diags: List[Diagnostic] = []
+    for f in files:
+        diags.extend(_lint_file(f, root=root))
+    return diags
+
+
+def _lint_file(path: Path, root: Optional[str] = None) -> List[Diagnostic]:
+    try:
+        text = path.read_text()
+        if skip_file(text):
+            return []
+        tree = ast.parse(text, filename=str(path))
+    except (OSError, SyntaxError, ValueError) as e:
+        return [make("NNL100", f"cannot lint {path}: {e}",
+                     location=str(path))]
+    display = str(path)
+    if root:
+        try:
+            display = str(path.relative_to(root))
+        except ValueError:
+            pass
+    pragmas, comments = _collect_pragmas(text)
+    scope = _file_scope(path)
+    finder = _FunctionIndex(tree)
+    hot_ids = {id(fn) for fn, _, _ in finder.hot_functions(scope)}
+    ctx = _ModuleContext(finder)
+
+    raw: List[Diagnostic] = []
+    for fn, cls in _all_functions(finder):
+        flow = _FunctionFlow(fn, cls, ctx)
+        flow.run()
+        hot = id(fn) in hot_ids
+        if hot:
+            raw += _emit_materializations(flow, fn, display)
+            raw += _emit_alloc_churn(flow, fn, display)
+        raw += _emit_sandwich(flow, fn, display)
+        raw += _emit_donation(flow, fn, display)
+    if _is_wire_file(path):
+        for fn, _cls in _all_functions(finder):
+            raw += _check_wire_copies(fn, display)
+    return [d for d in raw if not _suppressed(d, pragmas, comments)]
+
+
+def _all_functions(finder: _FunctionIndex
+                   ) -> List[Tuple[ast.FunctionDef, Optional[str]]]:
+    out: List[Tuple[ast.FunctionDef, Optional[str]]] = []
+    for fn in finder.module_funcs.values():
+        out.append((fn, None))
+    for (cls, _fname), fn in finder.methods.items():
+        out.append((fn, cls))
+    return out
+
+
+def _is_wire_file(path: Path) -> bool:
+    parts = set(path.parts)
+    return bool(parts & _WIRE_DIRS) or path.name in _WIRE_FILES
+
+
+# ---------------------------------------------------------------------------
+# module-level provenance context
+# ---------------------------------------------------------------------------
+
+def _is_jit_expr(value: ast.expr) -> Optional[ast.Call]:
+    """The jax.jit(...) call node when ``value`` is a jit binding —
+    direct (``jax.jit(f, ...)``) or partial-wrapped
+    (``functools.partial(jax.jit(f, ...), bound)``); else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    d = _dotted(value.func)
+    if d in ("jax.jit", "jit"):
+        return value
+    if d in ("functools.partial", "partial") and value.args:
+        inner = value.args[0]
+        if (isinstance(inner, ast.Call)
+                and _dotted(inner.func) in ("jax.jit", "jit")):
+            return inner
+    return None
+
+
+def _donate_argnums(jit_call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Constant donate_argnums of a jit call; () when absent; None when
+    present but not statically resolvable (skip NNL404 then)."""
+    for kw in jit_call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        vals = (kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value])
+        out = []
+        for v in vals:
+            if not (isinstance(v, ast.Constant)
+                    and isinstance(v.value, int)):
+                return None
+            out.append(v.value)
+        return tuple(out)
+    return ()
+
+
+class _ModuleContext:
+    """Cross-function provenance for one module: per-class jit attribute
+    bindings (``self._step = jax.jit(...)``) and one-level return-state
+    summaries for module functions / methods."""
+
+    def __init__(self, finder: _FunctionIndex):
+        self.finder = finder
+        # (class name, attr) -> (jit call node, partial-wrapped?)
+        self.jit_attrs: Dict[Tuple[str, str], Tuple[ast.Call, bool]] = {}
+        self._summaries: Dict[int, Optional[str]] = {}
+        for cls in finder.classes:
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                jit = _is_jit_expr(node.value)
+                if jit is None:
+                    continue
+                wrapped = node.value is not jit
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        self.jit_attrs[(cls.name, t.attr)] = (jit, wrapped)
+
+    def return_state(self, fn: ast.FunctionDef, cls: Optional[str]
+                     ) -> Optional[str]:
+        """DEVICE/HOST when every return of ``fn`` classifies that way
+        (one level only — summaries don't consult other summaries)."""
+        key = id(fn)
+        if key in self._summaries:
+            return self._summaries[key]
+        self._summaries[key] = None  # cycle/one-level guard
+        flow = _FunctionFlow(fn, cls, self, summarizing=True)
+        flow.run()
+        states = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                states.add(flow.classify(node.value))
+        state = None
+        if states == {DEVICE}:
+            state = DEVICE
+        elif states and states <= {HOST, HOSTDEV}:
+            state = HOST
+        self._summaries[key] = state
+        return state
+
+
+# ---------------------------------------------------------------------------
+# per-function forward dataflow
+# ---------------------------------------------------------------------------
+
+class _FunctionFlow:
+    """Single forward pass over one function body, in statement order
+    (loop back-edges are not iterated — lint precision, not soundness).
+    Classifies locals and ``self.x`` attributes and records the events
+    the NNL40x emitters translate into findings."""
+
+    def __init__(self, fn: ast.FunctionDef, cls: Optional[str],
+                 ctx: _ModuleContext, summarizing: bool = False):
+        self.fn = fn
+        self.cls = cls
+        self.ctx = ctx
+        self.summarizing = summarizing
+        self.env: Dict[str, str] = {}       # local name -> state
+        self.attr_env: Dict[str, str] = {}  # self attr  -> state
+        # local name -> jit call node (for NNL404 on local bindings)
+        self.jit_locals: Dict[str, ast.Call] = {}
+        # events
+        self.materializations: List[Tuple[ast.AST, str]] = []  # (node, what)
+        self.device_allocs: List[ast.Call] = []
+        self.sandwiches: List[Tuple[ast.Call, str]] = []  # (upload, name)
+        # (call, jit call node, callee label) through a resolvable binding
+        self.jit_calls: List[Tuple[ast.Call, ast.Call, str]] = []
+        # every Name load with its line (for single-owner / use-after)
+        self.loads: Dict[str, List[int]] = {}
+        self.local_device_names: Set[str] = set()
+
+    # -- classification -----------------------------------------------------
+
+    def classify(self, node: ast.expr) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                if (self.cls is not None
+                        and (self.cls, node.attr) in self.ctx.jit_attrs):
+                    return DEVICEFN
+                return self.attr_env.get(node.attr)
+            return None
+        if isinstance(node, ast.Call):
+            return self._classify_call(node)
+        if isinstance(node, (ast.BinOp,)):
+            left = self.classify(node.left)
+            right = self.classify(node.right)
+            if DEVICE in (left, right):
+                return DEVICE
+            if left in (HOST, HOSTDEV) or right in (HOST, HOSTDEV):
+                return HOSTDEV if HOSTDEV in (left, right) else HOST
+            return None
+        if isinstance(node, ast.UnaryOp):
+            return self.classify(node.operand)
+        if isinstance(node, ast.Subscript):
+            base = self.classify(node.value)
+            return DEVICE if base == DEVICE_SEQ else base
+        if isinstance(node, ast.IfExp):
+            a, b = self.classify(node.body), self.classify(node.orelse)
+            return a if a == b else None
+        if isinstance(node, ast.Constant):
+            return None
+        return None
+
+    def _classify_call(self, node: ast.Call) -> Optional[str]:
+        dotted = _dotted(node.func)
+        method = _method_name(node.func)
+        arg0 = node.args[0] if node.args else None
+        # device seeds
+        if dotted.startswith("jnp.") or dotted.startswith("jax.numpy."):
+            return DEVICE
+        if dotted == "jax.device_put":
+            return DEVICE
+        if method == "invoke":
+            return DEVICE_SEQ
+        if method == "fusion_stage" or dotted == "fusion_stage":
+            return DEVICEFN
+        if _is_jit_expr(node) is not None:
+            return DEVICEFN
+        if self.classify(node.func) == DEVICEFN:
+            return DEVICE
+        # explicit/implicit materializers: hostdev when fed a device value
+        if dotted == "jax.device_get":
+            return (HOSTDEV
+                    if arg0 is not None
+                    and self.classify(arg0) in (DEVICE, DEVICE_SEQ)
+                    else HOST)
+        if dotted in _NP_MATERIALIZERS:
+            return (HOSTDEV
+                    if arg0 is not None
+                    and self.classify(arg0) in (DEVICE, DEVICE_SEQ)
+                    else HOST)
+        if method in _METHOD_MATERIALIZERS:
+            base = self.classify(node.func.value)
+            return HOSTDEV if base == DEVICE else HOST
+        if method == "tobytes":
+            return HOST
+        # host seeds
+        if dotted.startswith("np.") or dotted.startswith("numpy."):
+            return HOST
+        if dotted in ("bytes", "bytearray", "memoryview"):
+            return HOST
+        # one-level intra-module call expansion
+        callee = self._resolve_callee(node)
+        if callee is not None and not self.summarizing:
+            fn, ccls = callee
+            return self.ctx.return_state(fn, ccls)
+        return None
+
+    def _resolve_callee(self, node: ast.Call
+                        ) -> Optional[Tuple[ast.FunctionDef, Optional[str]]]:
+        f = node.func
+        finder = self.ctx.finder
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and self.cls is not None):
+            target = finder.methods.get((self.cls, f.attr))
+            if target is not None:
+                return target, self.cls
+        elif isinstance(f, ast.Name):
+            target = finder.module_funcs.get(f.id)
+            if target is not None:
+                return target, None
+        return None
+
+    # -- statement walk ------------------------------------------------------
+
+    def run(self) -> None:
+        self._collect_loads(self.fn)
+        self._walk(self.fn.body, in_nested=False)
+
+    def _collect_loads(self, fn: ast.FunctionDef) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                self.loads.setdefault(node.id, []).append(node.lineno)
+
+    def _walk(self, body: List[ast.stmt], in_nested: bool) -> None:
+        for stmt in body:
+            self._statement(stmt, in_nested)
+
+    def _statement(self, stmt: ast.stmt, in_nested: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # nested defs are (almost always here) jit-traced stage
+            # closures: their jnp allocations compile into the graph —
+            # scan for materialization events only, flag no churn
+            self._scan_exprs(stmt, in_nested=True)
+            return
+        # compound statements: scan only the header expressions here —
+        # body statements are walked individually below (scanning the
+        # whole subtree would double-count their events)
+        if isinstance(stmt, ast.For):
+            self._scan_exprs(stmt.iter, in_nested, stop_at_defs=True)
+            iter_state = self.classify(stmt.iter)
+            if iter_state == DEVICE and not self.summarizing:
+                self.materializations.append(
+                    (stmt, "iteration over a device array"))
+            if isinstance(stmt.target, ast.Name) and iter_state is not None:
+                self.env[stmt.target.id] = (
+                    DEVICE if iter_state == DEVICE_SEQ else iter_state)
+            self._walk(stmt.body, in_nested)
+            self._walk(stmt.orelse, in_nested)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_exprs(stmt.test, in_nested, stop_at_defs=True)
+            self._walk(stmt.body, in_nested)
+            self._walk(stmt.orelse, in_nested)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_exprs(item.context_expr, in_nested,
+                                 stop_at_defs=True)
+            self._walk(stmt.body, in_nested)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk(stmt.body, in_nested)
+            for h in stmt.handlers:
+                self._walk(h.body, in_nested)
+            self._walk(stmt.orelse, in_nested)
+            self._walk(stmt.finalbody, in_nested)
+            return
+        self._scan_exprs(stmt, in_nested, stop_at_defs=True)
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            state = self.classify(stmt.value)
+            if isinstance(stmt.target, ast.Name) and state is not None:
+                self.env[stmt.target.id] = state
+
+    def _assign(self, targets: List[ast.expr], value: ast.expr) -> None:
+        state = self.classify(value)
+        jit = _is_jit_expr(value)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if jit is not None:
+                    self.jit_locals[t.id] = jit
+                if state is not None:
+                    self.env[t.id] = state
+                    if state == DEVICE:
+                        self.local_device_names.add(t.id)
+                elif t.id in self.env:
+                    del self.env[t.id]  # rebound to unknown
+            elif (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                if state is not None:
+                    self.attr_env[t.attr] = state
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                # tuple-unpack of one call: every element inherits the
+                # call's state (a jit returning (tok, cache) yields two
+                # device values)
+                for elt in t.elts:
+                    if isinstance(elt, ast.Name) and state is not None:
+                        self.env[elt.id] = state
+                        if state == DEVICE:
+                            self.local_device_names.add(elt.id)
+                    elif (isinstance(elt, ast.Attribute)
+                            and isinstance(elt.value, ast.Name)
+                            and elt.value.id == "self"
+                            and state is not None):
+                        self.attr_env[elt.attr] = state
+
+    def _scan_exprs(self, stmt: ast.stmt, in_nested: bool,
+                    stop_at_defs: bool = False) -> None:
+        """Record rule events for every expression of ``stmt`` (without
+        descending into nested defs when ``stop_at_defs``)."""
+        stack: List[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            if stop_at_defs and node is not stmt and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda, ast.ClassDef)):
+                self._scan_exprs(node, in_nested=True)
+                continue
+            if isinstance(node, ast.Call):
+                self._call_event(node, in_nested)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _call_event(self, node: ast.Call, in_nested: bool) -> None:
+        if self.summarizing:
+            return
+        dotted = _dotted(node.func)
+        method = _method_name(node.func)
+        arg0 = node.args[0] if node.args else None
+        # NNL401 events — implicit materialization of a device value
+        if (dotted in _NP_MATERIALIZERS and arg0 is not None
+                and self.classify(arg0) in (DEVICE, DEVICE_SEQ)):
+            self.materializations.append((node, dotted))
+        elif (dotted in _SCALAR_PULLS and arg0 is not None
+                and len(node.args) == 1
+                and self.classify(arg0) == DEVICE):
+            self.materializations.append((node, f"{dotted}()"))
+        elif (method in _METHOD_MATERIALIZERS
+                and self.classify(node.func.value) == DEVICE):
+            self.materializations.append((node, f".{method}()"))
+        # NNL402 events — fresh device constructor (exempt inside nested
+        # to-be-jitted closures)
+        if not in_nested:
+            tail = dotted.rsplit(".", 1)[-1] if "." in dotted else ""
+            if ((dotted.startswith("jnp.")
+                 or dotted.startswith("jax.numpy."))
+                    and tail in _JNP_CONSTRUCTORS):
+                self.device_allocs.append(node)
+        # NNL403 events — hostdev value fed back to device
+        upload = (dotted.startswith("jnp.")
+                  or dotted.startswith("jax.numpy.")
+                  or dotted == "jax.device_put"
+                  or method == "invoke")
+        if upload:
+            for arg in node.args:
+                s = self.classify(arg)
+                name = (arg.id if isinstance(arg, ast.Name)
+                        else ast.unparse(arg) if hasattr(ast, "unparse")
+                        else "<expr>")
+                if s == HOSTDEV:
+                    self.sandwiches.append((node, name))
+        # NNL404 events — call through a resolvable jit binding
+        jit_call = None
+        label = ""
+        if isinstance(node.func, ast.Name):
+            jit_call = self.jit_locals.get(node.func.id)
+            label = node.func.id
+        elif (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self" and self.cls is not None):
+            bound = self.ctx.jit_attrs.get((self.cls, node.func.attr))
+            if bound is not None and not bound[1]:  # partial-wrapped: the
+                jit_call = bound[0]  # positional index mapping is shifted
+                label = f"self.{node.func.attr}"  # by bound args — skip
+        if jit_call is not None:
+            self.jit_calls.append((node, jit_call, label))
+
+
+# ---------------------------------------------------------------------------
+# rule emitters
+# ---------------------------------------------------------------------------
+
+def _emit_materializations(flow: _FunctionFlow, fn: ast.FunctionDef,
+                           display: str) -> List[Diagnostic]:
+    diags = []
+    for node, what in flow.materializations:
+        diags.append(make(
+            "NNL401",
+            f"'{what}' materializes a device value on host inside hot "
+            f"function '{fn.name}' — one implicit device→host transfer "
+            "per buffer", location=display, line=node.lineno,
+            col=node.col_offset,
+            hint="keep the value device-resident, or pull once through "
+                 "the accounted path and pragma the intentional site",
+            fix_hint="stay on device (jnp ops), or route the pull "
+                     "through jax.device_get/Buffer.as_numpy at a "
+                     "batch boundary and add '# nnlint: disable=NNL401' "
+                     "with the justification"))
+    return diags
+
+
+def _emit_alloc_churn(flow: _FunctionFlow, fn: ast.FunctionDef,
+                      display: str) -> List[Diagnostic]:
+    diags = []
+    for node in flow.device_allocs:
+        what = _dotted(node.func)
+        diags.append(make(
+            "NNL402",
+            f"'{what}' allocates a fresh device array inside per-buffer "
+            f"hot function '{fn.name}' — one device allocation per "
+            "frame", location=display, line=node.lineno,
+            col=node.col_offset,
+            hint="hoist the constant to __init__/module scope, or reuse "
+                 "a donated buffer",
+            fix_hint=f"hoist the {what}(...) out of the per-buffer path "
+                     "(construct once, reuse), or donate the previous "
+                     "frame's buffer via donate_argnums"))
+    return diags
+
+
+def _emit_sandwich(flow: _FunctionFlow, fn: ast.FunctionDef,
+                   display: str) -> List[Diagnostic]:
+    diags = []
+    for node, name in flow.sandwiches:
+        diags.append(make(
+            "NNL403",
+            f"'{name}' went device→host and is re-uploaded to device in "
+            f"'{fn.name}' — a host round-trip sandwich on one value",
+            location=display, line=node.lineno, col=node.col_offset,
+            hint="keep the intermediate on device (the intra-function "
+                 "twin of graph-level NNL010)",
+            fix_hint="compute the intermediate with jnp ops instead of "
+                     "materializing it; drop the host hop entirely"))
+    return diags
+
+
+def _emit_donation(flow: _FunctionFlow, fn: ast.FunctionDef,
+                   display: str) -> List[Diagnostic]:
+    diags = []
+    for call, jit_call, label in flow.jit_calls:
+        donate = _donate_argnums(jit_call)
+        if donate is None:
+            continue  # non-constant donate_argnums: unresolvable
+        if not donate:
+            for arg in call.args:
+                if not (isinstance(arg, ast.Name)
+                        and arg.id in flow.local_device_names):
+                    continue
+                after = [ln for ln in flow.loads.get(arg.id, ())
+                         if ln > (call.end_lineno or call.lineno)]
+                if not after:
+                    diags.append(make(
+                        "NNL404",
+                        f"device value '{arg.id}' is single-owner at the "
+                        f"call to jitted '{label}' compiled without "
+                        "donate_argnums — its buffer could be donated",
+                        location=display, line=call.lineno,
+                        col=call.col_offset,
+                        hint="donate the input buffer so XLA writes the "
+                             "output in place",
+                        fix_hint=f"compile with jax.jit(..., donate_"
+                                 f"argnums=({call.args.index(arg)},)) "
+                                 f"and stop reusing '{arg.id}' after "
+                                 "the call"))
+        else:
+            for i in donate:
+                if i >= len(call.args):
+                    continue
+                arg = call.args[i]
+                if not isinstance(arg, ast.Name):
+                    continue
+                after = [ln for ln in flow.loads.get(arg.id, ())
+                         if ln > (call.end_lineno or call.lineno)]
+                if after and not _rebinds(call, arg.id, fn):
+                    diags.append(make(
+                        "NNL404",
+                        f"'{arg.id}' is donated to jitted '{label}' "
+                        f"(donate_argnums includes {i}) but read again "
+                        f"at line {after[0]} — use-after-donate on an "
+                        "invalidated buffer",
+                        location=display, line=call.lineno,
+                        col=call.col_offset,
+                        hint="rebind the name to the call result, or "
+                             "stop donating it",
+                        fix_hint=f"assign the jit result back to "
+                                 f"'{arg.id}' (carry-state style) or "
+                                 "drop it from donate_argnums"))
+    return diags
+
+
+def _rebinds(call: ast.Call, name: str, fn: ast.FunctionDef) -> bool:
+    """True when the statement containing ``call`` assigns ``name`` —
+    the canonical carry-state pattern ``x = f(x)`` (including tuple
+    targets), where later reads see the NEW buffer."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        found = any(sub is call for sub in ast.walk(node.value))
+        if not found:
+            continue
+        for t in node.targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if isinstance(e, ast.Name) and e.id == name:
+                    return True
+    return False
+
+
+def _check_wire_copies(fn: ast.FunctionDef, display: str
+                       ) -> List[Diagnostic]:
+    diags = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        method = _method_name(node.func)
+        if (dotted == "bytes" and len(node.args) == 1
+                and isinstance(node.args[0], (ast.Name, ast.Attribute))):
+            what = "bytes(<buffer>)"
+        elif method == "tobytes":
+            what = ".tobytes()"
+        else:
+            continue  # bytes(blob[a:b]) header slices etc are exempt
+        diags.append(make(
+            "NNL405",
+            f"'{what}' copies a whole wire/shm buffer in '{fn.name}' — "
+            "the zero-copy wire contract hands frames off by reference",
+            location=display, line=node.lineno, col=node.col_offset,
+            hint="pass the memoryview through (sendmsg gather-write, "
+                 "buffer-protocol file write) instead of copying",
+            fix_hint="replace the copy with a memoryview hand-off: "
+                     "sock.sendmsg([header, payload]) for sockets, "
+                     "fh.write(memoryview) for files"))
+    return diags

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis import sanitizer as _san
 from ..core import DataType, TensorsInfo
 from ..core.tensors import TensorSpec
 from ..registry.config import get_config
@@ -654,12 +655,20 @@ class JaxBackend(FilterBackend):
             elif self._device is not None and not self._device_is_default:
                 # pinned stage: stage the host array onto our chip explicitly
                 x = jax.device_put(x, self._device)
+                if _san.XFER:
+                    # intentional H2D staging: byte-accounted, not banned
+                    _san.note_transfer("backend:pinned_put", "h2d",
+                                       getattr(x, "nbytes", 0))
             # default-device host arrays go straight to the jitted call —
             # its C++ argument conversion does the same H2D transfer with
             # far less Python dispatch (measured: explicit device_put makes
             # a passthrough invoke ~70us; raw jit call is ~6.5us)
             device_inputs.append(x)
-        out = self._jitted(device_inputs)(*device_inputs)
+        # NNS_XFERCHECK: the jitted region itself must not pull implicitly
+        # (host inputs entering through the call's argument conversion are
+        # H2D — legal; only implicit D2H is banned)
+        with _san.no_implicit_d2h("backend:invoke"):
+            out = self._jitted(device_inputs)(*device_inputs)
         return list(out)
 
     def _invoke_sharded(self, inputs: List[Any]) -> List[Any]:
@@ -680,6 +689,9 @@ class JaxBackend(FilterBackend):
             if shape:  # batched tensor: shard when the mesh divides it
                 if shape[0] % n == 0:
                     x = jax.device_put(x, self._batch_sharding)
+                    if _san.XFER:
+                        _san.note_transfer("backend:shard_put", "h2d",
+                                           getattr(x, "nbytes", 0))
                 elif not self._mesh_warned:
                     self._mesh_warned = True
                     logger.warning(
@@ -691,7 +703,8 @@ class JaxBackend(FilterBackend):
             # rank-0 scalars / non-array aux inputs have no batch axis to
             # shard: pass through (replicated by GSPMD), no warning
             device_inputs.append(x)
-        out = self._jitted()(*device_inputs)
+        with _san.no_implicit_d2h("backend:invoke_sharded"):
+            out = self._jitted()(*device_inputs)
         return list(out)
 
     def fusion_callable(self):

@@ -13,6 +13,7 @@ every tensor on the streaming thread, tensor_filter.c:702-816).
 """
 from __future__ import annotations
 
+import sys as _sys
 import time
 from dataclasses import dataclass, field, replace
 from typing import Any, Optional, Sequence
@@ -68,10 +69,27 @@ class Buffer:
 
     # ------------------------------------------------------------------
     def as_numpy(self) -> "Buffer":
-        """Materialize device arrays on host. No copy for host arrays."""
+        """Materialize device arrays on host. No copy for host arrays.
+
+        This is THE accounted device→host path: the pull is an explicit
+        ``jax.device_get`` (legal under the NNS_XFERCHECK disallow
+        scopes, where an implicit ``np.asarray`` on a device array would
+        trip the transfer guard) and its bytes land in the transfer
+        ledger when the sanitizer is armed."""
         if not self.on_device:
             return self
-        host = [np.asarray(t) for t in self.tensors]
+        import jax  # deliberately lazy: core/ never imports jax at module scope
+
+        host = [jax.device_get(t) if _is_device_array(t) else np.asarray(t)
+                for t in self.tensors]
+        # sys.modules lookup, not an import: core/ must not import the
+        # analysis package (graph lint imports core.caps — cycle risk)
+        _san = _sys.modules.get("nnstreamer_tpu.analysis.sanitizer")
+        if _san is not None and _san.XFER:
+            _san.note_transfer(
+                "buffer:as_numpy", "d2h",
+                sum(int(h.nbytes) for h, t in zip(host, self.tensors)
+                    if _is_device_array(t)))
         return replace(self, tensors=host)
 
     def with_tensors(self, tensors: Sequence[Array]) -> "Buffer":

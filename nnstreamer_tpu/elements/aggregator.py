@@ -96,6 +96,10 @@ class TensorAggregator(TransformElement):
             import jax.numpy as jnp
 
             xp = jnp
+            # nnlint: disable=NNL402 — host-born frames joining a device
+            # window: this upload IS the element's work (asarray on an
+            # already-device tensor is a no-op; the guard above keeps
+            # all-host streams off this path entirely)
             arrays = [t if _is_device_array(t) else jnp.asarray(t)
                       for t in buf.tensors]
         else:

@@ -53,7 +53,8 @@ class DataRepoSink(SinkElement):
 
     def render(self, buf: Buffer) -> None:
         for t in buf.as_numpy().tensors:
-            self._fh.write(np.ascontiguousarray(t).tobytes())
+            # buffer-protocol write: no per-tensor .tobytes() copy
+            self._fh.write(np.ascontiguousarray(t).data)
         self._count += 1
 
     def stop(self) -> None:

@@ -86,8 +86,12 @@ class TensorMerge(Element):
         if any(p.on_device for p in parts):
             import jax.numpy as jnp
 
-            merged = jnp.concatenate(
-                [jnp.asarray(p.tensors[0]) for p in parts], axis=axis)
+            # nnlint: disable=NNL402 — mixed host/device merge: uploading
+            # the stray host parts is the element's work (asarray on the
+            # device parts is a no-op), and the all-host case never
+            # reaches this branch
+            device_parts = [jnp.asarray(p.tensors[0]) for p in parts]
+            merged = jnp.concatenate(device_parts, axis=axis)
         else:
             merged = np.concatenate(
                 [np.asarray(p.tensors[0]) for p in parts], axis=axis)

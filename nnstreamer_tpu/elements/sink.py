@@ -147,7 +147,10 @@ class FileSink(SinkElement):
 
     def render(self, buf: Buffer) -> None:
         for t in buf.as_numpy().tensors:
-            self._fh.write(np.ascontiguousarray(t).tobytes())
+            # write() consumes the array's buffer directly — no
+            # per-tensor .tobytes() copy (ascontiguousarray is a no-op
+            # for already-contiguous frames)
+            self._fh.write(np.ascontiguousarray(t).data)
         self._fh.flush()
 
 
@@ -177,4 +180,4 @@ class MultiFileSink(SinkElement):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "wb") as fh:
             for t in buf.as_numpy().tensors:
-                fh.write(np.ascontiguousarray(t).tobytes())
+                fh.write(np.ascontiguousarray(t).data)  # no copy: see filesink

@@ -620,7 +620,14 @@ def reset() -> None:
 
 
 def snapshot() -> dict:
-    return default_profiler.snapshot()
+    snap = default_profiler.snapshot()
+    # NNS_XFERCHECK byte ledger: when the transfer sanitizer is armed,
+    # per-(stage,direction) transfer bytes ride the same snapshot that
+    # feeds GET /profile and `obs top` — one surface for "where do my
+    # bytes cross the host/device (and process) boundary"
+    if _san.XFER:
+        snap["transfers"] = _san.xfer_transfers()
+    return snap
 
 
 def export_state() -> dict:
@@ -1131,6 +1138,18 @@ def render_top(profile_snap: dict, slo_status: List[dict],
             if scope == "queue_wait" and "depth" in s:
                 row += f"  {s['depth']:>5d}"
             lines.append(row)
+    transfers = profile_snap.get("transfers")
+    if transfers:
+        # NNS_XFERCHECK byte ledger (analysis/sanitizer.py third half):
+        # where bytes cross the host/device and process boundaries,
+        # largest movers first
+        lines.append("")
+        lines.append("TRANSFERS (NNS_XFERCHECK byte ledger)")
+        lines.append(f"  {'stage':<40} {'dir':>8} {'MiB':>10} {'n':>8}")
+        for row in transfers:
+            lines.append(
+                f"  {row['stage']:<40} {row['direction']:>8} "
+                f"{row['bytes'] / (1 << 20):>10.3f} {row['count']:>8d}")
     requests = profile_snap.get("requests", {})
     if requests:
         lines.append("")

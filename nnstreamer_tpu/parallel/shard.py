@@ -34,7 +34,11 @@ class ShardedRunner:
         self.mesh = mesh
         self.batch_axis = batch_axis
         self._in_sharding = NamedSharding(mesh, P(batch_axis))
-        self._jit = jax.jit(fn, in_shardings=(self._in_sharding,))
+        # donate the batch: __call__ device_puts a fresh single-owner
+        # array right before the call, so without donation every invoke
+        # holds input + output resident simultaneously (NNL404)
+        self._jit = jax.jit(fn, in_shardings=(self._in_sharding,),
+                            donate_argnums=(0,))
 
     @property
     def batch_divisor(self) -> int:

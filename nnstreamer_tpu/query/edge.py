@@ -247,10 +247,16 @@ class MqttSubscriber:
 
         def on_message(t: str, body: bytes) -> None:
             if t == f"{data_topic}/caps":
-                self.caps = parse_caps_string(bytes(body).decode())
+                # str(buf, "utf-8") decodes straight from any buffer —
+                # no intermediate bytes copy (cold path anyway, but the
+                # idiom is free)
+                self.caps = parse_caps_string(str(body, "utf-8"))
                 self._caps_evt.set()
             elif t == data_topic:
-                self._q.put(unpack_tensors(bytes(body)))
+                # per-frame hot path: unpack_tensors reads any contiguous
+                # buffer directly; the old bytes(body) re-copied every
+                # frame before the codec's own array copies (NNL405)
+                self._q.put(unpack_tensors(body))
 
         self._client.subscribe(f"{data_topic}/caps", on_message,
                                timeout=timeout)

@@ -101,7 +101,9 @@ def _buffer_to_ext(idl: str, buf: Buffer,
 def _ext_to_buffer(idl: str, msg: bytes) -> Tuple[Buffer, Caps]:
     """Reference ``Tensors`` message → (Buffer, caps derived from the
     per-message dimension/type fields — these IDLs' only config channel)."""
-    arrays, names, fmt, _rate = _EXT_IDL[idl][2].decode_tensors(bytes(msg))
+    # grpc delivers owning bytes already; the codecs read any buffer —
+    # wrapping in bytes() here paid a full-frame copy per message (NNL405)
+    arrays, names, fmt, _rate = _EXT_IDL[idl][2].decode_tensors(msg)
     info = TensorsInfo(
         tuple(TensorSpec(a.shape, a.dtype, name) for a, name in
               zip(arrays, names)), fmt)
@@ -204,7 +206,10 @@ class GrpcTensorService:
                                   "server pipeline has no negotiated caps yet")
                 yield b"C" + str(self._out_caps).encode()
                 for item in _drain(q, context):
-                    yield b"E" if item is None else b"D" + bytes(item)
+                    # join gathers the tag + memoryview frame in ONE copy
+                    # (grpc needs an owning message anyway); the old
+                    # ``b"D" + bytes(item)`` paid two
+                    yield b"E" if item is None else b"".join((b"D", item))
             finally:
                 _unregister_sub(q, "own")
 
@@ -243,6 +248,11 @@ class GrpcTensorService:
                     for item in _drain(q, context):
                         if item is None:
                             return  # EOS = end of stream (reference)
+                        # nnlint: disable=NNL405 — grpc requires an owning
+                        # immutable message object; items here are codec
+                        # bytes (already owning) or a pack_tensors
+                        # memoryview whose backing scratch is reused —
+                        # this copy is the ownership transfer, not waste
                         yield bytes(item)
                 finally:
                     _unregister_sub(q, idl)

@@ -54,6 +54,9 @@ def _encode_len(n: int) -> bytes:
         n //= 128
         out.append(digit | (0x80 if n else 0))
         if not n:
+            # nnlint: disable=NNL405 — a <=4-byte varint length field, not
+            # a frame payload: the copy is the owning-bytes conversion of
+            # a scratch bytearray, amortized to nothing
             return bytes(out)
 
 
@@ -96,11 +99,24 @@ def _read_packet(sock: socket.socket) -> Optional[Tuple[int, int, bytes]]:
 
 def _send_packet(sock: socket.socket, ptype: int, payload: bytes,
                  flags: int = 0) -> None:
-    # nnlint: disable=NNL203 — deliberate: callers hold their write lock
-    # ACROSS this sendall precisely so concurrent publishers cannot
+    # The NNL203 pragmas below are deliberate: callers hold their write
+    # lock ACROSS these sends precisely so concurrent publishers cannot
     # interleave partial MQTT frames on the shared socket; the lock's
-    # whole job is to serialize the blocking write
-    sock.sendall(bytes([ptype << 4 | flags]) + _encode_len(len(payload)) + payload)
+    # whole job is to serialize the blocking write.
+    header = bytes([ptype << 4 | flags]) + _encode_len(len(payload))
+    if not payload or not hasattr(sock, "sendmsg"):
+        sock.sendall(header + payload)  # nnlint: disable=NNL203
+        return
+    # scatter-gather: one syscall, and a memoryview payload (a packed
+    # tensor frame riding an MQTT body) is never copied to concatenate
+    sent = sock.sendmsg([header, payload])
+    if sent < len(header) + len(payload):  # rare partial write: stitch
+        if sent < len(header):
+            sock.sendall(header[sent:])  # nnlint: disable=NNL203
+            sock.sendall(payload)  # nnlint: disable=NNL203
+        else:
+            sock.sendall(  # nnlint: disable=NNL203
+                memoryview(payload)[sent - len(header):])
 
 
 def _mqtt_str(s: bytes) -> bytes:
@@ -155,8 +171,12 @@ class MqttClient:
     # -- api ----------------------------------------------------------------
     def publish(self, topic: str, payload, retain: bool = False) -> None:
         head = _mqtt_str(topic.encode())
+        # join accepts buffer-protocol payloads (memoryview from
+        # pack_tensors): ONE gather copy into the MQTT body, where
+        # ``head + bytes(payload)`` paid a copy plus a concat copy
+        body = b"".join((head, payload))
         with self._write_lock:
-            _send_packet(self._sock, PUBLISH, head + bytes(payload),
+            _send_packet(self._sock, PUBLISH, body,
                          flags=0x01 if retain else 0x00)
 
     def subscribe(self, topic: str,

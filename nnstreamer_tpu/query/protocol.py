@@ -65,18 +65,32 @@ def check_connect_fault(host: str, port: int) -> None:
 
 
 def send_msg(sock: socket.socket, msg_type: MsgType, payload=b"") -> None:
-    """Send one frame; accepts bytes or a memoryview payload. Large payloads
-    go out as a second sendall so a memoryview from ``pack_tensors`` is never
-    copied into a concatenated bytes object."""
+    """Send one frame; accepts bytes or a memoryview payload. Header and
+    payload go out as ONE scatter-gather ``sendmsg`` — one syscall, and a
+    memoryview from ``pack_tensors`` is never copied into a concatenated
+    bytes object (the old small-payload path paid one ``bytes(payload)``
+    copy per frame; NNL405's finding)."""
     hook = _send_fault_hook
     if hook is not None:
         hook(sock, msg_type)
     header = _HEADER.pack(MAGIC, int(msg_type), len(payload))
-    if len(payload) <= 1 << 13:
-        sock.sendall(header + bytes(payload))
-    else:
+    if not payload:
         sock.sendall(header)
+        return
+    if not hasattr(sock, "sendmsg"):  # non-POSIX socket object (tests'
+        sock.sendall(header)          # fakes): two writes, still no copy
         sock.sendall(payload)
+        return
+    sent = sock.sendmsg([header, payload])
+    total = len(header) + len(payload)
+    if sent < total:
+        # rare partial gather-write (tiny socket buffer): stitch the
+        # remainder with plain sendalls — cold path, correctness only
+        if sent < len(header):
+            sock.sendall(header[sent:])
+            sock.sendall(payload)
+        else:
+            sock.sendall(memoryview(payload)[sent - len(header):])
 
 
 def recv_msg(sock: socket.socket) -> Optional[Tuple[MsgType, bytes]]:

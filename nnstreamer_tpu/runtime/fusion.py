@@ -44,6 +44,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable, Dict, List, Optional
 
+from ..analysis import sanitizer as _san
 from ..analysis.sanitizer import named_lock
 from ..core import Buffer, clock_now
 from ..obs import context as obs_context
@@ -528,7 +529,12 @@ class FusedSegment:
                 return True  # dropped (QoS throttle), buffer consumed
         t0 = clock_now()
         try:
-            outs = call(tuple(buf.tensors))
+            # NNS_XFERCHECK: the fused region is a pure-jit dispatch —
+            # steady state must perform ZERO implicit device→host pulls
+            # (the zero-copy contract's sentinel scope; a no-op module-
+            # global check when the sanitizer is off)
+            with _san.no_implicit_d2h(f"fused:{self.name}"):
+                outs = call(tuple(buf.tensors))
         except Exception as e:
             # an allocation failure must land in the flight ring WITH the
             # owning stage's name before the error path erases the context

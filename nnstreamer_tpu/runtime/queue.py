@@ -16,6 +16,7 @@ from collections import deque
 from time import monotonic as _monotonic
 from typing import Optional
 
+from ..analysis import sanitizer as _san
 from ..analysis.sanitizer import named_condition
 from ..core import Buffer, Caps, Event, EventType
 from ..obs import profile as obs_profile
@@ -229,6 +230,17 @@ class QueueElement(Element):
                     obs_profile.record_queue_wait(
                         obs_profile.series_name(self),
                         _monotonic() - t0, self._ch._n_bufs)
+                if _san.XFER:
+                    # queue hand-off choke point: byte-accounting only —
+                    # a disallow scope here would outlaw the legitimate
+                    # host elements running on this worker thread. Device
+                    # buffers cross by reference (zero copy), and the
+                    # ledger proves it: "queue" rows carry bytes moved,
+                    # not bytes copied.
+                    _san.note_transfer(
+                        f"queue:{self.name}",
+                        "device" if payload.on_device else "host",
+                        payload.nbytes)
                 try:
                     self.srcpad.push(payload)
                 except Exception as e:  # noqa: BLE001

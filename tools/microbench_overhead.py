@@ -38,7 +38,12 @@ taps on (sampled device-side health reductions) reported alongside.
 The NNS_LEAKCHECK paired-resource ledger (analysis/sanitizer.py) gets
 the same leg on the host chain: disabled = one module-global check per
 note_* call site (and NOTHING on the per-buffer path, by construction),
-gated <= 2%; enabled-mode ledger cost reported alongside.
+gated <= 2%; enabled-mode ledger cost reported alongside. The
+NNS_XFERCHECK transfer sanitizer (analysis/sanitizer.py third half)
+gets the same leg on the fused DEVICE chain — its guard scope wraps the
+fused dispatch itself: disabled = one module-global check at each choke
+point, gated <= 2%; enabled mode (transfer-guard scopes + byte ledger)
+reported alongside.
 
 Usage:
   python tools/microbench_overhead.py [n_frames]      # full report
@@ -343,6 +348,50 @@ def leakcheck_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     }
 
 
+def xfercheck_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
+    """NNS_XFERCHECK transfer-sanitizer cost on an 8-element fused
+    DEVICE chain — the hooks live exactly where this leg measures: the
+    fused dispatch runs under the transfer-guard scope and the choke
+    points check the module global per buffer. Same three-state protocol
+    and min-of-pairs gate as the tracing/profiler/leakcheck legs:
+
+    * ``baseline`` — xfercheck never enabled in this leg's pair;
+    * ``enabled``  — ``sanitizer.enable_xfercheck()`` (guard scopes
+      armed + byte ledger recording) — REPORTED, not gated;
+    * ``disabled`` — after ``disable_xfercheck()``: back to the
+      one-module-global check, gated at <= 2% vs its paired baseline.
+    """
+    import statistics
+
+    from nnstreamer_tpu.analysis import sanitizer as nns_sanitizer
+
+    measure(8, max(200, n_bufs // 4), DEVICE_ELEM)  # warmup
+    baselines, disableds, enabled = [], [], None
+    for _ in range(attempts):
+        baselines.append(measure(8, n_bufs, DEVICE_ELEM))
+        nns_sanitizer.enable_xfercheck()
+        try:
+            if enabled is None:
+                enabled = measure(8, n_bufs, DEVICE_ELEM)
+        finally:
+            nns_sanitizer.disable_xfercheck()
+            nns_sanitizer.reset_xfercheck()
+        disableds.append(measure(8, n_bufs, DEVICE_ELEM))
+    ratios = [d / b for b, d in zip(baselines, disableds)]
+    baseline = min(baselines)
+    return {
+        "n_frames": n_bufs,
+        "attempts": attempts,
+        "baseline_us_per_frame": baseline * 1e6,
+        "enabled_us_per_frame": enabled * 1e6,
+        "disabled_us_per_frame": min(disableds) * 1e6,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "disabled_overhead_frac": min(ratios) - 1.0,
+        "disabled_overhead_frac_median": statistics.median(ratios) - 1.0,
+        "enabled_overhead_frac": enabled / baseline - 1.0,
+    }
+
+
 def placement_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     """Placement cost on an 8-element fused DEVICE chain: per-buffer
     steady state with a plan applied vs ``place`` off, same min-of-pairs
@@ -414,12 +463,14 @@ def main() -> None:
         memory = memory_overhead_report(n_bufs=1500, attempts=4)
         quality = quality_overhead_report(n_bufs=1500, attempts=4)
         leakcheck = leakcheck_overhead_report(n_bufs=2000, attempts=4)
+        xfercheck = xfercheck_overhead_report(n_bufs=1500, attempts=4)
         best["tracing_overhead"] = tracing
         best["profiler_overhead"] = profiling
         best["placement_overhead"] = placement
         best["memory_overhead"] = memory
         best["quality_overhead"] = quality
         best["leakcheck_overhead"] = leakcheck
+        best["xfercheck_overhead"] = xfercheck
         print(json.dumps(best, indent=2))
         ok = best["speedup_marginal"] >= 2.0
         print(f"smoke: fused marginal speedup {best['speedup_marginal']:.1f}x "
@@ -469,14 +520,23 @@ def main() -> None:
               f"{leakcheck['disabled_overhead_frac'] * 100:+.2f}% vs "
               f"baseline (gate <= 2%), enabled mode "
               f"{leakcheck['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
+        xc_ok = xfercheck["disabled_overhead_frac"] <= 0.02
+        verdict = ("OK" if xc_ok
+                   else "REGRESSION — disabled xfercheck is not free "
+                        "anymore")
+        print(f"smoke: xfercheck-disabled fast path "
+              f"{xfercheck['disabled_overhead_frac'] * 100:+.2f}% vs "
+              f"baseline (gate <= 2%), enabled mode "
+              f"{xfercheck['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
         sys.exit(0 if ok and trc_ok and prof_ok and plc_ok and mem_ok
-                 and qual_ok and leak_ok else 1)
+                 and qual_ok and leak_ok and xc_ok else 1)
 
     n_bufs = args.n_frames
     report = {"n_frames": n_bufs, "host_chain": [], "device_chain": None,
               "tracing_overhead": None, "profiler_overhead": None,
               "placement_overhead": None, "memory_overhead": None,
-              "quality_overhead": None}
+              "quality_overhead": None, "leakcheck_overhead": None,
+              "xfercheck_overhead": None}
     # before any other measurement: the baseline leg requires a process
     # where tracing has never been enabled
     report["tracing_overhead"] = tracing_overhead_report(
@@ -526,6 +586,15 @@ def main() -> None:
         n_bufs=min(n_bufs, 2000))
     t = report["leakcheck_overhead"]
     print("— leakcheck overhead (8-element host chain) —")
+    print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
+          f"enabled {t['enabled_us_per_frame']:8.1f} "
+          f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
+          f"disabled {t['disabled_us_per_frame']:8.1f} "
+          f"({t['disabled_overhead_frac'] * 100:+.2f}%, gate <= 2%)")
+    report["xfercheck_overhead"] = xfercheck_overhead_report(
+        n_bufs=min(n_bufs, 2000))
+    t = report["xfercheck_overhead"]
+    print("— xfercheck overhead (8-element fused device chain) —")
     print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
           f"enabled {t['enabled_us_per_frame']:8.1f} "
           f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
