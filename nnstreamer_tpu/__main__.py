@@ -399,6 +399,10 @@ def _obs_top(args) -> int:
                 data["fleet"] = client.fleet().get("fleet")
             except ServiceError:
                 data["fleet"] = None  # pre-PR-13 serve process
+            try:
+                data["transport"] = client.transport().get("transport")
+            except ServiceError:
+                data["transport"] = None  # pre-PR-18 serve process
             return data
         from . import aot
         from .obs import fleet as obs_fleet
@@ -407,6 +411,7 @@ def _obs_top(args) -> int:
         from .obs import slo as obs_slo
         from .runtime import placement
         from .service import autoscaler as svc_autoscaler
+        from .transport import stats as wire_stats
 
         return {"profile": obs_profile.snapshot(),
                 "slo": obs_slo.status_all(),
@@ -415,6 +420,7 @@ def _obs_top(args) -> int:
                 "quality": obs_quality.snapshot(),
                 "autoscale": svc_autoscaler.snapshot_all(),
                 "fleet": obs_fleet.snapshot_all(),
+                "transport": wire_stats.snapshot(),
                 "aot": aot.snapshot()}
 
     while True:
@@ -426,6 +432,7 @@ def _obs_top(args) -> int:
                                      quality=data.get("quality"),
                                      autoscale=data.get("autoscale"),
                                      fleet=data.get("fleet"),
+                                     transport=data.get("transport"),
                                      aot=data.get("aot")))
         if not args.watch:
             return 0

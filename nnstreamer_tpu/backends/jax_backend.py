@@ -244,6 +244,10 @@ class JaxBackend(FilterBackend):
         # this backend serves through a cached/exported artifact, None on
         # the plain-jit path (cache off, mesh mode, export refused)
         self._aot_state: Optional[str] = None
+        # double-buffered host→device staging for the PINNED path only
+        # (transport/staging.py); the default-device fast path never
+        # pays an explicit put and never builds one
+        self._stager = None
 
     # -- open/close ---------------------------------------------------------
     def open(self, props: FilterProperties) -> None:
@@ -446,6 +450,9 @@ class JaxBackend(FilterBackend):
         self._fn = None
         self._jit = None
         self._aot_state = None
+        if self._stager is not None:
+            self._stager.drain()
+            self._stager = None
         super().close()
 
     def aot_state(self) -> Optional[str]:
@@ -626,6 +633,19 @@ class JaxBackend(FilterBackend):
                 "custom=max_signatures:N to silence",
                 self.props.model if self.props else "?", n)
 
+    def _stage_pinned(self, inputs: List[Any]) -> List[Any]:
+        """Stage host inputs onto the pinned chip through the two-slot
+        stager; re-targets (and drops stale slots) when the placement
+        planner moved this backend to another device."""
+        from ..transport.staging import DoubleBufferedStager
+
+        s = self._stager
+        if s is None:
+            s = self._stager = DoubleBufferedStager(self._device)
+        elif s.device is not self._device:
+            s.retarget(self._device)
+        return s.stage(inputs)
+
     def invoke(self, inputs: List[Any]) -> List[Any]:
         import jax
 
@@ -638,6 +658,17 @@ class JaxBackend(FilterBackend):
             return list(self._jitted()(*inputs))
         if self._mesh is not None:
             return self._invoke_sharded(inputs)
+        pinned = self._device is not None and not self._device_is_default
+        if pinned and any(not hasattr(x, "addressable_shards")
+                          for x in inputs):
+            # pinned stage: the host arrays ride the double-buffered
+            # stager (transport/staging.py) — the async put for frame
+            # N+1 is issued while frame N's handles stay parked, so the
+            # transfer overlaps the previous dispatch's device compute
+            # instead of serializing behind it ("staging:put" in the
+            # XFER ledger, the accounted successor of the old per-call
+            # backend:pinned_put)
+            inputs = self._stage_pinned(inputs)
         device_inputs = []
         for x in inputs:
             if hasattr(x, "addressable_shards"):
@@ -649,16 +680,8 @@ class JaxBackend(FilterBackend):
                 # follow jax's configured default, and forcing devices[0]
                 # here could split the call across two devices.
                 devs = x.devices()
-                if (self._device is not None and not self._device_is_default
-                        and len(devs) == 1 and devs != {self._device}):
+                if (pinned and len(devs) == 1 and devs != {self._device}):
                     x = jax.device_put(x, self._device)
-            elif self._device is not None and not self._device_is_default:
-                # pinned stage: stage the host array onto our chip explicitly
-                x = jax.device_put(x, self._device)
-                if _san.XFER:
-                    # intentional H2D staging: byte-accounted, not banned
-                    _san.note_transfer("backend:pinned_put", "h2d",
-                                       getattr(x, "nbytes", 0))
             # default-device host arrays go straight to the jitted call —
             # its C++ argument conversion does the same H2D transfer with
             # far less Python dispatch (measured: explicit device_put makes

@@ -412,6 +412,7 @@ class FleetView:
                 "scrapes": st.scrapes,
                 "errors": st.errors,
                 "last_error": st.last_error,
+                "wire": _wire_summary(st.metrics_text),
             })
         return out
 
@@ -850,6 +851,26 @@ def _collect_fleet(reg: obs_metrics.Registry) -> None:
 obs_metrics.register_collector("fleet", _collect_fleet)
 
 
+def _wire_summary(metrics_text: str) -> Optional[str]:
+    """Condense a replica's ``nns_wire_*`` samples (last ``/metrics``
+    scrape) into one label: ``"binary+shm"``, ``"binary"``, ``"json"``,
+    a comma list when connections are mixed, None before any handshake.
+    This is how a replica silently stuck on the JSON fallback shows in
+    ``obs fleet`` / the FLEET section of ``obs top``."""
+    if not metrics_text:
+        return None
+    formats = sorted(
+        {labels.get("format", "?")
+         for name, labels, value in promtext.parse_samples(metrics_text)
+         if name == "nns_wire_connections" and value > 0})
+    if not formats:
+        return None
+    shm = promtext.sample(metrics_text, "nns_shm_events_total",
+                          event="slot_writes")
+    tag = ",".join(formats)
+    return tag + "+shm" if shm else tag
+
+
 def render_section(fleet_snaps: List[dict]) -> List[str]:
     """The FLEET section of ``obs top`` (appended by
     ``profile.render_top`` when fleet snapshots are supplied)."""
@@ -863,7 +884,7 @@ def render_section(fleet_snaps: List[dict]) -> List[str]:
                      f"(tick {snap.get('tick_s', 0):g}s, "
                      f"stale after {snap.get('stale_after_s', 0):g}s)")
         lines.append(f"  {'replica':<28} {'state':>7} {'age_s':>7} "
-                     f"{'scrapes':>8} {'errors':>7}")
+                     f"{'scrapes':>8} {'errors':>7} {'wire':>11}")
         for r in rows:
             state = ("STALE" if r.get("stale")
                      else "ok" if r.get("ok") else "error")
@@ -871,7 +892,8 @@ def render_section(fleet_snaps: List[dict]) -> List[str]:
             lines.append(
                 f"  {r['replica']:<28} {state:>7} "
                 f"{'—' if age_s is None else f'{age_s:.1f}':>7} "
-                f"{r.get('scrapes', 0):>8d} {r.get('errors', 0):>7d}")
+                f"{r.get('scrapes', 0):>8d} {r.get('errors', 0):>7d} "
+                f"{r.get('wire') or '—':>11}")
         requests = snap.get("profile", {}).get("requests", {})
         if requests:
             lines.append(f"  {'merged series':<28} {'p50ms':>9} "

@@ -523,6 +523,45 @@ def _collect_fused(reg: Registry) -> None:
                       segment=seg.name)
 
 
+def _collect_wire(reg: Registry) -> None:
+    """Data-plane counters (transport/stats.py): negotiated wire formats,
+    frames/bytes per format+direction, shm ring events. How a fleet
+    silently stuck on the JSON fallback shows up in ``obs fleet``."""
+    from ..transport import stats as wire_stats
+
+    conn = reg.gauge("nns_wire_connections",
+                     "open query connections by negotiated wire format",
+                     ("format",))
+    neg = reg.counter("nns_wire_negotiated_total",
+                      "handshakes completed by selected wire format",
+                      ("format",))
+    frames = reg.counter("nns_wire_frames_total",
+                         "DATA frames moved", ("format", "direction"))
+    nbytes = reg.counter("nns_wire_bytes_total",
+                         "DATA payload bytes moved (shm frames count their "
+                         "slot bytes, not the descriptor)",
+                         ("format", "direction"))
+    shm = reg.counter("nns_shm_events_total",
+                      "shared-memory ring events (slot_writes, bytes, "
+                      "fallback_full, fallback_oversize, reclaimed_slots, "
+                      "segments_created/attached/closed)", ("event",))
+    for inst in (conn, neg, frames, nbytes, shm):  # snapshot mirrors
+        inst.clear()
+    snap = wire_stats.snapshot()
+    for fmt, v in snap["connections"].items():
+        conn.set(v, format=fmt)
+    for fmt, v in snap["negotiated"].items():
+        neg.set_total(v, format=fmt)
+    for key, v in snap["frames"].items():
+        fmt, direction = key.rsplit(":", 1)
+        frames.set_total(v, format=fmt, direction=direction)
+    for key, v in snap["bytes"].items():
+        fmt, direction = key.rsplit(":", 1)
+        nbytes.set_total(v, format=fmt, direction=direction)
+    for event, v in snap["shm"].items():
+        shm.set_total(v, event=event)
+
+
 def _collect_obs(reg: Registry) -> None:
     from . import context, flight
 
@@ -542,4 +581,5 @@ register_collector("serving", _collect_serving)
 register_collector("fabric", _collect_fabric)
 register_collector("services", _collect_services)
 register_collector("fused", _collect_fused)
+register_collector("wire", _collect_wire)
 register_collector("obs", _collect_obs)

@@ -1062,6 +1062,7 @@ def render_top(profile_snap: dict, slo_status: List[dict],
                quality: Optional[dict] = None,
                autoscale: Optional[List[dict]] = None,
                fleet: Optional[List[dict]] = None,
+               transport: Optional[dict] = None,
                aot: Optional[dict] = None) -> str:
     """The ``obs top`` one-shot/watch dashboard: per-element rates,
     queue waits + depths, fused quantiles, request series, SLO burn,
@@ -1103,6 +1104,32 @@ def render_top(profile_snap: dict, slo_status: List[dict],
                 f"mem {last.get('memory_used_fraction', 0):.2f} "
                 f"cooldown out {last.get('out_cooldown_s', 0):.1f}s / "
                 f"in {last.get('in_cooldown_s', 0):.1f}s")
+    if transport and (transport.get("negotiated") or transport.get("shm")):
+        # the data plane (transport/stats.py): which wire formats this
+        # process's connections negotiated + shm ring traffic/fallbacks
+        lines.append("")
+        conns = transport.get("connections", {})
+        neg = transport.get("negotiated", {})
+        parts = [f"{fmt}:{neg.get(fmt, 0)}"
+                 f"({conns.get(fmt, 0)} open)" for fmt in sorted(neg)]
+        lines.append("TRANSPORT negotiated " + (" ".join(parts) or "—"))
+        frames = transport.get("frames", {})
+        nbytes = transport.get("bytes", {})
+        if frames:
+            lines.append(f"  {'plane':<14} {'frames':>10} {'MB':>10}")
+            for key in sorted(frames):
+                lines.append(f"  {key:<14} {frames[key]:>10d} "
+                             f"{nbytes.get(key, 0) / 1e6:>10.2f}")
+        shm = transport.get("shm", {})
+        if shm:
+            lines.append(
+                f"  shm: writes={shm.get('slot_writes', 0)} "
+                f"reclaimed={shm.get('reclaimed_slots', 0)} "
+                f"full-fallbacks={shm.get('fallback_full', 0)} "
+                f"oversize={shm.get('fallback_oversize', 0)} "
+                f"segments={shm.get('segments_created', 0)}c/"
+                f"{shm.get('segments_attached', 0)}a/"
+                f"{shm.get('segments_closed', 0)}x")
     for plan in placement or []:
         lines.append("")
         lines.append(f"PLACEMENT [{plan.get('pipeline', '?')}] "

@@ -14,6 +14,8 @@ from ..core import Buffer, Caps, parse_caps_string
 from ..core.serialize import pack_tensors, unpack_tensors
 from ..obs import context as obs_context
 from ..utils.log import logger
+from .. import transport
+from ..transport import stats as wire_stats
 from .protocol import MsgType, check_connect_fault, recv_msg, send_msg
 
 
@@ -36,7 +38,8 @@ class RemoteError(RuntimeError):
 
 
 class QueryClient:
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 wire: str = "auto", shm: bool = True):
         self.host, self.port = host, port
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
@@ -47,6 +50,21 @@ class QueryClient:
         self._running = threading.Event()
         self.connected = False
         self._clean_eos = False
+        # data-plane negotiation (transport/frame.py). ``wire``:
+        #   "auto" — offer binary+json, use what the server selects
+        #   "json" — legacy NNST frames only, no wire structure offered
+        # ``shm`` additionally offers the same-host shared-memory ring;
+        # it only activates when the server proves it shares our boot id.
+        if wire not in ("auto", "json"):
+            raise ValueError(f"wire must be 'auto' or 'json', not {wire!r}")
+        self._wire_mode = wire
+        self._shm_wanted = shm
+        self.wire_format = transport.FORMAT_JSON  # until negotiated
+        self.shm_active = False
+        self._ring = None          # our c2s ring (we create, server attaches)
+        self._peer_rings = {}      # name -> attached s2c ring(s) of the server
+        self._ring_lock = threading.Lock()
+        self._stats_open = False
 
     def connect(self, caps: Caps) -> Caps:
         """TCP connect + caps handshake; returns the server's caps
@@ -61,7 +79,17 @@ class QueryClient:
                                         daemon=True)
         self._reader.start()
         try:
-            send_msg(self._sock, MsgType.CAPABILITY, str(caps).encode())
+            offer = str(caps)
+            if self._wire_mode == "auto":
+                # ride the wire offer on the existing CAPABILITY payload:
+                # an old server's any-pair caps intersection still matches
+                # the tensor structure and simply never echoes a selection
+                # — the JSON fallback needs no second round trip
+                offer = transport.offer_caps(
+                    offer,
+                    shm_host=(transport.same_host_token()
+                              if self._shm_wanted else None))
+            send_msg(self._sock, MsgType.CAPABILITY, offer.encode())
             if not self._caps_event.wait(self.timeout):
                 raise TimeoutError("tensor-query caps handshake timed out")
             if self.server_caps is None:
@@ -72,6 +100,8 @@ class QueryClient:
             self.close()
             raise
         self.connected = True
+        wire_stats.note_connection(self.wire_format)
+        self._stats_open = True
         return self.server_caps
 
     def _read_loop(self) -> None:
@@ -82,7 +112,21 @@ class QueryClient:
                     break
                 msg_type, payload = msg
                 if msg_type is MsgType.CAPABILITY:
-                    self.server_caps = parse_caps_string(payload.decode())
+                    caps, wire = transport.split_wire_caps(
+                        parse_caps_string(payload.decode()))
+                    if wire is not None and self._wire_mode == "auto":
+                        sel = wire.get("selected")
+                        if str(sel) in (transport.FORMAT_BINARY,
+                                        transport.FORMAT_JSON):
+                            self.wire_format = str(sel)
+                        if str(wire.get("shm", "")) == "1":
+                            # server proved same host: create our c2s ring
+                            # up front so send() never blocks on setup
+                            with self._ring_lock:
+                                if self._ring is None:
+                                    self._ring = transport.create_ring()
+                            self.shm_active = True
+                    self.server_caps = caps
                     self._caps_event.set()
                 elif msg_type is MsgType.ERROR:
                     text = payload.decode()
@@ -96,21 +140,69 @@ class QueryClient:
                         # shed) — deliver it to the answer waiter
                         self.responses.put(RemoteError(text))
                 elif msg_type is MsgType.DATA:
-                    self.responses.put(unpack_tensors(payload))
+                    self.responses.put(self._decode_data(payload))
                 elif msg_type is MsgType.EOS:
                     self._clean_eos = True
                     self.responses.put(None)
         except (ConnectionError, OSError) as e:
+            # TornFrameError lands here too: a link cut mid-frame is a
+            # typed disconnect, never a silent hang or a fake clean EOS
             logger.info("tensor-query connection closed: %s", e)
+        except transport.FrameError as e:
+            logger.error("tensor-query frame rejected, dropping link: %s", e)
         finally:
             self.connected = False
             # unblock any waiter: None = clean end, DISCONNECTED = link died
             self.responses.put(None if self._clean_eos else DISCONNECTED)
 
+    def _decode_data(self, payload: bytes) -> Buffer:
+        """Sniff-decode one inbound DATA payload: shm descriptor →
+        binary frame → legacy NNST, by magic — a mixed fleet (old server,
+        new client or vice versa) can never misparse a frame."""
+        if transport.is_shm_descriptor(payload):
+            name, slot, gen, nbytes = transport.unpack_descriptor(payload)
+            with self._ring_lock:
+                ring = self._peer_rings.get(name)
+                if ring is None:
+                    ring = transport.attach_ring(name)
+                    self._peer_rings[name] = ring
+            wire_stats.note_frame("shm", "rx", nbytes)
+            return ring.read_frame(slot, gen, nbytes)
+        if transport.is_binary_frame(payload):
+            wire_stats.note_frame(transport.FORMAT_BINARY, "rx", len(payload))
+            return transport.decode_frame(payload, copy=False)
+        wire_stats.note_frame(transport.FORMAT_JSON, "rx", len(payload))
+        return unpack_tensors(payload)
+
     def send(self, buf: Buffer) -> None:
         if self._sock is None:
             raise ConnectionError("tensor-query client not connected")
-        send_msg(self._sock, MsgType.DATA, pack_tensors(buf.as_numpy()))
+        if self.wire_format == transport.FORMAT_BINARY:
+            try:
+                parts = transport.encode_frame(buf.as_numpy())
+            except transport.FrameError:
+                # unencodable outlier (rank > 8): this one frame rides
+                # the NNST fallback; the connection stays binary
+                payload = pack_tensors(buf.as_numpy())
+                wire_stats.note_frame(
+                    transport.FORMAT_JSON, "tx", len(payload))
+                send_msg(self._sock, MsgType.DATA, payload)
+                return
+            nbytes = transport.frame_nbytes(parts)
+            if self.shm_active and self._ring is not None:
+                desc = self._ring.write_frame(parts)
+                if desc is not None:
+                    # only the ~50-byte descriptor crosses the socket
+                    wire_stats.note_frame("shm", "tx", nbytes)
+                    send_msg(self._sock, MsgType.DATA, desc)
+                    return
+                # ring full / frame oversize: inline binary fallback
+            wire_stats.note_frame(transport.FORMAT_BINARY, "tx", nbytes)
+            send_msg(self._sock, MsgType.DATA, parts)
+            return
+        payload = pack_tensors(buf.as_numpy())
+        wire_stats.note_frame(transport.FORMAT_JSON, "tx", len(payload))
+        send_msg(self._sock, MsgType.DATA, payload)
 
     def request(self, buf: Buffer, timeout: float) -> Buffer:
         """Blocking call: send one frame, wait for ITS answer (the link is
@@ -172,3 +264,18 @@ class QueryClient:
         if self._reader is not None:
             self._reader.join(timeout=2.0)
             self._reader = None
+        with self._ring_lock:
+            ring, self._ring = self._ring, None
+            peers, self._peer_rings = dict(self._peer_rings), {}
+        if ring is not None:
+            # our c2s ring: reclaim slots the (possibly dead) server
+            # still held in flight, then unlink — the generation bump
+            # turns any descriptor it already sent into a typed stale
+            ring.reclaim()
+            transport.detach_ring(ring)
+        for peer in peers.values():
+            transport.detach_ring(peer)
+        self.shm_active = False
+        if self._stats_open:
+            self._stats_open = False
+            wire_stats.drop_connection(self.wire_format)

@@ -155,6 +155,14 @@ class TensorQueryClient(Element):
         "dest_port": Prop(0, int,
                           "server port (reference dest-port; overrides "
                           "port when set)"),
+        "wire": Prop("auto", str,
+                     "data plane: auto = negotiate the NNSB binary wire "
+                     "(falling back to json for old servers), json = "
+                     "force legacy NNST frames (docs/transport.md)"),
+        "shm": Prop(True, prop_bool,
+                    "with wire=auto, also offer the same-host shared-"
+                    "memory ring (only activates when the server proves "
+                    "it shares this host's /dev/shm)"),
     }
 
     def __init__(self, name=None, **props):
@@ -184,7 +192,8 @@ class TensorQueryClient(Element):
             host, port = discover(host, port, _hybrid_topic(self),
                                   self.props["timeout"],
                                   abort=self._stopping)
-        return QueryClient(host, port, self.props["timeout"])
+        return QueryClient(host, port, self.props["timeout"],
+                           wire=self.props["wire"], shm=self.props["shm"])
 
     def set_caps(self, pad: Pad, caps: Caps) -> None:
         self._in_caps = caps

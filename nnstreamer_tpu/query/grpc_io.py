@@ -52,6 +52,7 @@ from ..core.tensors import TensorSpec
 from ..registry.elements import register_element
 from ..runtime.element import ElementError, Prop, SinkElement, SourceElement, prop_bool
 from ..runtime.pad import PadDirection, PadTemplate
+from ..transport.frame import owning_message, owning_tagged
 from ..utils.log import logger
 
 _TENSOR_CAPS = Caps.new("other/tensors")
@@ -206,10 +207,10 @@ class GrpcTensorService:
                                   "server pipeline has no negotiated caps yet")
                 yield b"C" + str(self._out_caps).encode()
                 for item in _drain(q, context):
-                    # join gathers the tag + memoryview frame in ONE copy
-                    # (grpc needs an owning message anyway); the old
+                    # owning_tagged gathers tag + memoryview frame in ONE
+                    # copy (grpc needs an owning message anyway); the old
                     # ``b"D" + bytes(item)`` paid two
-                    yield b"E" if item is None else b"".join((b"D", item))
+                    yield b"E" if item is None else owning_tagged(b"D", item)
             finally:
                 _unregister_sub(q, "own")
 
@@ -248,12 +249,13 @@ class GrpcTensorService:
                     for item in _drain(q, context):
                         if item is None:
                             return  # EOS = end of stream (reference)
-                        # nnlint: disable=NNL405 — grpc requires an owning
-                        # immutable message object; items here are codec
-                        # bytes (already owning) or a pack_tensors
-                        # memoryview whose backing scratch is reused —
-                        # this copy is the ownership transfer, not waste
-                        yield bytes(item)
+                        # grpc requires an owning immutable message;
+                        # owning_message passes already-owning codec
+                        # bytes through untouched and pays exactly ONE
+                        # gather-copy for a borrowed pack_tensors view
+                        # (the old unconditional bytes(item) re-copied
+                        # the owning case too)
+                        yield owning_message(item)
                 finally:
                     _unregister_sub(q, idl)
 
@@ -420,7 +422,9 @@ class GrpcTensorClient:
         if self._idl in _EXT_IDL:
             self._send_q.put(_buffer_to_ext(self._idl, buf, self._send_info))
         else:
-            self._send_q.put(b"D" + bytes(pack_tensors(buf)))
+            # one gather-copy into the owning grpc message (the old
+            # ``b"D" + bytes(...)`` materialized the frame twice)
+            self._send_q.put(owning_tagged(b"D", pack_tensors(buf)))
 
     def finish_send(self, timeout: float = 10.0) -> None:
         if self._idl not in _EXT_IDL:

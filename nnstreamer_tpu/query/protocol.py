@@ -26,6 +26,7 @@ from __future__ import annotations
 import enum
 import socket
 import struct
+import sys as _sys
 from typing import Optional, Tuple
 
 MAGIC = b"NNSQ"
@@ -38,6 +39,14 @@ class MsgType(enum.IntEnum):
     DATA = 2
     EOS = 3
     ERROR = 4
+
+
+class TornFrameError(ConnectionError):
+    """The peer vanished MID-frame: bytes arrived, then EOF before the
+    frame completed. Distinct from a clean EOF between frames (recv_msg
+    → None) — the old path returned None for both, so a connection cut
+    during a payload read parsed as an orderly end-of-stream and the
+    half-frame was silently dropped."""
 
 
 # -- chaos hooks -------------------------------------------------------------
@@ -65,37 +74,64 @@ def check_connect_fault(host: str, port: int) -> None:
 
 
 def send_msg(sock: socket.socket, msg_type: MsgType, payload=b"") -> None:
-    """Send one frame; accepts bytes or a memoryview payload. Header and
-    payload go out as ONE scatter-gather ``sendmsg`` — one syscall, and a
-    memoryview from ``pack_tensors`` is never copied into a concatenated
-    bytes object (the old small-payload path paid one ``bytes(payload)``
-    copy per frame; NNL405's finding)."""
+    """Send one frame; the payload may be bytes, a memoryview, or a LIST
+    of scatter-gather parts (transport/frame.py's ``encode_frame``
+    output). Header and every part go out as ONE ``sendmsg`` — one
+    syscall, and neither a ``pack_tensors`` memoryview nor a binary
+    frame's borrowed tensor views are ever copied into a concatenated
+    bytes object (NNL405's contract)."""
     hook = _send_fault_hook
     if hook is not None:
         hook(sock, msg_type)
-    header = _HEADER.pack(MAGIC, int(msg_type), len(payload))
-    if not payload:
+    if isinstance(payload, (list, tuple)):
+        parts = [memoryview(p).cast("B") for p in payload]
+    elif payload:
+        parts = [memoryview(payload).cast("B")]
+    else:
+        parts = []
+    total = sum(p.nbytes for p in parts)
+    header = _HEADER.pack(MAGIC, int(msg_type), total)
+    _note_socket_bytes(_HEADER.size + total)
+    if not parts:
         sock.sendall(header)
         return
-    if not hasattr(sock, "sendmsg"):  # non-POSIX socket object (tests'
-        sock.sendall(header)          # fakes): two writes, still no copy
-        sock.sendall(payload)
+    if not hasattr(sock, "sendmsg") or len(parts) >= 512:
+        # non-POSIX socket objects (tests' fakes) and frames near the
+        # IOV_MAX gather limit: sequential writes, still no copy
+        sock.sendall(header)
+        for p in parts:
+            sock.sendall(p)
         return
-    sent = sock.sendmsg([header, payload])
-    total = len(header) + len(payload)
-    if sent < total:
+    bufs = [header, *parts]
+    sent = sock.sendmsg(bufs)
+    if sent < len(header) + total:
         # rare partial gather-write (tiny socket buffer): stitch the
         # remainder with plain sendalls — cold path, correctness only
-        if sent < len(header):
-            sock.sendall(header[sent:])
-            sock.sendall(payload)
-        else:
-            sock.sendall(memoryview(payload)[sent - len(header):])
+        for b in bufs:
+            mv = memoryview(b).cast("B")
+            if sent >= mv.nbytes:
+                sent -= mv.nbytes
+                continue
+            sock.sendall(mv[sent:])
+            sent = 0
+
+
+def _note_socket_bytes(nbytes: int) -> None:
+    """NNS_XFERCHECK ledger of bytes that actually HIT the socket
+    (stage ``wire:socket``) — the shm path's zero-payload-over-TCP
+    assertion diffs this against the codec stages. sys.modules lookup,
+    not an import: one dict-get when the sanitizer is off."""
+    _san = _sys.modules.get("nnstreamer_tpu.analysis.sanitizer")
+    if _san is not None and _san.XFER:
+        _san.note_transfer("wire:socket", "host", nbytes)
 
 
 def recv_msg(sock: socket.socket) -> Optional[Tuple[MsgType, bytes]]:
-    """Blocking read of one frame; None on clean EOF."""
-    header = _recv_exact(sock, _HEADER.size)
+    """Blocking read of one frame. None ONLY on a clean EOF between
+    frames; a connection that dies mid-header or mid-payload raises
+    :class:`TornFrameError` (it used to read as a clean EOS, silently
+    dropping the half-frame)."""
+    header = _recv_exact(sock, _HEADER.size, "frame header")
     if header is None:
         return None
     magic, msg_type, length = _HEADER.unpack(header)
@@ -103,19 +139,28 @@ def recv_msg(sock: socket.socket) -> Optional[Tuple[MsgType, bytes]]:
         raise ConnectionError("bad tensor-query frame magic")
     if length > MAX_PAYLOAD:
         raise ConnectionError(f"oversized tensor-query payload ({length} bytes)")
-    payload = _recv_exact(sock, length) if length else b""
-    if length and payload is None:
-        return None
+    payload = b""
+    if length:
+        payload = _recv_exact(sock, length, "payload")
+        if payload is None:  # 0 of `length` bytes then EOF: torn too
+            raise TornFrameError(
+                f"connection closed before any of a {length}-byte payload")
     return MsgType(msg_type), payload
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _recv_exact(sock: socket.socket, n: int, what: str) -> Optional[bytes]:
+    """Read exactly ``n`` bytes. None on EOF at a frame boundary (zero
+    bytes read); :class:`TornFrameError` on EOF after a partial read."""
     chunks = []
     remaining = n
     while remaining:
         chunk = sock.recv(min(remaining, 1 << 20))
         if not chunk:
-            return None
+            if not chunks:
+                return None
+            got = n - remaining
+            raise TornFrameError(
+                f"connection closed mid-{what}: {got} of {n} bytes")
         chunks.append(chunk)
         remaining -= len(chunk)
     return b"".join(chunks)

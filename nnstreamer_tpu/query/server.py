@@ -21,6 +21,8 @@ from ..obs import context as obs_context
 from ..obs import profile as obs_profile
 from ..utils.log import logger
 from ..utils.threads import ThreadRegistry
+from .. import transport
+from ..transport import stats as wire_stats
 from .protocol import MsgType, recv_msg, send_msg
 
 #: the request series a served query records under (obs/profile.py) —
@@ -89,6 +91,14 @@ class QueryServer:
         self.inbox: _queue.Queue = _queue.Queue()
         self._clients: Dict[int, socket.socket] = {}
         self._client_caps: Dict[int, Caps] = {}
+        # negotiated data plane per client (transport/frame.py): wire
+        # format selected at handshake, whether the same-host shm ring is
+        # on, our lazily-created s2c ring, and the client's c2s rings we
+        # attached (by segment name). All guarded-by: _lock.
+        self._client_wire: Dict[int, str] = {}
+        self._client_shm: Dict[int, bool] = {}
+        self._client_ring_out: Dict[int, transport.ShmRing] = {}
+        self._client_rings_in: Dict[int, Dict[str, transport.ShmRing]] = {}
         self._next_id = 0
         self._lock = threading.Lock()
         self._running = threading.Event()
@@ -294,11 +304,28 @@ class QueryServer:
                     break
                 msg_type, payload = msg
                 if msg_type is MsgType.CAPABILITY:
-                    caps = parse_caps_string(payload.decode())
+                    # strip the wire-negotiation structure BEFORE the
+                    # accept gate: an accept_caps that pattern-matches
+                    # tensor structures must never see (or veto) it
+                    caps, wire = transport.split_wire_caps(
+                        parse_caps_string(payload.decode()))
                     ok = self.accept_caps(caps) if self.accept_caps else True
                     if ok:
                         self._client_caps[client_id] = caps
                         reply = str(self.caps) if self.caps else str(caps)
+                        fmt = transport.FORMAT_JSON
+                        shm_ok = False
+                        if wire is not None:
+                            offered = transport.offered_formats(wire)
+                            if transport.FORMAT_BINARY in offered:
+                                fmt = transport.FORMAT_BINARY
+                            shm_ok = (str(wire.get("shmhost", ""))
+                                      == transport.same_host_token())
+                            reply = transport.reply_caps(reply, fmt, shm_ok)
+                        with self._lock:
+                            self._client_wire[client_id] = fmt
+                            self._client_shm[client_id] = shm_ok
+                        wire_stats.note_connection(fmt)
                         send_msg(conn, MsgType.CAPABILITY, reply.encode())
                         conn.settimeout(None)  # handshake done: stream freely
                     else:
@@ -314,7 +341,7 @@ class QueryServer:
                             "dropping a frame from client %d",
                             self.port, limit, client_id)
                         continue
-                    buf = unpack_tensors(payload)
+                    buf = self._decode_data(client_id, payload)
                     buf.meta["client_id"] = client_id
                     track = self._inflight.get(client_id)
                     if track is not None:
@@ -348,19 +375,57 @@ class QueryServer:
                 elif msg_type is MsgType.EOS:
                     self.inbox.put(("eos", client_id))
         except (ConnectionError, OSError) as e:
+            # TornFrameError lands here: a client cut mid-frame is a
+            # typed disconnect on this worker only, never a hang
             logger.info("query server client %d dropped: %s", client_id, e)
+        except transport.FrameError as e:
+            logger.error("query server client %d sent a bad frame, "
+                         "dropping it: %s", client_id, e)
         finally:
             with self._lock:
                 self._clients.pop(client_id, None)
                 self._client_caps.pop(client_id, None)
                 track = self._inflight.pop(client_id, None)
+                fmt = self._client_wire.pop(client_id, None)
+                self._client_shm.pop(client_id, None)
+                ring_out = self._client_ring_out.pop(client_id, None)
+                rings_in = self._client_rings_in.pop(client_id, {})
             for _idx, _t0, span in (track.marks if track else ()):
                 if span is not None:  # unanswered at disconnect
                     span.end("error:client-dropped")
+            if ring_out is not None:
+                # our s2c ring: reclaim slots the departed client never
+                # released (generation bump retires its descriptors too)
+                ring_out.reclaim()
+                transport.detach_ring(ring_out)
+            for r in rings_in.values():
+                transport.detach_ring(r)
+            if fmt is not None:
+                wire_stats.drop_connection(fmt)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _decode_data(self, client_id: int, payload: bytes) -> Buffer:
+        """Sniff-decode one inbound DATA payload: shm descriptor →
+        binary frame → legacy NNST, by magic, independent of what the
+        handshake negotiated (a client may fall back per frame)."""
+        if transport.is_shm_descriptor(payload):
+            name, slot, gen, nbytes = transport.unpack_descriptor(payload)
+            with self._lock:
+                rings = self._client_rings_in.setdefault(client_id, {})
+                ring = rings.get(name)
+                if ring is None:
+                    ring = transport.attach_ring(name)
+                    rings[name] = ring
+            wire_stats.note_frame("shm", "rx", nbytes)
+            return ring.read_frame(slot, gen, nbytes)
+        if transport.is_binary_frame(payload):
+            wire_stats.note_frame(transport.FORMAT_BINARY, "rx", len(payload))
+            return transport.decode_frame(payload, copy=False)
+        wire_stats.note_frame(transport.FORMAT_JSON, "rx", len(payload))
+        return unpack_tensors(payload)
 
     # -- answer routing -----------------------------------------------------
     def _pop_mark_locked(self, client_id: int,
@@ -403,6 +468,45 @@ class QueryServer:
                 stale.append(m[2])
         return mark, stale
 
+    def _encode_answer(self, client_id: int, out: Buffer):
+        """Encode one outbound answer on the client's negotiated plane:
+        shm descriptor when the same-host ring is on and has a free
+        slot, else inline binary scatter-gather parts, else NNST."""
+        with self._lock:
+            fmt = self._client_wire.get(client_id, transport.FORMAT_JSON)
+            shm_ok = self._client_shm.get(client_id, False)
+            ring = self._client_ring_out.get(client_id)
+        if fmt != transport.FORMAT_BINARY:
+            payload = pack_tensors(out)
+            wire_stats.note_frame(transport.FORMAT_JSON, "tx", len(payload))
+            return payload
+        try:
+            parts = transport.encode_frame(out)
+        except transport.FrameError:
+            payload = pack_tensors(out)  # rank-8+ outlier: NNST fallback
+            wire_stats.note_frame(transport.FORMAT_JSON, "tx", len(payload))
+            return payload
+        nbytes = transport.frame_nbytes(parts)
+        if shm_ok:
+            if ring is None:
+                # first answer to this shm client: create our s2c ring
+                ring = transport.create_ring(
+                    name=transport.ring_name(f"s{self.port}c{client_id}"))
+                with self._lock:
+                    if client_id in self._client_wire:
+                        self._client_ring_out[client_id] = ring
+                    else:  # client vanished while we built it
+                        transport.detach_ring(ring)
+                        ring = None
+            if ring is not None:
+                desc = ring.write_frame(parts)
+                if desc is not None:
+                    wire_stats.note_frame("shm", "tx", nbytes)
+                    return desc
+                # ring full / oversize answer: inline binary fallback
+        wire_stats.note_frame(transport.FORMAT_BINARY, "tx", nbytes)
+        return parts
+
     def send(self, client_id: int, buf: Buffer,
              mark_idx: Optional[int] = None) -> bool:
         with self._lock:
@@ -420,7 +524,7 @@ class QueryServer:
         out = buf.with_tensors(buf.as_numpy().tensors)
         out.meta = meta
         try:
-            send_msg(conn, MsgType.DATA, pack_tensors(out))
+            send_msg(conn, MsgType.DATA, self._encode_answer(client_id, out))
             ok = True
         except OSError:
             ok = False

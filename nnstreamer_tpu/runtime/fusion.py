@@ -249,6 +249,12 @@ class FusedSegment:
         # at plan/replan time via set_device, consumed at _build — the
         # steady-state dispatch path never looks at it.
         self._device = None      # guarded-by: _lock
+        # double-buffered host→device staging for PLACED segments only
+        # (transport/staging.py): built lazily on the first placed
+        # dispatch that sees host inputs; the default-device path —
+        # where the jitted call's own argument conversion is the fastest
+        # H2D — never builds one
+        self._stager = None      # guarded-by: _lock (reads racy-ok)
         # calibration hook: placement installs a per-dispatch probe while
         # a calibration window is open; cleared when the plan lands. Only
         # consulted under obs_profile.ACTIVE (calibration keeps recording
@@ -332,11 +338,27 @@ class FusedSegment:
             self._gen += 1
             self._call = None
             self._defused = False
+            stager = self._stager
+        if stager is not None:
+            # staged slots live on the OLD chip: drop them and follow
+            stager.retarget(device)
 
     @property
     def device(self):
         """The planner-assigned chip (None = jax default device)."""
         return self._device
+
+    def _stage_placed(self, tensors):
+        """Host→device staging for a placed dispatch (see dispatch())."""
+        from ..transport.staging import DoubleBufferedStager
+
+        s = self._stager
+        if s is None:
+            with self._lock:
+                s = self._stager
+                if s is None:
+                    s = self._stager = DoubleBufferedStager(self._device)
+        return s.stage(tensors)
 
     def _aot_resolve(self, composed: Callable, example_args: tuple,
                      pipe) -> Optional[Callable]:
@@ -527,6 +549,15 @@ class FusedSegment:
         for gate in self._gates:
             if not gate(buf):
                 return True  # dropped (QoS throttle), buffer consumed
+        args = tuple(buf.tensors)
+        if self._device is not None and \
+                any(not hasattr(t, "addressable_shards") for t in args):
+            # placement-pinned segment with host inputs: ride the
+            # two-slot stager so frame N+1's async put overlaps frame
+            # N's device compute (transport/staging.py). Default-device
+            # segments skip this — the jitted call's own C++ argument
+            # conversion is the faster H2D there.
+            args = tuple(self._stage_placed(args))
         t0 = clock_now()
         try:
             # NNS_XFERCHECK: the fused region is a pure-jit dispatch —
@@ -534,7 +565,7 @@ class FusedSegment:
             # (the zero-copy contract's sentinel scope; a no-op module-
             # global check when the sanitizer is off)
             with _san.no_implicit_d2h(f"fused:{self.name}"):
-                outs = call(tuple(buf.tensors))
+                outs = call(args)
         except Exception as e:
             # an allocation failure must land in the flight ring WITH the
             # owning stage's name before the error path erases the context

@@ -274,6 +274,12 @@ def _make_handler(manager: ServiceManager):
                 from ..obs import memory as obs_memory
 
                 return {"memory": obs_memory.snapshot()}
+            if parts == ["transport"] and method == "GET":
+                from ..transport import stats as wire_stats
+
+                # the data-plane block: negotiated wire formats, frame/
+                # byte tallies, shm ring traffic (docs/transport.md)
+                return {"transport": wire_stats.snapshot()}
             if parts == ["quality"] and method == "GET":
                 from ..obs import quality as obs_quality
 
@@ -510,6 +516,11 @@ class ControlClient:
     def memory(self) -> dict:
         """GET /memory — the device-memory accounting snapshot."""
         return self._call("GET", "/memory")
+
+    def transport(self) -> dict:
+        """GET /transport — the data-plane snapshot: negotiated wire
+        formats, per-format frame/byte tallies, shm ring traffic."""
+        return self._call("GET", "/transport")
 
     def quality(self, raw: bool = False) -> dict:
         """GET /quality — the data-plane quality snapshot (per-edge

@@ -144,12 +144,19 @@ class Replica:
         self.stats = {"requests": 0, "failures": 0, "evictions": 0,
                       "readmissions": 0}       # guarded-by: ReplicaPool._lock
         self.link: Optional[_Link] = None      # set once at add time
+        # the data plane the LAST successful dial negotiated with this
+        # replica ("binary"/"json", "+shm" when the same-host ring is
+        # on; None until first dial) — surfaced in pool snapshots and
+        # the obs fleet view. Written by _Link._dial without the pool
+        # lock: a stale read only mislabels a replica mid-redial.
+        self.wire_format: Optional[str] = None
 
     def snapshot_locked(self) -> dict:
         # caller holds the pool lock
         return {"id": self.id, "state": self.state.value,
                 "score": round(self.score, 3), "inflight": self.inflight,
                 "consecutive_failures": self.consecutive_failures,
+                "wire": self.wire_format,
                 **self.stats}
 
 
@@ -173,8 +180,11 @@ class _Link:
         host, port = self._replica.resolver()
         budget = max(0.05, min(self._pool.connect_timeout,
                                deadline - time.monotonic()))
-        client = QueryClient(host, port, timeout=budget)
+        client = QueryClient(host, port, timeout=budget,
+                             wire=self._pool.wire, shm=self._pool.shm)
         client.connect(self._pool.caps)
+        self._replica.wire_format = (
+            client.wire_format + ("+shm" if client.shm_active else ""))
         return client
 
     def call(self, buf: Buffer, deadline: float) -> Buffer:
@@ -253,7 +263,9 @@ class ReplicaPool:
                  quarantine_base_s: float = 0.25,
                  quarantine_max_s: float = 5.0,
                  connect_timeout: float = 2.0,
-                 health_poll_s: float = 0.1):
+                 health_poll_s: float = 0.1,
+                 wire: str = "auto",
+                 shm: bool = True):
         if load_factor < 1.0:
             raise ValueError(f"load_factor {load_factor} must be >= 1")
         self.name = name
@@ -268,6 +280,12 @@ class ReplicaPool:
         self.quarantine_max_s = quarantine_max_s
         self.connect_timeout = connect_timeout
         self.health_poll_s = health_poll_s
+        # data-plane policy for every link this pool dials: "auto"
+        # negotiates the binary wire (and, with shm=True, the same-host
+        # shared-memory ring) per connection; "json" forces the legacy
+        # NNST frames (transport/frame.py, docs/transport.md)
+        self.wire = wire
+        self.shm = shm
         self._lock = named_lock(f"ReplicaPool._lock:{name}")
         # readmissions / in-flight completions wake blocked routers
         self._cond = named_condition(f"ReplicaPool._cond:{name}", self._lock)

@@ -353,8 +353,13 @@ class Scheduler:
         for r, outs in zip(batch.requests, batch.split_outputs(outputs)):
             r.metrics["device_time_s"] = device_s
             r.metrics["ttft_s"] = now - r.metrics["enqueue_time"]
-            r.complete(outs)
+            r.metrics.setdefault("total_latency_s",
+                                 now - r.metrics["enqueue_time"])
+            # record BEFORE complete(): complete() releases the waiter
+            # (and the query-bridge answer), so a client must never see
+            # its answer while the completed counter still excludes it
             self._record_done(r)
+            r.complete(outs)
         # these clients just got results — closed-loop traffic resubmits
         # within the next max-wait window, so hold the idle-boundary
         # flush until that many rows land (or the window lapses) rather

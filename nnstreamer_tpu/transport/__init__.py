@@ -1,0 +1,45 @@
+"""Zero-copy data plane (L5): binary wire format, shared-memory replica
+transport, double-buffered device staging.
+
+Three legs, one contract — frames move by reference until a process or
+device boundary forces exactly one accounted copy:
+
+* :mod:`.frame` — the NNSB binary wire codec (fixed header + tensor
+  table + compact meta sidecar) negotiated per connection during the
+  query CAPABILITY handshake; JSON/NNST stays the fallback for old
+  peers, and receive paths sniff the frame magic so a mixed fleet
+  interoperates.
+* :mod:`.shm` — single-writer slot rings in ``multiprocessing.
+  shared_memory`` for same-host peers: tensors land in shm, only slot
+  descriptors cross the socket, generation counters make peer death
+  recoverable.
+* :mod:`.staging` — the two-slot host→device staging pipeline behind
+  pinned-device backend invokes and placement-pinned fused dispatches.
+* :mod:`.stats` — the counters the ``nns_wire_*`` / ``nns_shm_*``
+  metrics and the ``obs top`` TRANSPORT section render.
+
+Enforcement lives one layer down: NNL405 lints every byte copy in this
+package, NNL3xx checks the ring attach/detach pairs, and the
+``NNS_XFERCHECK``/``NNS_LEAKCHECK`` sanitizers ledger the same
+contracts at runtime (docs/transport.md).
+"""
+from . import stats
+from .frame import (FORMAT_BINARY, FORMAT_JSON, FrameError, WIRE_MIME,
+                    decode_frame, encode_frame, encode_frame_bytes,
+                    frame_nbytes, gather_parts, is_binary_frame,
+                    offer_caps, offered_formats, owning_message,
+                    owning_tagged, reply_caps, split_wire_caps)
+from .shm import (ShmRing, attach_ring, create_ring, detach_ring,
+                  is_shm_descriptor, pack_descriptor, ring_name,
+                  same_host_token, unpack_descriptor)
+from .staging import DoubleBufferedStager
+
+__all__ = [
+    "FORMAT_BINARY", "FORMAT_JSON", "FrameError", "WIRE_MIME",
+    "decode_frame", "encode_frame", "encode_frame_bytes", "frame_nbytes",
+    "gather_parts", "is_binary_frame", "offer_caps", "offered_formats",
+    "owning_message", "owning_tagged", "reply_caps", "split_wire_caps",
+    "ShmRing", "attach_ring", "create_ring", "detach_ring",
+    "is_shm_descriptor", "pack_descriptor", "ring_name",
+    "same_host_token", "unpack_descriptor", "DoubleBufferedStager", "stats",
+]

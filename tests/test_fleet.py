@@ -663,9 +663,20 @@ class TestServeMarks:
             roots = [s for s in obs_ctx.finished_spans()
                      if s.kind == "query" and s.parent_id is None]
             assert roots
-            serve = [s for s in obs_ctx.finished_spans()
-                     if s.kind == "serving"
-                     and s.name.startswith("query.serve")]
+            # the server ends the serve span / records the series AFTER
+            # the answer frame is on the wire, so they land concurrently
+            # with the client's return — wait for them
+            deadline = time.monotonic() + 5.0
+            serve: list = []
+            while time.monotonic() < deadline:
+                serve = [s for s in obs_ctx.finished_spans()
+                         if s.kind == "serving"
+                         and s.name.startswith("query.serve")]
+                ok = obs_profile.default_profiler.request_window(
+                    "serving:query", 3600.0)[1]
+                if serve and ok >= before + 1:
+                    break
+                time.sleep(0.01)
             assert serve
             assert serve[-1].trace_id == roots[-1].trace_id
             _d, ok, _e = obs_profile.default_profiler.request_window(

@@ -1,0 +1,545 @@
+"""Zero-copy data plane (transport/): NNSB codec roundtrips + truncation,
+torn-frame typing at the socket layer (typed error, never a hang), the
+wire-format negotiation matrix incl. a legacy-server JSON fallback, shm
+ring lifecycle (full-ring fallback, reclaim, stale descriptors, unlink),
+byte parity binary-vs-JSON-vs-shm across the fusion parity pipelines,
+and the XFERCHECK proof that the shm path moves only descriptor bytes
+over the socket."""
+import importlib.util
+import os
+import pathlib
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import transport
+from nnstreamer_tpu.analysis import sanitizer
+from nnstreamer_tpu.core import Buffer, parse_caps_string
+from nnstreamer_tpu.core.serialize import pack_tensors, unpack_tensors
+from nnstreamer_tpu.query import protocol
+from nnstreamer_tpu.query.client import QueryClient
+from nnstreamer_tpu.query.protocol import (MsgType, TornFrameError,
+                                           recv_msg, send_msg)
+from nnstreamer_tpu.query.server import QueryServer
+from nnstreamer_tpu.transport.frame import (FrameError, decode_frame,
+                                            encode_frame,
+                                            encode_frame_bytes,
+                                            gather_parts, is_binary_frame,
+                                            owning_message, owning_tagged)
+
+CAPS = "other/tensors,format=static,dimensions=8,types=float32"
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_hooks():
+    """Disarm any protocol fault hooks a prior suite test left behind:
+    net_chaos's send hook does ``sock.getpeername()[1]``, which raises
+    IndexError on the AF_UNIX socketpairs used here."""
+    saved = (protocol._send_fault_hook, protocol._connect_fault_hook)
+    protocol.set_fault_hooks(None, None)
+    yield
+    protocol.set_fault_hooks(*saved)
+
+
+def _rich_buffer():
+    rng = np.random.default_rng(7)
+    return Buffer(
+        [rng.random((2, 3, 4)).astype(np.float32),
+         rng.integers(0, 255, (5,), dtype=np.uint8),
+         rng.integers(-100, 100, (1, 7)).astype(np.int64),
+         np.asarray([3.5], np.float64)],
+        pts=0.125,
+        meta={"client_id": 3, "note": "héllo ∑",
+              "nested": {"k": [1, 2.5, None, True, "x"]},
+              "big": 2**48, "neg": -7},
+    )
+
+
+# ---------------------------------------------------------------------------
+# NNSB codec
+# ---------------------------------------------------------------------------
+
+class TestFrameCodec:
+    def test_dense_roundtrip(self):
+        buf = _rich_buffer()
+        out = decode_frame(encode_frame_bytes(buf))
+        assert len(out.tensors) == len(buf.tensors)
+        for a, b in zip(buf.tensors, out.tensors):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.ascontiguousarray(a).tobytes() == b.tobytes()
+        assert out.pts == buf.pts
+        assert out.meta == buf.meta
+
+    def test_rank0_normalizes_like_nnst(self):
+        # numpy's ascontiguousarray promotes 0-d to (1,); the NNST wire
+        # does the same — parity means matching it, not "fixing" it
+        buf = Buffer([np.asarray(3.5, np.float64)])
+        via_bin = decode_frame(encode_frame_bytes(buf))
+        via_json = unpack_tensors(pack_tensors(buf))
+        assert via_bin.tensors[0].shape == via_json.tensors[0].shape
+        assert via_bin.tensors[0].tobytes() == via_json.tensors[0].tobytes()
+
+    def test_none_pts_and_empty_meta(self):
+        buf = Buffer([np.zeros(4, np.float32)])
+        out = decode_frame(encode_frame_bytes(buf))
+        assert out.pts is None
+        assert out.meta == {}
+
+    def test_parts_are_zero_copy_views(self):
+        arr = np.arange(16, dtype=np.float32)
+        parts = encode_frame(Buffer([arr]))
+        payload = [p for p in parts if p.nbytes == arr.nbytes]
+        assert payload, "tensor payload part missing"
+        # the payload part aliases the array, not a copy
+        arr[0] = 99.0
+        assert np.frombuffer(payload[0], np.float32)[0] == 99.0
+
+    def test_magic_sniff(self):
+        blob = encode_frame_bytes(Buffer([np.zeros(2, np.float32)]))
+        assert is_binary_frame(blob)
+        assert not is_binary_frame(pack_tensors(
+            Buffer([np.zeros(2, np.float32)])))
+        assert not is_binary_frame(b"NN")
+
+    def test_rank_over_8_rejected(self):
+        arr = np.zeros((1,) * 9, np.float32)
+        with pytest.raises(FrameError):
+            encode_frame(Buffer([arr]))
+
+    def test_truncation_is_typed_at_every_cut(self):
+        blob = bytes(encode_frame_bytes(_rich_buffer()))
+        # header cut, table cut, payload cut, meta cut — a sweep across
+        # the whole frame; every torn prefix must be a typed FrameError,
+        # never a struct.error / IndexError / silent short tensor
+        cuts = {1, 4, len(blob) // 4, len(blob) // 2, len(blob) - 1}
+        for cut in cuts:
+            with pytest.raises(FrameError):
+                decode_frame(blob[:cut])
+
+    def test_garbage_rejected(self):
+        with pytest.raises(FrameError):
+            decode_frame(b"NNSB" + b"\x00" * 3)  # shorter than header
+        with pytest.raises(FrameError):
+            decode_frame(b"XXXX" + b"\x00" * 64)  # wrong magic
+
+    def test_owning_helpers(self):
+        raw = bytearray(b"abc")
+        owned = owning_message(memoryview(raw))
+        raw[0] = 0x7A
+        assert owned == b"abc"  # snapshot, not alias
+        b = b"already-bytes"
+        assert owning_message(b) is b  # no second copy
+        tagged = owning_tagged(b"D", memoryview(bytearray(b"xy")))
+        assert tagged == b"Dxy"
+
+    def test_gather_parts_matches_bytes_join(self):
+        parts = encode_frame(_rich_buffer())
+        assert bytes(gather_parts(parts)) == bytes(
+            encode_frame_bytes(_rich_buffer()))
+
+
+# ---------------------------------------------------------------------------
+# torn frames at the socket layer — typed, never a hang
+# ---------------------------------------------------------------------------
+
+class TestTornFrames:
+    def test_clean_eof_between_frames_is_none(self):
+        a, b = socket.socketpair()
+        try:
+            send_msg(a, MsgType.EOS)
+            a.close()
+            assert recv_msg(b) == (MsgType.EOS, b"")
+            assert recv_msg(b) is None  # orderly EOS, not an error
+        finally:
+            b.close()
+
+    def test_torn_header_raises(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"NNSQ\x02")  # header cut after 5 of 13 bytes
+            a.close()
+            with pytest.raises(TornFrameError):
+                recv_msg(b)
+        finally:
+            b.close()
+
+    def test_torn_payload_raises(self):
+        a, b = socket.socketpair()
+        try:
+            payload = bytes(encode_frame_bytes(_rich_buffer()))
+            hdr = struct.pack("<4sBQ", b"NNSQ", int(MsgType.DATA),
+                              len(payload))
+            a.sendall(hdr + payload[: len(payload) // 2])
+            a.close()
+            with pytest.raises(TornFrameError):
+                recv_msg(b)
+        finally:
+            b.close()
+
+    def test_zero_byte_payload_eof_raises(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack("<4sBQ", b"NNSQ", int(MsgType.DATA), 64))
+            a.close()  # length promised, zero payload bytes delivered
+            with pytest.raises(TornFrameError):
+                recv_msg(b)
+        finally:
+            b.close()
+
+    def test_server_survives_mid_frame_disconnect(self):
+        """A client cut mid-DATA must neither hang a worker nor poison
+        the accept loop — the next client still handshakes."""
+        srv = QueryServer().start()
+        try:
+            raw = socket.create_connection(("127.0.0.1", srv.port),
+                                           timeout=5)
+            send_msg(raw, MsgType.CAPABILITY, CAPS.encode())
+            assert recv_msg(raw)[0] is MsgType.CAPABILITY
+            raw.sendall(struct.pack("<4sBQ", b"NNSQ",
+                                    int(MsgType.DATA), 4096) + b"x" * 10)
+            raw.close()
+            cli = QueryClient("127.0.0.1", srv.port)
+            cli.connect(parse_caps_string(CAPS))
+            cli.close()
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# negotiation matrix
+# ---------------------------------------------------------------------------
+
+def _echo_pump(srv: QueryServer, stop: threading.Event) -> None:
+    while not stop.is_set():
+        try:
+            item = srv.inbox.get(timeout=0.05)
+        except Exception:
+            continue
+        if isinstance(item, tuple):  # ("eos", cid)
+            continue
+        cid = item.meta.pop("client_id")
+        idx = item.meta.pop("_qserve_idx", None)
+        srv.send(cid, item, mark_idx=idx)
+
+
+class _EchoServer:
+    """QueryServer + a thread echoing inbox items back to their client."""
+
+    def __enter__(self):
+        self.srv = QueryServer().start()
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=_echo_pump,
+                                   args=(self.srv, self._stop), daemon=True)
+        self._t.start()
+        return self.srv
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(timeout=5)
+        self.srv.stop()
+
+
+def _roundtrip(cli: QueryClient, value: float = 2.0) -> Buffer:
+    buf = Buffer([np.full(8, value, np.float32)], meta={"tag": "t"})
+    out = cli.request(buf, timeout=10)
+    assert out is not None and not isinstance(out, Exception)
+    assert np.allclose(np.asarray(out.tensors[0]), value)
+    return out
+
+
+class TestNegotiation:
+    def test_auto_negotiates_binary_and_shm_same_host(self):
+        with _EchoServer() as srv:
+            cli = QueryClient("127.0.0.1", srv.port)
+            try:
+                cli.connect(parse_caps_string(CAPS))
+                assert cli.wire_format == transport.FORMAT_BINARY
+                assert cli.shm_active
+                out = _roundtrip(cli)
+                assert out.meta.get("tag") == "t"
+            finally:
+                cli.close()
+
+    def test_forced_json_stays_json(self):
+        with _EchoServer() as srv:
+            cli = QueryClient("127.0.0.1", srv.port, wire="json")
+            try:
+                cli.connect(parse_caps_string(CAPS))
+                assert cli.wire_format == transport.FORMAT_JSON
+                assert not cli.shm_active
+                _roundtrip(cli, 5.0)
+            finally:
+                cli.close()
+
+    def test_shm_opt_out_keeps_binary_wire(self):
+        with _EchoServer() as srv:
+            cli = QueryClient("127.0.0.1", srv.port, shm=False)
+            try:
+                cli.connect(parse_caps_string(CAPS))
+                assert cli.wire_format == transport.FORMAT_BINARY
+                assert not cli.shm_active
+                _roundtrip(cli, 1.5)
+            finally:
+                cli.close()
+
+    def test_legacy_server_falls_back_to_json(self):
+        """A pre-NNSB server echoes the offered caps string VERBATIM
+        (wire structure included, never a ``selected=``) and speaks only
+        NNST — the auto client must settle on JSON and still roundtrip,
+        with no second handshake round trip."""
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+
+        def legacy():
+            conn, _ = lst.accept()
+            with conn:
+                while True:
+                    msg = recv_msg(conn)
+                    if msg is None:
+                        return
+                    mtype, payload = msg
+                    if mtype is MsgType.CAPABILITY:
+                        # old behavior: parse + re-serialize the caps,
+                        # unknown structures and all — no wire reply
+                        send_msg(conn, MsgType.CAPABILITY,
+                                 str(parse_caps_string(
+                                     payload.decode())).encode())
+                    elif mtype is MsgType.DATA:
+                        buf = unpack_tensors(payload)
+                        send_msg(conn, MsgType.DATA, pack_tensors(buf))
+
+        t = threading.Thread(target=legacy, daemon=True)
+        t.start()
+        cli = QueryClient("127.0.0.1", port)
+        try:
+            cli.connect(parse_caps_string(CAPS))
+            assert cli.wire_format == transport.FORMAT_JSON
+            assert not cli.shm_active
+            _roundtrip(cli, 4.0)
+        finally:
+            cli.close()
+            lst.close()
+            t.join(timeout=5)
+
+    def test_offer_survives_legacy_caps_reserialization(self):
+        """The wire offer rides the caps string through an old peer's
+        parse→str cycle without corrupting the tensor structure."""
+        offered = transport.offer_caps(
+            CAPS, shm_host=transport.same_host_token())
+        caps, wire = transport.split_wire_caps(
+            parse_caps_string(str(parse_caps_string(offered))))
+        assert wire is not None
+        assert transport.FORMAT_BINARY in transport.offered_formats(wire)
+        assert "nns-wire" not in str(caps)
+
+
+# ---------------------------------------------------------------------------
+# shm ring lifecycle
+# ---------------------------------------------------------------------------
+
+class TestShmRing:
+    def test_roundtrip_and_slot_release(self):
+        ring = transport.create_ring(slots=2)  # pairs-with: detach_ring
+        try:
+            buf = _rich_buffer()
+            desc = ring.write_frame(encode_frame(buf))
+            assert desc is not None and transport.is_shm_descriptor(desc)
+            name, slot, gen, nbytes = transport.unpack_descriptor(desc)
+            assert name == ring.name
+            assert ring.in_flight() == 1
+            out = ring.read_frame(slot, gen, nbytes)
+            assert ring.in_flight() == 0  # consumed slot returned
+            for a, b in zip(buf.tensors, out.tensors):
+                assert np.ascontiguousarray(a).tobytes() == b.tobytes()
+            assert out.meta == buf.meta
+        finally:
+            transport.detach_ring(ring)
+
+    def test_full_ring_returns_none_for_inline_fallback(self):
+        ring = transport.create_ring(slots=1)  # pairs-with: detach_ring
+        try:
+            parts = encode_frame(Buffer([np.zeros(4, np.float32)]))
+            assert ring.write_frame(parts) is not None
+            assert ring.write_frame(parts) is None  # full → inline wire
+        finally:
+            transport.detach_ring(ring)
+
+    def test_oversize_frame_returns_none(self):
+        ring = transport.create_ring(slot_bytes=256)  # pairs-with: detach_ring
+        try:
+            parts = encode_frame(Buffer([np.zeros(1024, np.float32)]))
+            assert ring.write_frame(parts) is None
+        finally:
+            transport.detach_ring(ring)
+
+    def test_reclaim_invalidates_outstanding_descriptors(self):
+        ring = transport.create_ring(slots=2)  # pairs-with: detach_ring
+        try:
+            desc = ring.write_frame(
+                encode_frame(Buffer([np.arange(8).astype(np.float32)])))
+            _name, slot, gen, nbytes = transport.unpack_descriptor(desc)
+            assert ring.reclaim() == 1  # peer died holding the slot
+            assert ring.in_flight() == 0
+            with pytest.raises(FrameError):  # stale generation
+                ring.read_frame(slot, gen, nbytes)
+            # the reclaimed slot is immediately writable again
+            assert ring.write_frame(
+                encode_frame(Buffer([np.zeros(2, np.float32)]))) is not None
+        finally:
+            transport.detach_ring(ring)
+
+    def test_close_unlinks_segment(self):
+        ring = transport.create_ring()  # pairs-with: detach_ring
+        seg = pathlib.Path("/dev/shm") / ring.name
+        assert seg.exists()
+        transport.detach_ring(ring)
+        assert not seg.exists()
+        transport.detach_ring(ring)  # idempotent
+
+    def test_attach_sees_writer_frames(self):
+        ring = transport.create_ring()  # pairs-with: detach_ring
+        reader = None
+        try:
+            reader = transport.attach_ring(ring.name)  # pairs-with: detach_ring
+            buf = Buffer([np.arange(6).astype(np.int32)], meta={"n": 1})
+            desc = ring.write_frame(encode_frame(buf))
+            _n, slot, gen, nbytes = transport.unpack_descriptor(desc)
+            out = reader.read_frame(slot, gen, nbytes)
+            assert out.tensors[0].tobytes() == buf.tensors[0].tobytes()
+            assert ring.in_flight() == 0  # release is visible to the writer
+        finally:
+            transport.detach_ring(reader)
+            transport.detach_ring(ring)
+
+    def test_descriptor_sniffs_distinctly(self):
+        desc = transport.pack_descriptor("nns-x", 0, 1, 64)
+        assert transport.is_shm_descriptor(desc)
+        assert not is_binary_frame(desc)
+        assert not transport.is_shm_descriptor(
+            encode_frame_bytes(Buffer([np.zeros(1, np.float32)])))
+
+
+# ---------------------------------------------------------------------------
+# byte parity binary-vs-JSON-vs-shm across the fusion parity pipelines
+# ---------------------------------------------------------------------------
+
+def _load_fusion_module():
+    # tests/ is not a package; import the parity corpus dynamically
+    path = pathlib.Path(__file__).with_name("test_fusion.py")
+    spec = importlib.util.spec_from_file_location("_nns_fusion_corpus", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_FUSION = _load_fusion_module()
+
+
+def _capture_buffers(line):
+    """Run one parity pipeline and grab the real Buffers its sinks see."""
+    pipe = _FUSION.parse_launch(line, fuse=True)
+    grabbed = []
+    for el in pipe.sinks:
+        def render(buf, _el=el):
+            grabbed.append(buf.as_numpy())
+            type(_el).render(_el, buf)
+        el.render = render
+    pipe.run(timeout=40.0)
+    return grabbed
+
+
+def _tensor_sig(buf):
+    return tuple((str(t.dtype), t.shape,
+                  np.ascontiguousarray(t).tobytes()) for t in buf.tensors)
+
+
+@pytest.mark.parametrize("name", sorted(_FUSION.PARITY_LINES))
+def test_wire_parity_across_fusion_pipelines(name):
+    """Every buffer the fusion parity pipelines emit must survive the
+    binary wire, the JSON/NNST wire, and the shm ring byte-identically
+    — the three planes are encodings of ONE frame, not three dialects."""
+    bufs = _capture_buffers(_FUSION.PARITY_LINES[name])
+    assert bufs, f"{name}: pipeline produced no buffers"
+    ring = transport.create_ring(  # pairs-with: detach_ring
+        slot_bytes=max(1 << 20, max(b.nbytes for b in bufs) + 4096))
+    try:
+        for buf in bufs:
+            want = _tensor_sig(buf)
+            via_json = unpack_tensors(pack_tensors(buf))
+            assert _tensor_sig(via_json) == want, f"{name}: json parity"
+            via_bin = decode_frame(encode_frame_bytes(buf))
+            assert _tensor_sig(via_bin) == want, f"{name}: binary parity"
+            assert via_bin.meta == via_json.meta
+            assert via_bin.pts == via_json.pts
+            desc = ring.write_frame(encode_frame(buf))
+            assert desc is not None
+            _n, slot, gen, nbytes = transport.unpack_descriptor(desc)
+            via_shm = ring.read_frame(slot, gen, nbytes)
+            assert _tensor_sig(via_shm) == want, f"{name}: shm parity"
+            assert via_shm.meta == via_bin.meta
+    finally:
+        transport.detach_ring(ring)
+
+
+# ---------------------------------------------------------------------------
+# XFERCHECK: the shm path moves only descriptor bytes over the socket
+# ---------------------------------------------------------------------------
+
+class TestXfercheckLedger:
+    @pytest.fixture(autouse=True)
+    def _armed(self):
+        was = sanitizer.xfercheck_enabled()
+        sanitizer.enable_xfercheck()
+        sanitizer.reset_xfercheck()
+        try:
+            yield
+        finally:
+            sanitizer.reset_xfercheck()
+            if not was:
+                sanitizer.disable_xfercheck()
+
+    @staticmethod
+    def _stage_bytes():
+        return {(r["stage"], r["direction"]): r["bytes"]
+                for r in sanitizer.xfer_transfers()}
+
+    def test_shm_request_sends_descriptors_not_payload(self):
+        payload = np.zeros(64 * 1024, np.float32)  # 256 KiB tensor
+        with _EchoServer() as srv:
+            cli = QueryClient("127.0.0.1", srv.port)
+            try:
+                cli.connect(parse_caps_string(CAPS))
+                assert cli.shm_active
+                sanitizer.reset_xfercheck()  # drop handshake bytes
+                out = cli.request(Buffer([payload]), timeout=10)
+                assert np.asarray(out.tensors[0]).nbytes == payload.nbytes
+            finally:
+                cli.close()
+        rows = self._stage_bytes()
+        wire = rows.get(("wire:socket", "host"), 0)
+        shm_w = rows.get(("shm:write", "host"), 0)
+        # request + echoed answer both rode the ring
+        assert shm_w >= 2 * payload.nbytes
+        # the socket carried headers + descriptors only: orders of
+        # magnitude under ONE payload, let alone the two that moved
+        assert 0 < wire < payload.nbytes // 4, rows
+
+    def test_json_wire_pays_full_payload_on_socket(self):
+        payload = np.zeros(16 * 1024, np.float32)
+        with _EchoServer() as srv:
+            cli = QueryClient("127.0.0.1", srv.port, wire="json")
+            try:
+                cli.connect(parse_caps_string(CAPS))
+                sanitizer.reset_xfercheck()
+                cli.request(Buffer([payload]), timeout=10)
+            finally:
+                cli.close()
+        rows = self._stage_bytes()
+        assert rows.get(("wire:socket", "host"), 0) >= 2 * payload.nbytes
+        assert ("shm:write", "host") not in rows

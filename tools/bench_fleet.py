@@ -21,6 +21,17 @@ One 3-subprocess-replica fleet under live traffic, three gated legs:
 Report written to FLEET_r13.json (full mode) — the ISSUE 13 trajectory
 point.
 
+PR 18 adds the zero-copy data-plane legs (docs/transport.md):
+
+* **wire_overhead** — codec µs/frame, NNSB binary vs NNST/JSON, same
+  frames both ways with byte parity asserted; gate: binary ≤ 0.5× JSON.
+* **shm_vs_tcp** — same-host echo fps, negotiated binary+shm ring vs
+  forced-JSON loopback TCP; gate: shm ≥ 1.5× TCP, plus the XFERCHECK
+  ledger assertion that the shm path moves only descriptor bytes
+  through ``wire:socket`` (zero payload bytes on the socket).
+
+The wire legs' report lands in WIRE_r18.json (full mode).
+
     python tools/bench_fleet.py           # full bench, JSON report
     python tools/bench_fleet.py --smoke   # CI gate, short run
 """
@@ -179,6 +190,191 @@ def _leg_chaos(ps, view, settle_s: float) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# zero-copy data-plane legs (PR 18, docs/transport.md)
+# ---------------------------------------------------------------------------
+
+def _wire_frame(ntensors: int = 4, dim: int = 8):
+    import numpy as np
+
+    from nnstreamer_tpu.core import Buffer
+
+    return Buffer([np.arange(dim, dtype=np.float32) + i
+                   for i in range(ntensors)],
+                  pts=0.25, meta={"client_id": 1, "tag": "bench"})
+
+
+def _leg_wire_overhead(frames: int) -> dict:
+    """Wire-path overhead µs/frame over identical frames: what each
+    codec actually costs per frame on the socket path — NNSB emits
+    scatter-gather parts TX (``sendmsg`` joins them in the kernel) and
+    decodes one contiguous received payload RX; NNST pays its inherent
+    gather in ``pack_tensors`` TX and ``unpack_tensors`` RX. Byte
+    parity is asserted on the same frames."""
+    import numpy as np
+
+    from nnstreamer_tpu.core.serialize import pack_tensors, unpack_tensors
+    from nnstreamer_tpu.transport.frame import (decode_frame, encode_frame,
+                                                encode_frame_bytes)
+
+    buf = _wire_frame()
+    bin_blob = bytes(encode_frame_bytes(buf))   # the RX side's payload
+    json_blob = bytes(pack_tensors(buf))
+
+    def sig(b):
+        return tuple(np.ascontiguousarray(t).tobytes() for t in b.tensors)
+
+    parity = (sig(decode_frame(bin_blob)) == sig(buf)
+              and sig(unpack_tensors(json_blob)) == sig(buf))
+
+    def clock(enc, dec, blob):
+        t0 = time.perf_counter()
+        for _ in range(frames):
+            enc(buf)
+            dec(blob)
+        return (time.perf_counter() - t0) / frames * 1e6
+
+    # warm both codecs off the clock
+    for _ in range(64):
+        encode_frame(buf)
+        decode_frame(bin_blob)
+        pack_tensors(buf)
+        unpack_tensors(json_blob)
+    json_us = clock(pack_tensors, unpack_tensors, json_blob)
+    bin_us = clock(encode_frame, decode_frame, bin_blob)
+    ratio = bin_us / json_us if json_us else float("inf")
+    return {
+        "frames": frames,
+        "json_us_per_frame": round(json_us, 2),
+        "binary_us_per_frame": round(bin_us, 2),
+        "binary_over_json": round(ratio, 3),
+        "byte_parity": parity,
+        "ok": parity and ratio <= 0.5,
+    }
+
+
+def _echo_server():
+    """QueryServer + echo pump; returns (server, stop_callable)."""
+    import queue as _queue
+
+    from nnstreamer_tpu.query.server import QueryServer
+
+    srv = QueryServer().start()
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                item = srv.inbox.get(timeout=0.05)
+            except _queue.Empty:
+                continue
+            if isinstance(item, tuple):  # ("eos", cid)
+                continue
+            cid = item.meta.pop("client_id")
+            idx = item.meta.pop("_qserve_idx", None)
+            srv.send(cid, item, mark_idx=idx)
+
+    t = threading.Thread(target=pump, name="bench:echo", daemon=True)
+    t.start()
+
+    def shutdown():
+        stop.set()
+        t.join(timeout=5.0)
+        srv.stop()
+
+    return srv, shutdown
+
+
+def _leg_shm_vs_tcp(seconds: float) -> dict:
+    """Same-host echo fps: negotiated binary+shm vs forced-JSON loopback
+    TCP, identical ~512 KiB payloads, one client each way. Also runs one
+    shm request under the XFERCHECK ledger and asserts the socket moved
+    descriptor bytes only."""
+    import numpy as np
+
+    from nnstreamer_tpu.analysis import sanitizer
+    from nnstreamer_tpu.core import Buffer, parse_caps_string
+    from nnstreamer_tpu.query.client import QueryClient
+
+    caps = parse_caps_string(CAPS)
+    payload = np.zeros(128 * 1024, np.float32)  # 512 KiB, fits one slot
+
+    def fps(wire: str, shm: bool) -> tuple:
+        srv, shutdown = _echo_server()
+        cli = QueryClient("127.0.0.1", srv.port, wire=wire, shm=shm)
+        try:
+            cli.connect(caps)
+            negotiated = cli.wire_format + ("+shm" if cli.shm_active else "")
+            for _ in range(3):  # warm
+                cli.request(Buffer([payload]), timeout=15.0)
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                cli.request(Buffer([payload]), timeout=15.0)
+                n += 1
+            return n / (time.perf_counter() - t0), negotiated
+        finally:
+            cli.close()
+            shutdown()
+
+    tcp_fps, tcp_wire = fps("json", shm=False)
+    shm_fps, shm_wire = fps("auto", shm=True)
+
+    # XFERCHECK proof: one shm request, payload bytes in shm:write,
+    # descriptor-sized bytes only through wire:socket
+    was = sanitizer.xfercheck_enabled()
+    sanitizer.enable_xfercheck()
+    try:
+        srv, shutdown = _echo_server()
+        cli = QueryClient("127.0.0.1", srv.port)
+        try:
+            cli.connect(caps)
+            sanitizer.reset_xfercheck()  # drop handshake bytes
+            cli.request(Buffer([payload]), timeout=15.0)
+        finally:
+            cli.close()
+            shutdown()
+        rows = {(r["stage"], r["direction"]): r["bytes"]
+                for r in sanitizer.xfer_transfers()}
+        socket_b = rows.get(("wire:socket", "host"), 0)
+        shm_b = rows.get(("shm:write", "host"), 0)
+    finally:
+        sanitizer.reset_xfercheck()
+        if not was:
+            sanitizer.disable_xfercheck()
+    zero_payload_on_socket = (shm_b >= 2 * payload.nbytes
+                              and 0 < socket_b < payload.nbytes // 4)
+    speedup = shm_fps / tcp_fps if tcp_fps else float("inf")
+    return {
+        "payload_bytes": int(payload.nbytes),
+        "tcp_wire": tcp_wire,
+        "shm_wire": shm_wire,
+        "tcp_fps": round(tcp_fps, 1),
+        "shm_fps": round(shm_fps, 1),
+        "shm_over_tcp": round(speedup, 3),
+        "xfercheck": {"socket_bytes": socket_b, "shm_write_bytes": shm_b,
+                      "zero_payload_on_socket": zero_payload_on_socket},
+        "ok": (shm_wire == "binary+shm" and tcp_wire == "json"
+               and speedup >= 1.5 and zero_payload_on_socket),
+    }
+
+
+def run_wire(frames: int, seconds: float) -> dict:
+    legs = {"wire_overhead": _leg_wire_overhead(frames)}
+    print(f"[bench_fleet] wire_overhead: "
+          f"{'ok' if legs['wire_overhead']['ok'] else 'FAILED'} "
+          f"(binary {legs['wire_overhead']['binary_us_per_frame']}us vs "
+          f"json {legs['wire_overhead']['json_us_per_frame']}us/frame)",
+          file=sys.stderr)
+    legs["shm_vs_tcp"] = _leg_shm_vs_tcp(seconds)
+    print(f"[bench_fleet] shm_vs_tcp: "
+          f"{'ok' if legs['shm_vs_tcp']['ok'] else 'FAILED'} "
+          f"(shm {legs['shm_vs_tcp']['shm_fps']}fps vs "
+          f"tcp {legs['shm_vs_tcp']['tcp_fps']}fps)", file=sys.stderr)
+    return {"bench": "wire", "legs": legs,
+            "ok": all(l["ok"] for l in legs.values())}
+
+
 def run(traffic_s: float, settle_s: float) -> dict:
     from nnstreamer_tpu.obs import context as obs_ctx
     from nnstreamer_tpu.obs.fleet import FleetView
@@ -243,8 +439,16 @@ def main() -> int:
     ap.add_argument("--out", default=None, help="also write JSON here")
     args = ap.parse_args()
     if args.smoke:
+        wire = run_wire(frames=400, seconds=0.5)
         report = run(traffic_s=2.0, settle_s=1.2)
     else:
+        wire = run_wire(frames=4000, seconds=3.0)
+        wire_out = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..",
+            "WIRE_r18.json")
+        with open(wire_out, "w") as fh:
+            json.dump(wire, fh, indent=2)
+        print(f"[bench_fleet] wire report -> {wire_out}", file=sys.stderr)
         report = run(traffic_s=6.0, settle_s=2.0)
         out = args.out or os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "..",
@@ -252,6 +456,8 @@ def main() -> int:
         with open(out, "w") as fh:
             json.dump(report, fh, indent=2)
         print(f"[bench_fleet] report -> {out}", file=sys.stderr)
+    report["wire"] = wire
+    report["ok"] = report["ok"] and wire["ok"]
     print(json.dumps(report, indent=2))
     return 0 if report["ok"] else 1
 

@@ -448,6 +448,121 @@ def proc_replica_kill(mgr, duration: float) -> dict:
         ps.stop()
 
 
+@_scenario("shm-peer-kill")
+def shm_peer_kill(mgr, duration: float) -> dict:
+    """SIGKILL the shm peer (docs/transport.md slot lifecycle).
+
+    Leg A, deterministic: a forked reader attaches the parent's ring,
+    then dies by SIGKILL while every slot is in flight (it never
+    releases one). The parent must reclaim all slots via the generation
+    counters, outstanding descriptors must fail validation as typed
+    ``FrameError``s (never a torn read), the ring must be immediately
+    writable again, and the segment must unlink on detach.
+
+    Leg B, fleet: same-host subprocess replicas negotiate ``binary+shm``
+    automatically; SIGKILL one mid-traffic — evict, respawn, readmit
+    with the fresh link re-negotiating shm, zero client-visible errors
+    (``proc-replica-kill``'s bar, now with tensors riding the rings).
+    """
+    import multiprocessing
+    import numpy as np
+
+    from nnstreamer_tpu import transport
+    from nnstreamer_tpu.core import Buffer
+    from nnstreamer_tpu.service import Autoscaler, AutoscalerConfig
+    from nnstreamer_tpu.service.procreplica import ProcReplicaSet
+
+    # -- leg A: generation-counter recovery under a real SIGKILL ----------
+    ring = transport.create_ring(slots=2)  # pairs-with: detach_ring
+    leg_a: dict = {}
+    try:
+        descs = []
+        while True:
+            d = ring.write_frame(transport.encode_frame(
+                Buffer([np.arange(64, dtype=np.float32)])))
+            if d is None:
+                break  # ring full: every slot is now in flight
+            descs.append(transport.unpack_descriptor(d))
+        ready = multiprocessing.Event()
+
+        def reader(name: str) -> None:
+            peer = transport.attach_ring(name)  # pairs-with: detach_ring
+            ready.set()
+            time.sleep(300)  # hold the slots until SIGKILLed
+            transport.detach_ring(peer)  # unreachable; contract partner
+
+        proc = multiprocessing.Process(target=reader, args=(ring.name,),
+                                       daemon=True)
+        proc.start()
+        assert ready.wait(10), "shm reader never attached"
+        proc.kill()  # SIGKILL: no release, no detach
+        proc.join(10)
+        reclaimed = ring.reclaim()
+        stale_typed = 0
+        for _name, slot, gen, nbytes in descs:
+            try:
+                ring.read_frame(slot, gen, nbytes)
+            except transport.FrameError:
+                stale_typed += 1
+        rewrite = ring.write_frame(transport.encode_frame(
+            Buffer([np.zeros(8, np.float32)]))) is not None
+        leg_a = {"slots_held": len(descs), "reclaimed": reclaimed,
+                 "stale_descriptors_typed": stale_typed,
+                 "writable_after_reclaim": rewrite,
+                 "ok": (len(descs) == 2 and reclaimed == 2
+                        and stale_typed == 2 and rewrite)}
+    finally:
+        seg = "/dev/shm/" + ring.name
+        transport.detach_ring(ring)
+        leg_a["segment_unlinked"] = not os.path.exists(seg)
+        leg_a["ok"] = leg_a.get("ok", False) and leg_a["segment_unlinked"]
+
+    # -- leg B: fleet traffic over the rings while a replica dies ---------
+    ps = ProcReplicaSet(
+        "chaos-shm", "tensor_filter framework=jax model=registry://chaos",
+        CAPS, replicas=2,
+        models={"chaos": {"versions": {"1": "builtin://scaler?factor=2"},
+                          "active": "1"}},
+        quarantine_base_s=0.2, health_poll_s=0.05)
+    cfg = AutoscalerConfig(
+        min_replicas=2, max_replicas=2, tick_s=0.2,
+        respawn_backoff_base_s=0.3, max_respawns=4,
+        scale_out_cooldown_s=60.0, scale_in_cooldown_s=60.0)
+    scaler = Autoscaler(ps, cfg, name="chaos-shm")
+    try:
+        ps.start()
+        _warmup(ps, 4)
+        scaler.start()
+        wires_before = [r["wire"] for r in ps.pool.snapshot()["replicas"]]
+        with Traffic(ps, timeout=10.0) as tr:
+            time.sleep(duration / 2)
+            killed = ps.kill_replica(0)
+            evicted = _wait_counter(ps.pool, "evictions", 1)
+            deadline = time.monotonic() + 60.0
+            respawned = 0
+            while time.monotonic() < deadline and not respawned:
+                respawned = scaler.snapshot()["respawns"]
+                time.sleep(0.1)
+            readmitted = _wait_counter(ps.pool, "readmissions", 1,
+                                       timeout=20.0)
+            time.sleep(duration / 2)
+        wires_after = [r["wire"] for r in ps.pool.snapshot()["replicas"]]
+        shm_links = all(w == "binary+shm" for w in wires_before + wires_after)
+        leg_b = {"requests": tr.ok, "errors": tr.errors, "killed": killed,
+                 "evictions": evicted, "respawns": respawned,
+                 "readmissions": readmitted,
+                 "wire_before": wires_before, "wire_after": wires_after,
+                 "ok": (not tr.errors and tr.ok > 0 and evicted >= 1
+                        and respawned >= 1 and readmitted >= 1
+                        and shm_links)}
+    finally:
+        scaler.stop()
+        ps.stop()
+    return {"requests": leg_b["requests"], "errors": leg_b["errors"],
+            "ring_recovery": leg_a, "fleet": leg_b,
+            "ok": leg_a["ok"] and leg_b["ok"]}
+
+
 @_scenario("rolling-swap")
 def rolling_swap(mgr, duration: float) -> dict:
     """Roll the model slot across all replicas under traffic; zero
@@ -523,7 +638,7 @@ def main() -> int:
         sanitizer.enable(hold_warn_s=5.0)
     if args.smoke:
         scenarios = ["replica-kill", "conn-kill", "load-ramp",
-                     "proc-replica-kill"]
+                     "proc-replica-kill", "shm-peer-kill"]
         duration = args.duration or 2.0
     elif args.scenario:
         scenarios = [args.scenario]
