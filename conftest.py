@@ -95,6 +95,20 @@ if _XFERCHECK:
 
     _xfer_sanitizer.enable_xfercheck()
 
+# ---------------------------------------------------------------------------
+# wirefuzz: NNS_WIREFUZZ=1 runs the whole session with the frame-fuzz
+# scorekeeper enabled (analysis/sanitizer.py fourth half): the wire codec
+# choke points feed a frames-seen ledger and every fuzzed mutant records a
+# typed/clean/hang/crash/silent outcome. Each test then asserts zero NEW
+# hostile-peer contract violations during its span — the runtime twin of
+# the NNL5xx wire-protocol lint.
+# ---------------------------------------------------------------------------
+_WIREFUZZ = os.environ.get("NNS_WIREFUZZ", "") == "1"
+if _WIREFUZZ:
+    from nnstreamer_tpu.analysis import sanitizer as _wire_sanitizer
+
+    _wire_sanitizer.enable_wirefuzz()
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -110,6 +124,10 @@ def pytest_configure(config):
         "markers", "xfer_ok: opt out of the per-test NNS_XFERCHECK "
                    "zero-implicit-D2H check (tests that exercise the "
                    "violation path itself)")
+    config.addinivalue_line(
+        "markers", "wirefuzz_ok: opt out of the per-test NNS_WIREFUZZ "
+                   "zero-contract-violations check (tests that exercise "
+                   "the violation path itself)")
 
 
 @pytest.fixture(autouse=True)
@@ -181,6 +199,25 @@ def _xfercheck(request):
     assert not fresh, (
         f"xfercheck: {len(fresh)} implicit device→host transfer(s) inside "
         f"guarded scopes during this test: {fresh}")
+
+
+@pytest.fixture(autouse=True)
+def _wirefuzz_check(request):
+    """Under NNS_WIREFUZZ=1: fail any test during which a fuzzed mutant
+    broke the hostile-peer contract (hang, crash, or silent wrong
+    decode — anything but a typed error or a parity-clean decode)."""
+    if not _WIREFUZZ:
+        yield
+        return
+    if request.node.get_closest_marker("wirefuzz_ok"):
+        yield
+        return
+    before = len(_wire_sanitizer.wirefuzz_violations())
+    yield
+    fresh = _wire_sanitizer.wirefuzz_violations()[before:]
+    assert not fresh, (
+        f"wirefuzz: {len(fresh)} hostile-peer contract violation(s) "
+        f"during this test: {fresh}")
 
 
 # thread names owned by the control plane / serving layers — all of them

@@ -32,7 +32,15 @@ runtime, during caps negotiation). Two passes share one diagnostic model:
   constructors), implicit device→host materializations in hot scopes,
   per-frame device allocation churn, host round-trip sandwiches,
   donation opportunities/violations, and whole-buffer byte copies on
-  the query/transport wire (the zero-copy contract in docs/lint.md).
+  the query/transport wire (the zero-copy contract in docs/lint.md);
+* **protocol lint** (`lint_protocol`, rules ``NNL5xx``): the
+  wire-protocol & serialization contract over the query/transport
+  codecs — struct-layout drift (pack/unpack/declared-size
+  disagreement), unvalidated wire-derived sizes (the hostile-peer
+  memory-bomb shape), unbounded recv paths outside the typed
+  TornFrameError/FrameError contract, encode/decode field asymmetry
+  and negotiation-fallback gaps, and platform-dependent serialization
+  (native byte order, hash-order meta emission).
 
 The static passes are paired with runtime sanitizers
 (:mod:`.sanitizer`): tsan-lite — the control plane creates its locks
@@ -46,7 +54,12 @@ runtime and every test asserts zero outstanding units — and the
 at the fused-dispatch/backend-invoke choke points ban implicit
 device→host pulls while a per-(stage, direction) ledger byte-accounts
 every intentional transfer (surfaced via ``obs top`` / ``GET
-/profile``).
+/profile``) — and the ``NNS_WIREFUZZ=1`` structure-aware frame fuzzer
+(fourth half + tools/wirefuzz.py): deterministic seeded mutations of
+real NNSB frames and shm descriptors (truncations, bit flips, length
+inflations, stale generations, version/magic skew) driven through the
+decoders and a live QueryServer, asserting every mutant yields a typed
+FrameError-family error — the runtime twin of the NNL5xx contract.
 
 CLI: ``python -m nnstreamer_tpu lint <pbtxt | launch-string | pkg>``
 (also ``tools/nnlint.py`` — the self-lint CI gate; ``--rules NNL2xx``
@@ -58,6 +71,7 @@ from .concurrency_lint import lint_concurrency  # noqa: F401
 from .diagnostics import RULES, Diagnostic, Severity  # noqa: F401
 from .graph_lint import lint_launch, lint_pbtxt, lint_pipeline  # noqa: F401
 from .lifecycle_lint import lint_lifecycle  # noqa: F401
+from .protocol_lint import lint_protocol  # noqa: F401
 from .source_lint import lint_source  # noqa: F401
 from .transfer_lint import lint_transfer  # noqa: F401
 
@@ -70,6 +84,7 @@ __all__ = [
     "lint_lifecycle",
     "lint_pbtxt",
     "lint_pipeline",
+    "lint_protocol",
     "lint_source",
     "lint_transfer",
 ]

@@ -46,6 +46,7 @@ def _lint_target(target: str) -> List[Diagnostic]:
     from .concurrency_lint import lint_concurrency
     from .graph_lint import lint_launch, lint_pbtxt
     from .lifecycle_lint import lint_lifecycle
+    from .protocol_lint import lint_protocol
     from .source_lint import lint_source
     from .transfer_lint import lint_transfer
 
@@ -57,7 +58,8 @@ def _lint_target(target: str) -> List[Diagnostic]:
         return (lint_source([p], root=root)
                 + lint_concurrency([p], root=root)
                 + lint_lifecycle([p], root=root)
-                + lint_transfer([p], root=root))
+                + lint_transfer([p], root=root)
+                + lint_protocol([p], root=root))
     if p.suffix in (".pbtxt", ".launch", ".json"):
         try:
             text = p.read_text()

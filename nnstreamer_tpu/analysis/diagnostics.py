@@ -2,7 +2,8 @@
 
 Every finding is a :class:`Diagnostic` carrying a stable rule ID
 (``NNL0xx`` graph, ``NNL1xx`` source, ``NNL2xx`` concurrency, ``NNL3xx``
-lifecycle, ``NNL4xx`` device-transfer rules), a severity, a
+lifecycle, ``NNL4xx`` device-transfer, ``NNL5xx`` wire-protocol rules),
+a severity, a
 human-readable message, and a location (element/pad name for graph
 findings, ``file:line:col`` span for source findings). The catalog in
 :data:`RULES` is the single source of truth — docs/lint.md and the CLI's
@@ -211,6 +212,40 @@ _RULES = (
          "query hot path copies the payload the zero-copy wire contract "
          "says must be handed off by reference (memoryview, sendmsg "
          "gather-write, buffer-protocol file write)"),
+    # -- protocol lint (pass 6) -------------------------------------------------
+    Rule("NNL501", Severity.ERROR, "struct-layout drift",
+         "a wire struct layout disagrees with its own module: a packed "
+         "format with no matching unpack (or vice versa), an unpack "
+         "destructured into the wrong number of fields, or a declared "
+         "header-size constant that no longer equals calcsize(format) — "
+         "width, field-count, and offset drift ship silently and corrupt "
+         "every frame on the wire"),
+    Rule("NNL502", Severity.ERROR, "unvalidated wire-derived size",
+         "a length/count/rank field read off the wire flows into an "
+         "allocation, range loop, multiplication, frombuffer, or sized "
+         "recv without a bounds check against a declared limit — a "
+         "hostile peer's 4-byte field drives an OOM-scale allocation or "
+         "a billions-iteration loop (the memory-bomb shape)"),
+    Rule("NNL503", Severity.WARNING, "unbounded recv path",
+         "a socket read outside the typed TornFrameError/FrameError "
+         "contract: a partial-read loop that never checks for EOF (hangs "
+         "forever on a half-closed peer), a handshake read on a "
+         "just-accepted connection with no deadline (a silent peer parks "
+         "the worker thread), or wire bytes parsed with unpack_from "
+         "where struct.error escapes untyped and kills the reader — a "
+         "skewed peer must produce a typed error, never a hang"),
+    Rule("NNL504", Severity.WARNING, "encode/decode asymmetry or fallback gap",
+         "a field key written by an encoder with no reader in the paired "
+         "decoder (or read but never written), or negotiation caps "
+         "consumed by hard indexing instead of .get with a fallback — an "
+         "old peer that echoes the offer verbatim (or omits the key) "
+         "must fall back to the legacy path, not raise KeyError"),
+    Rule("NNL505", Severity.WARNING, "platform-dependent serialization",
+         "a wire struct format without an explicit byte order ('@' or "
+         "'=' or bare codes use NATIVE order and alignment — the frame "
+         "layout changes across architectures), or meta emitted by "
+         "iterating an unsorted dict (hash/insertion order is not a wire "
+         "contract; canonical encoders iterate sorted(items()))"),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in _RULES}

@@ -41,6 +41,21 @@ MAGIC = b"NNST"
 VERSION = 2
 _FLAG_SPARSE = 0x01
 
+# declared hostile-peer limits (docs/transport.md "hostile peer"
+# contract): every wire-derived size is checked against these BEFORE it
+# drives an allocation or a loop, and the violation raises the decoder's
+# typed error (ValueError here; transport/frame.py imports these and
+# raises FrameError, a ValueError subclass). A 4-byte count field from a
+# corrupt or hostile peer must never become a multi-GB allocation.
+MAX_TENSORS = 256
+MAX_META_BYTES = 1 << 20        # 1 MiB of JSON/tagged-binary meta
+MAX_PAYLOAD_BYTES = 1 << 33     # 8 GiB total tensor payload per frame
+
+# both sides of the v2/sparse header fields share these layouts — one
+# source of truth, so encoder and decoder cannot drift independently
+_FLAGS_DTLEN = struct.Struct("<BB")   # u8 flags | u8 dtype-name length
+_NBYTES_NNZ = struct.Struct("<QI")    # u64 nbytes | u32 nnz (sparse)
+
 # meta key consumed into per-tensor sparse headers rather than the JSON blob
 SPARSE_META_KEY = "sparse_specs"
 
@@ -77,7 +92,10 @@ def _encode_meta(meta: dict) -> bytes:
     from ..utils.log import logger
 
     items = {}
-    for k, v in meta.items():
+    # sorted: the emitted bytes must not depend on dict insertion order
+    # (canonical encoding — two peers packing the same meta produce the
+    # same frame, and wirefuzz byte-parity checks rely on it)
+    for k, v in sorted(meta.items(), key=lambda kv: str(kv[0])):
         if k == SPARSE_META_KEY:
             continue  # carried in the per-tensor headers
         if isinstance(v, np.ndarray) and v.size > _META_ARRAY_MAX:
@@ -90,10 +108,11 @@ def _encode_meta(meta: dict) -> bytes:
             continue
         items[str(k)] = v
     try:
-        return json.dumps(items, default=_meta_default).encode()
+        return json.dumps(items, default=_meta_default,
+                          sort_keys=True).encode()
     except (TypeError, ValueError):
         bad = []
-        for k, v in items.items():
+        for k, v in sorted(items.items()):
             try:
                 json.dumps(v, default=_meta_default)
             except (TypeError, ValueError):
@@ -164,10 +183,10 @@ def pack_tensors(buf: Buffer, extra_meta: Optional[dict] = None) -> memoryview:
             nbytes = 4 + idx.nbytes + vals.nbytes
             dt = dtype.value.encode()
             parts.append(_bview(
-                struct.pack("<BB", _FLAG_SPARSE, len(dt)) + dt
+                _FLAGS_DTLEN.pack(_FLAG_SPARSE, len(dt)) + dt
                 + struct.pack("<B", len(shape))
                 + struct.pack(f"<{len(shape)}Q", *shape)
-                + struct.pack("<QI", nbytes, idx.size)))
+                + _NBYTES_NNZ.pack(nbytes, idx.size)))
             parts.append(idx.view(np.uint8))
             parts.append(vals.reshape(-1).view(np.uint8))
     frame = native.gather(parts).data
@@ -198,48 +217,87 @@ def unpack_tensors(blob) -> Buffer:
     if bytes(blob[:4]) != MAGIC:
         raise ValueError("bad tensor frame magic")
     off = 4
-    version, n, pts, meta_len = struct.unpack_from("<HIdI", blob, off)
-    if version not in (1, VERSION):
-        raise ValueError(f"unsupported frame version {version}")
-    off += struct.calcsize("<HIdI")
-    meta = json.loads(bytes(blob[off:off + meta_len]) or b"{}")
-    off += meta_len
-    tensors: List[np.ndarray] = []
-    specs: List[TensorSpec] = []
-    for ti in range(n):
-        flags = 0
-        if version >= 2:
-            (flags,) = struct.unpack_from("<B", blob, off)
+    try:
+        version, n, pts, meta_len = struct.unpack_from("<HIdI", blob, off)
+        if version not in (1, VERSION):
+            raise ValueError(f"unsupported frame version {version}")
+        off += struct.calcsize("<HIdI")
+        # hostile-peer bounds: every wire-derived size is validated
+        # against the declared limit (and against what actually arrived)
+        # BEFORE it drives an allocation or a loop
+        if n > MAX_TENSORS:
+            raise ValueError(
+                f"frame declares {n} tensors (limit {MAX_TENSORS})")
+        if meta_len > MAX_META_BYTES or off + meta_len > len(blob):
+            raise ValueError(
+                f"torn/oversized meta: {meta_len} bytes declared, "
+                f"{len(blob) - off} available (limit {MAX_META_BYTES})")
+        meta = json.loads(bytes(blob[off:off + meta_len]) or b"{}")
+        off += meta_len
+        tensors: List[np.ndarray] = []
+        specs: List[TensorSpec] = []
+        for ti in range(n):
+            if version >= 2:
+                flags, dt_len = _FLAGS_DTLEN.unpack_from(blob, off)
+                off += _FLAGS_DTLEN.size
+            else:
+                flags = 0
+                (dt_len,) = struct.unpack_from("<B", blob, off)
+                off += 1
+            dtype = DataType(bytes(blob[off:off + dt_len]).decode())
+            off += dt_len
+            (rank,) = struct.unpack_from("<B", blob, off)
             off += 1
-        (dt_len,) = struct.unpack_from("<B", blob, off)
-        off += 1
-        dtype = DataType(bytes(blob[off:off + dt_len]).decode())
-        off += dt_len
-        (rank,) = struct.unpack_from("<B", blob, off)
-        off += 1
-        shape = struct.unpack_from(f"<{rank}Q", blob, off)
-        off += 8 * rank
-        (nbytes,) = struct.unpack_from("<Q", blob, off)
-        off += 8
-        if flags & _FLAG_SPARSE:
-            # a frame is all-sparse or all-dense (tensor_sparse_enc layout
-            # pairs idx/values positionally — mixing would misalign them)
-            if len(tensors) != 2 * len(specs):
-                raise ValueError(f"tensor {ti}: sparse/dense mix in one frame")
-            (nnz,) = struct.unpack_from("<I", blob, off)
-            idx = np.frombuffer(blob, np.int32, count=nnz, offset=off + 4)
-            vals = np.frombuffer(blob, dtype.np_dtype, count=nnz,
-                                 offset=off + 4 + idx.nbytes)
-            tensors.extend([idx.copy(), vals.copy()])
-            specs.append(TensorSpec(shape, dtype))
-        else:
-            if specs:
-                raise ValueError(f"tensor {ti}: sparse/dense mix in one frame")
-            a = np.frombuffer(blob, dtype.np_dtype,
-                              count=int(np.prod(shape)) if shape else 1,
-                              offset=off)
-            tensors.append(a.reshape(shape or ()).copy())
-        off += nbytes
+            shape = struct.unpack_from(f"<{rank}Q", blob, off)
+            off += 8 * rank
+            if flags & _FLAG_SPARSE:
+                # a frame is all-sparse or all-dense (tensor_sparse_enc
+                # layout pairs idx/values positionally — mixing would
+                # misalign them)
+                if len(tensors) != 2 * len(specs):
+                    raise ValueError(
+                        f"tensor {ti}: sparse/dense mix in one frame")
+                nbytes, nnz = _NBYTES_NNZ.unpack_from(blob, off)
+                off += 8  # nnz is part of the nbytes-counted payload
+                itemsize = np.dtype(dtype.np_dtype).itemsize
+                if (nbytes > MAX_PAYLOAD_BYTES
+                        or 4 + nnz * (4 + itemsize) > nbytes
+                        or off + nbytes > len(blob)):
+                    raise ValueError(
+                        f"tensor {ti}: torn/oversized sparse payload "
+                        f"({nnz} nnz, {nbytes} bytes declared, "
+                        f"{len(blob) - off} available)")
+                idx = np.frombuffer(blob, np.int32, count=nnz,
+                                    offset=off + 4)
+                vals = np.frombuffer(blob, dtype.np_dtype, count=nnz,
+                                     offset=off + 4 + idx.nbytes)
+                tensors.extend([idx.copy(), vals.copy()])
+                specs.append(TensorSpec(shape, dtype))
+            else:
+                if specs:
+                    raise ValueError(
+                        f"tensor {ti}: sparse/dense mix in one frame")
+                (nbytes,) = struct.unpack_from("<Q", blob, off)
+                off += 8
+                count = 1
+                for d in shape:
+                    count *= int(d)  # Python ints: no silent overflow
+                itemsize = np.dtype(dtype.np_dtype).itemsize
+                if (nbytes > MAX_PAYLOAD_BYTES
+                        or count * itemsize != nbytes
+                        or off + nbytes > len(blob)):
+                    raise ValueError(
+                        f"tensor {ti}: payload mismatch (shape {shape} "
+                        f"wants {count * itemsize} bytes, {nbytes} "
+                        f"declared, {len(blob) - off} available)")
+                a = np.frombuffer(blob, dtype.np_dtype, count=count,
+                                  offset=off)
+                tensors.append(a.reshape(shape or ()).copy())
+            off += nbytes
+    except (struct.error, UnicodeDecodeError) as e:
+        # a truncated/corrupt frame must surface as the decoder's TYPED
+        # error, never a bare struct.error killing a reader thread
+        raise ValueError(f"torn tensor frame: {e}") from e
     out = Buffer(tensors, pts=None if math.isnan(pts) else pts)
     out.meta.update(meta)
     if specs:

@@ -129,7 +129,7 @@ class QueryClient:
                     self.server_caps = caps
                     self._caps_event.set()
                 elif msg_type is MsgType.ERROR:
-                    text = payload.decode()
+                    text = payload.decode(errors="replace")
                     if not self._caps_event.is_set():
                         # pre-handshake: caps rejection ends the connect
                         logger.error("tensor-query server error: %s", text)
@@ -148,10 +148,18 @@ class QueryClient:
             # TornFrameError lands here too: a link cut mid-frame is a
             # typed disconnect, never a silent hang or a fake clean EOS
             logger.info("tensor-query connection closed: %s", e)
-        except transport.FrameError as e:
+        except ValueError as e:
+            # FrameError, NNST decode errors, UnicodeDecodeError (garbage
+            # caps payload): a poisoned frame drops the link, typed —
+            # never an unhandled exception leaving waiters to time out
             logger.error("tensor-query frame rejected, dropping link: %s", e)
         finally:
             self.connected = False
+            if not self._caps_event.is_set():
+                # reader died pre-handshake (garbage caps reply, torn
+                # frame): fail connect() NOW with server_caps=None
+                # instead of letting it run out the full timeout
+                self._caps_event.set()
             # unblock any waiter: None = clean end, DISCONNECTED = link died
             self.responses.put(None if self._clean_eos else DISCONNECTED)
 

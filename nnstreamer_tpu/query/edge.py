@@ -81,6 +81,10 @@ class PubSubBroker:
 
     def _handshake(self, conn: socket.socket) -> None:
         try:
+            # deadline on the handshake only: a peer that connects and
+            # never sends its topic must not park this thread forever
+            # (socket.timeout is an OSError — caught below, clean close)
+            conn.settimeout(10.0)
             msg = recv_msg(conn)
             if msg is None or msg[0] is not MsgType.CAPABILITY:
                 conn.close()
@@ -93,9 +97,12 @@ class PubSubBroker:
                 conn.close()
                 return
             send_msg(conn, MsgType.CAPABILITY, str(caps).encode())
+            conn.settimeout(None)  # publish sends are not deadline-bound
             with self._lock:
                 self._subs.setdefault(topic, []).append(conn)
-        except (OSError, ConnectionError):
+        except (OSError, ConnectionError, UnicodeDecodeError):
+            # UnicodeDecodeError: garbage topic bytes must close the
+            # connection, not kill the handshake thread with it open
             conn.close()
 
     def _drop(self, topic: str, s: socket.socket) -> None:

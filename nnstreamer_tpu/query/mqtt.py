@@ -38,6 +38,15 @@ def _closer(conn: socket.socket):
     return close
 
 
+# MQTT 3.1.1 [2.2.3]: remaining length is a 4-digit varint, so the
+# protocol itself caps a packet at 256 MiB - 1; enforcing it here bounds
+# what a hostile peer can make _read_packet allocate
+MQTT_MAX_PACKET = 268_435_455
+
+# a silent peer must not park a broker serve thread forever: the CONNECT
+# packet has this long to arrive before the connection is dropped
+MQTT_CONNECT_DEADLINE_S = 10.0
+
 # packet types (high nibble of the fixed header)
 CONNECT, CONNACK = 1, 2
 PUBLISH = 3
@@ -91,6 +100,9 @@ def _read_packet(sock: socket.socket) -> Optional[Tuple[int, int, bytes]]:
         mult *= 128
     else:
         raise ConnectionError("mqtt: malformed remaining length")
+    if length > MQTT_MAX_PACKET:
+        raise ConnectionError(
+            f"mqtt: remaining length {length} exceeds protocol ceiling")
     payload = _read_exact(sock, length) if length else b""
     if length and payload is None:
         return None
@@ -232,8 +244,14 @@ class MqttClient:
                 return
             ptype, _, payload = pkt
             if ptype == PUBLISH:
-                (tlen,) = struct.unpack_from(">H", payload, 0)
-                topic = payload[2:2 + tlen].decode()
+                try:
+                    (tlen,) = struct.unpack_from(">H", payload, 0)
+                    topic = payload[2:2 + tlen].decode()
+                except (struct.error, UnicodeDecodeError):
+                    # a malformed frame must not kill the reader thread
+                    # (and with it every later subscription)
+                    logger.warning("mqtt: malformed PUBLISH frame dropped")
+                    continue
                 body = payload[2 + tlen:]
                 cb = self._on_message
                 if cb is not None:
@@ -298,12 +316,17 @@ class MiniBroker:
     def _serve(self, conn: socket.socket) -> None:
         write_lock = threading.Lock()
         try:
+            # deadline on the handshake only: a peer that connects and
+            # never sends CONNECT must not park this thread forever
+            # (socket.timeout is an OSError — caught below, clean exit)
+            conn.settimeout(MQTT_CONNECT_DEADLINE_S)
             pkt = _read_packet(conn)
             if pkt is None or pkt[0] != CONNECT:
                 conn.close()
                 return
             with write_lock:
                 _send_packet(conn, CONNACK, b"\x00\x00")
+            conn.settimeout(None)
             while self._running.is_set():
                 pkt = _read_packet(conn)
                 if pkt is None:
@@ -341,7 +364,7 @@ class MiniBroker:
                         _send_packet(conn, PINGRESP, b"")
                 elif ptype == DISCONNECT:
                     break
-        except (OSError, ConnectionError, struct.error):
+        except (OSError, ConnectionError, struct.error, UnicodeDecodeError):
             pass
         finally:
             with self._lock:

@@ -139,13 +139,20 @@ def recv_msg(sock: socket.socket) -> Optional[Tuple[MsgType, bytes]]:
         raise ConnectionError("bad tensor-query frame magic")
     if length > MAX_PAYLOAD:
         raise ConnectionError(f"oversized tensor-query payload ({length} bytes)")
+    try:
+        mt = MsgType(msg_type)
+    except ValueError:
+        # a skewed/corrupt header must surface as the protocol's typed
+        # error, not a bare ValueError killing the reader loop
+        raise ConnectionError(
+            f"unknown tensor-query message type {msg_type}") from None
     payload = b""
     if length:
         payload = _recv_exact(sock, length, "payload")
         if payload is None:  # 0 of `length` bytes then EOF: torn too
             raise TornFrameError(
                 f"connection closed before any of a {length}-byte payload")
-    return MsgType(msg_type), payload
+    return mt, payload
 
 
 def _recv_exact(sock: socket.socket, n: int, what: str) -> Optional[bytes]:

@@ -304,11 +304,20 @@ class QueryServer:
                     break
                 msg_type, payload = msg
                 if msg_type is MsgType.CAPABILITY:
+                    try:
+                        text = payload.decode()
+                    except UnicodeDecodeError:
+                        # garbage capability token: answer with a typed
+                        # ERROR and drop the link — never an unhandled
+                        # exception killing this worker with conn open
+                        send_msg(conn, MsgType.ERROR,
+                                 b"bad capability payload: not utf-8")
+                        break
                     # strip the wire-negotiation structure BEFORE the
                     # accept gate: an accept_caps that pattern-matches
                     # tensor structures must never see (or veto) it
                     caps, wire = transport.split_wire_caps(
-                        parse_caps_string(payload.decode()))
+                        parse_caps_string(text))
                     ok = self.accept_caps(caps) if self.accept_caps else True
                     if ok:
                         self._client_caps[client_id] = caps
@@ -378,7 +387,10 @@ class QueryServer:
             # TornFrameError lands here: a client cut mid-frame is a
             # typed disconnect on this worker only, never a hang
             logger.info("query server client %d dropped: %s", client_id, e)
-        except transport.FrameError as e:
+        except ValueError as e:
+            # the whole decode family: FrameError (NNSB), the NNST
+            # codec's ValueError, UnicodeDecodeError — a poisoned frame
+            # drops THIS link only, typed, never an unhandled exception
             logger.error("query server client %d sent a bad frame, "
                          "dropping it: %s", client_id, e)
         finally:

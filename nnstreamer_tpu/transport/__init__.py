@@ -24,18 +24,21 @@ package, NNL3xx checks the ring attach/detach pairs, and the
 contracts at runtime (docs/transport.md).
 """
 from . import stats
-from .frame import (FORMAT_BINARY, FORMAT_JSON, FrameError, WIRE_MIME,
-                    decode_frame, encode_frame, encode_frame_bytes,
-                    frame_nbytes, gather_parts, is_binary_frame,
-                    offer_caps, offered_formats, owning_message,
-                    owning_tagged, reply_caps, split_wire_caps)
+from .frame import (FORMAT_BINARY, FORMAT_JSON, FrameError,
+                    MAX_META_BYTES, MAX_PAYLOAD_BYTES, MAX_TENSORS,
+                    WIRE_MIME, decode_frame, encode_frame,
+                    encode_frame_bytes, frame_nbytes, gather_parts,
+                    is_binary_frame, offer_caps, offered_formats,
+                    owning_message, owning_tagged, reply_caps,
+                    split_wire_caps)
 from .shm import (ShmRing, attach_ring, create_ring, detach_ring,
                   is_shm_descriptor, pack_descriptor, ring_name,
                   same_host_token, unpack_descriptor)
 from .staging import DoubleBufferedStager
 
 __all__ = [
-    "FORMAT_BINARY", "FORMAT_JSON", "FrameError", "WIRE_MIME",
+    "FORMAT_BINARY", "FORMAT_JSON", "FrameError",
+    "MAX_META_BYTES", "MAX_PAYLOAD_BYTES", "MAX_TENSORS", "WIRE_MIME",
     "decode_frame", "encode_frame", "encode_frame_bytes", "frame_nbytes",
     "gather_parts", "is_binary_frame", "offer_caps", "offered_formats",
     "owning_message", "owning_tagged", "reply_caps", "split_wire_caps",

@@ -39,7 +39,9 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..core.buffer import Buffer
-from ..core.serialize import SPARSE_META_KEY, _META_ARRAY_MAX
+from ..core.serialize import (MAX_META_BYTES, MAX_PAYLOAD_BYTES,
+                              MAX_TENSORS, SPARSE_META_KEY,
+                              _META_ARRAY_MAX)
 from ..core.tensors import DataType, TensorSpec
 
 MAGIC = b"NNSB"
@@ -124,7 +126,8 @@ def _enc_value(out: bytearray, v) -> None:
     elif isinstance(v, dict):
         out += b"d"
         out += _U32.pack(len(v))
-        for k, item in v.items():
+        # canonical order for nested dicts too (see _pack_meta)
+        for k, item in sorted(v.items(), key=lambda kv: str(kv[0])):
             ks = str(k).encode()
             out += _U32.pack(len(ks))
             out += ks
@@ -153,7 +156,10 @@ def _pack_meta(meta: dict) -> bytearray:
     from ..utils.log import logger
 
     items = []
-    for k, v in meta.items():
+    # canonical encoding: two processes building the same meta dict in
+    # different insertion order must emit identical bytes (hash/insertion
+    # order is not part of the wire contract)
+    for k, v in sorted(meta.items(), key=lambda kv: str(kv[0])):
         if k == SPARSE_META_KEY:
             continue  # carried in the per-tensor table entries
         if isinstance(v, np.ndarray) and v.size > _META_ARRAY_MAX:
@@ -231,9 +237,17 @@ def _dec_value(r: _Reader):
         return int(text) if tag == b"I" else text
     if tag == b"l":
         (n,) = r.unpack(_U32, "meta sidecar")
+        if n > r.view.nbytes - r.off:  # every item is >= 1 tag byte
+            raise FrameError(
+                f"torn meta sidecar: list claims {n} items, "
+                f"{r.view.nbytes - r.off} bytes remain")
         return [_dec_value(r) for _ in range(n)]
     if tag == b"d":
         (n,) = r.unpack(_U32, "meta sidecar")
+        if n > r.view.nbytes - r.off:  # every entry is >= 5 bytes
+            raise FrameError(
+                f"torn meta sidecar: dict claims {n} entries, "
+                f"{r.view.nbytes - r.off} bytes remain")
         out = {}
         for _ in range(n):
             (kn,) = r.unpack(_U32, "meta sidecar")
@@ -246,6 +260,10 @@ def _dec_value(r: _Reader):
 def _unpack_meta(view: memoryview) -> dict:
     r = _Reader(view)
     (n,) = r.unpack(_U32, "meta sidecar")
+    if n > view.nbytes:  # every entry is >= 5 bytes (keylen + tag)
+        raise FrameError(
+            f"torn meta sidecar: {n} entries claimed in "
+            f"{view.nbytes} bytes")
     out = {}
     for _ in range(n):
         (kn,) = r.unpack(_U32, "meta sidecar")
@@ -380,6 +398,15 @@ def decode_frame(blob, copy: bool = True) -> Buffer:
         raise FrameError("bad binary frame magic")
     if version != VERSION:
         raise FrameError(f"unsupported binary frame version {version}")
+    # hostile-peer bounds (docs/transport.md): wire-derived counts are
+    # validated against the declared limits BEFORE they drive a loop or
+    # an allocation — the limits are shared with the NNST codec
+    if n > MAX_TENSORS:
+        raise FrameError(
+            f"frame declares {n} tensors (limit {MAX_TENSORS})")
+    if meta_len > MAX_META_BYTES:
+        raise FrameError(
+            f"frame declares {meta_len}B meta (limit {MAX_META_BYTES})")
     entries = [r.unpack(_TENTRY, "tensor table") for _ in range(n)]
     tensors: List[np.ndarray] = []
     specs: List[TensorSpec] = []
@@ -391,16 +418,20 @@ def decode_frame(blob, copy: bool = True) -> Buffer:
         if rank > MAX_RANK:
             raise FrameError(f"tensor {ti}: rank {rank} > {MAX_RANK}")
         shape = tuple(int(d) for d in dims[:rank])
+        if nbytes > MAX_PAYLOAD_BYTES:
+            raise FrameError(
+                f"tensor {ti}: {nbytes}B payload declared "
+                f"(limit {MAX_PAYLOAD_BYTES})")
         raw = r.take(nbytes, f"tensor {ti} payload")
         if tflags & _TFLAG_SPARSE:
             if len(tensors) != 2 * len(specs):
                 raise FrameError(
                     f"tensor {ti}: sparse/dense mix in one frame")
             nnz = extra
-            if nnz * 4 > nbytes:
+            if nnz * (4 + itemsize) > nbytes:
                 raise FrameError(
                     f"tensor {ti}: torn sparse payload ({nbytes} bytes "
-                    f"for {nnz} indices)")
+                    f"for {nnz} idx/value pairs)")
             idx = np.frombuffer(raw, np.int32, count=nnz)
             vals = np.frombuffer(raw, np_dtype, count=nnz,
                                  offset=idx.nbytes)
@@ -421,6 +452,12 @@ def decode_frame(blob, copy: bool = True) -> Buffer:
                               count=count).reshape(shape or ())
             tensors.append(a.copy() if copy else a)
     meta_view = r.take(meta_len, "meta sidecar")
+    if r.off != view.nbytes:
+        # the frame must account for every byte: trailing garbage means
+        # the sender and this decoder disagree about the layout
+        raise FrameError(
+            f"frame has {view.nbytes - r.off} trailing bytes past the "
+            f"meta sidecar")
     meta = _unpack_meta(meta_view) if meta_len else {}
     out = Buffer(tensors, pts=None if math.isnan(pts) else pts)
     out.meta.update(meta)
@@ -433,10 +470,16 @@ def decode_frame(blob, copy: bool = True) -> Buffer:
 def _note_wire_bytes(stage: str, nbytes: int) -> None:
     """NNS_XFERCHECK byte accounting at the codec choke point — the same
     ledger stages the NNST codec reports under, so binary-vs-JSON wire
-    volume is one ``xfer_report`` diff."""
+    volume is one ``xfer_report`` diff. The NNS_WIREFUZZ scorekeeper
+    shares the choke point: every clean encode/decode reports here while
+    the fuzzer is armed (its byte-parity denominator)."""
     _san = _sys.modules.get("nnstreamer_tpu.analysis.sanitizer")
-    if _san is not None and _san.XFER:
+    if _san is None:
+        return
+    if _san.XFER:
         _san.note_transfer(stage, "host", nbytes)
+    if _san.WIREFUZZ:
+        _san.note_frame_event(stage, nbytes)
 
 
 # ---------------------------------------------------------------------------

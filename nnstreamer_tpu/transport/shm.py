@@ -281,6 +281,15 @@ def attach_ring(name: str) -> ShmRing:  # pairs-with: detach_ring
     if magic != RING_MAGIC or version != RING_VERSION:
         shm.close()
         raise FrameError(f"segment {name} is not an NNSR v{RING_VERSION} ring")
+    # geometry from the segment header is wire-adjacent data: validate
+    # it against the mapping's actual size before any slot arithmetic
+    # trusts it (a corrupt header must not index past the segment)
+    need = _RING_HEADER.size + nslots * (_SLOT_STRIDE + slot_bytes)
+    if nslots == 0 or need > shm.size:
+        shm.close()
+        raise FrameError(
+            f"segment {name}: ring header claims {nslots} slots of "
+            f"{slot_bytes}B ({need}B) in a {shm.size}B segment")
     _note_segment("acquire", name)
     stats.note_shm("segments_attached")
     return ShmRing(shm, owner=False, nslots=nslots, slot_bytes=slot_bytes)

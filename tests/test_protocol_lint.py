@@ -1,0 +1,350 @@
+"""nnlint pass 6: wire-protocol & serialization-contract rules (NNL5xx).
+
+Each rule gets a bad fixture that triggers and a good fixture that stays
+silent, plus the shared pragma/skip-file machinery, the wire-scope gate
+(non-wire files never produce findings), and the strict self-lint gate
+with the NNL5xx family armed."""
+import textwrap
+
+from nnstreamer_tpu.analysis import Severity
+from nnstreamer_tpu.analysis.cli import main as lint_main
+from nnstreamer_tpu.analysis.protocol_lint import lint_protocol
+
+
+def rules_of(diags):
+    return {d.rule for d in diags}
+
+
+def _lint_snippet(tmp_path, subdir, code):
+    d = tmp_path / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "mod.py"
+    f.write_text(textwrap.dedent(code))
+    return lint_protocol([f], root=str(tmp_path))
+
+
+class TestLayoutRules:
+    def test_nnl501_size_constant_drift(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "transport", """
+            import struct
+            _HEADER = struct.Struct("<4sHI")
+            HEADER_SIZE = 12  # calcsize is 10: drifted
+            def pack_header(a, b, c):
+                return _HEADER.pack(a, b, c)
+            def unpack_header(blob):
+                return _HEADER.unpack_from(blob, 0)
+        """)
+        errs = [d for d in bad if d.rule == "NNL501"]
+        assert errs and "HEADER_SIZE" in errs[0].message
+        good = _lint_snippet(tmp_path, "transport", """
+            import struct
+            _HEADER = struct.Struct("<4sHI")
+            HEADER_SIZE = 10
+            def pack_header(a, b, c):
+                return _HEADER.pack(a, b, c)
+            def unpack_header(blob):
+                return _HEADER.unpack_from(blob, 0)
+        """)
+        assert "NNL501" not in rules_of(good)
+
+    def test_nnl501_one_sided_format(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "transport", """
+            import struct
+            def encode_pair(a, b):
+                return struct.pack("<HH", a, b)
+            def decode_count(blob):
+                (n,) = struct.unpack("<I", blob[:4])
+                if n > 64:
+                    raise ValueError(n)
+                return n
+        """)
+        assert "NNL501" in rules_of(bad)
+        # shared module-level Struct on both sides: one source of truth
+        good = _lint_snippet(tmp_path, "transport", """
+            import struct
+            _PAIR = struct.Struct("<HH")
+            def encode_pair(a, b):
+                return _PAIR.pack(a, b)
+            def decode_pair(blob):
+                a, b = _PAIR.unpack_from(blob, 0)
+                return a, b
+        """)
+        assert "NNL501" not in rules_of(good)
+
+    def test_nnl501_destructure_arity(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "transport", """
+            import struct
+            _HDR = struct.Struct("<HHI")
+            def pack_hdr(a, b, c):
+                return _HDR.pack(a, b, c)
+            def unpack_hdr(blob):
+                a, b = _HDR.unpack_from(blob, 0)
+                return a, b
+        """)
+        errs = [d for d in bad if d.rule == "NNL501"]
+        assert errs and "2 name(s)" in errs[0].message
+        good = _lint_snippet(tmp_path, "transport", """
+            import struct
+            _HDR = struct.Struct("<HHI")
+            def pack_hdr(a, b, c):
+                return _HDR.pack(a, b, c)
+            def unpack_hdr(blob):
+                a, b, c = _HDR.unpack_from(blob, 0)
+                return a, b, c
+        """)
+        assert "NNL501" not in rules_of(good)
+
+
+class TestSizeAndRecvRules:
+    def test_nnl502_unvalidated_wire_size(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "query", """
+            import struct
+            def decode_list(blob):
+                (n,) = struct.unpack_from("<I", blob, 0)
+                return [i for i in range(n)]
+        """)
+        errs = [d for d in bad if d.rule == "NNL502"]
+        assert errs and errs[0].severity is Severity.ERROR
+        good = _lint_snippet(tmp_path, "query", """
+            import struct
+            def decode_list(blob):
+                (n,) = struct.unpack_from("<I", blob, 0)
+                if n > 256:
+                    raise ValueError(f"count {n} over limit")
+                return [i for i in range(n)]
+        """)
+        assert "NNL502" not in rules_of(good)
+
+    def test_nnl502_len_of_received_buffer_is_bounded(self, tmp_path):
+        # len() of bytes that already arrived is NOT wire-tainted
+        clean = _lint_snippet(tmp_path, "query", """
+            def consume(sock):
+                data = sock.recv(4096)
+                if not data:
+                    raise ConnectionError("eof")
+                n = len(data)
+                return list(range(n))
+        """)
+        assert "NNL502" not in rules_of(clean)
+
+    def test_nnl503_partial_read_without_eof_check(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "query", """
+            def read_exact(sock, want):
+                buf = b""
+                while len(buf) < want:
+                    chunk = sock.recv(want - len(buf))
+                    buf += chunk
+                return buf
+        """)
+        assert "NNL503" in rules_of(bad)
+        good = _lint_snippet(tmp_path, "query", """
+            def read_exact(sock, want):
+                buf = b""
+                while len(buf) < want:
+                    chunk = sock.recv(want - len(buf))
+                    if not chunk:
+                        raise ConnectionError("torn frame")
+                    buf += chunk
+                return buf
+        """)
+        assert "NNL503" not in rules_of(good)
+
+    def test_nnl503_handshake_without_deadline(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "query", """
+            def handshake(conn):
+                msg = recv_msg(conn)
+                return msg
+        """)
+        errs = [d for d in bad if d.rule == "NNL503"]
+        assert errs and "settimeout" in errs[0].message
+        good = _lint_snippet(tmp_path, "query", """
+            def handshake(conn):
+                conn.settimeout(10.0)
+                msg = recv_msg(conn)
+                conn.settimeout(None)
+                return msg
+        """)
+        assert "NNL503" not in rules_of(good)
+
+    def test_nnl503_untyped_unpack_in_reader(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "query", """
+            import struct
+            def read_loop(sock):
+                data = sock.recv(4096)
+                if not data:
+                    raise ConnectionError("eof")
+                (tag,) = struct.unpack_from(">H", data, 0)
+                return tag
+        """)
+        assert "NNL503" in rules_of(bad)
+        good = _lint_snippet(tmp_path, "query", """
+            import struct
+            def read_loop(sock):
+                data = sock.recv(4096)
+                if not data:
+                    raise ConnectionError("eof")
+                try:
+                    (tag,) = struct.unpack_from(">H", data, 0)
+                except struct.error:
+                    raise ConnectionError("short frame")
+                return tag
+        """)
+        assert "NNL503" not in rules_of(good)
+
+
+class TestSymmetryRules:
+    def test_nnl504_write_only_field_key(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "transport", """
+            def encode_caps(mode):
+                return {"selected": mode, "orphan": 1}
+            def decode_caps(caps):
+                return caps.get("selected")
+        """)
+        errs = [d for d in bad if d.rule == "NNL504"]
+        assert errs and "'orphan'" in errs[0].message
+        good = _lint_snippet(tmp_path, "transport", """
+            def encode_caps(mode):
+                return {"selected": mode, "orphan": 1}
+            def decode_caps(caps):
+                return caps.get("selected"), caps.get("orphan")
+        """)
+        assert "NNL504" not in rules_of(good)
+
+    def test_nnl504_hard_negotiation_index(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "transport", """
+            def parse_reply(caps):
+                return caps["selected"]
+        """)
+        errs = [d for d in bad if d.rule == "NNL504"]
+        assert errs and "KeyError" in errs[0].message
+        good = _lint_snippet(tmp_path, "transport", """
+            def parse_reply(caps):
+                return caps.get("selected")
+        """)
+        assert "NNL504" not in rules_of(good)
+
+
+class TestPortabilityRules:
+    def test_nnl505_native_byte_order(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "transport", """
+            import struct
+            def encode_pair(a, b):
+                return struct.pack("HH", a, b)
+            def decode_pair(blob):
+                a, b = struct.unpack("HH", blob)
+                return a, b
+        """)
+        assert "NNL505" in rules_of(bad)
+        good = _lint_snippet(tmp_path, "transport", """
+            import struct
+            def encode_pair(a, b):
+                return struct.pack("<HH", a, b)
+            def decode_pair(blob):
+                a, b = struct.unpack("<HH", blob)
+                return a, b
+        """)
+        assert "NNL505" not in rules_of(good)
+
+    def test_nnl505_order_free_format_exempt(self, tmp_path):
+        clean = _lint_snippet(tmp_path, "transport", """
+            import struct
+            def encode_tag(tag):
+                return struct.pack("4s", tag)
+            def decode_tag(blob):
+                (tag,) = struct.unpack("4s", blob)
+                return tag
+        """)
+        assert "NNL505" not in rules_of(clean)
+
+    def test_nnl505_unsorted_items_in_encoder(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "transport", """
+            def encode_meta(meta):
+                out = []
+                for k, v in meta.items():
+                    out.append((k, v))
+                return out
+        """)
+        errs = [d for d in bad if d.rule == "NNL505"]
+        assert errs and "insertion order" in errs[0].message
+        good = _lint_snippet(tmp_path, "transport", """
+            def encode_meta(meta):
+                out = []
+                for k, v in sorted(meta.items()):
+                    out.append((k, v))
+                return out
+        """)
+        assert "NNL505" not in rules_of(good)
+
+    def test_nnl505_decoder_iteration_exempt(self, tmp_path):
+        # only ENCODERS emit bytes; decode-side iteration is order-free
+        clean = _lint_snippet(tmp_path, "transport", """
+            def decode_meta(meta):
+                return [(k, v) for k, v in meta.items()]
+        """)
+        assert "NNL505" not in rules_of(clean)
+
+
+class TestScopeAndPragmas:
+    BAD = """
+        import struct
+        def decode_list(blob):
+            (n,) = struct.unpack_from("<I", blob, 0)
+            return [i for i in range(n)]
+    """
+
+    def test_non_wire_files_are_exempt(self, tmp_path):
+        assert _lint_snippet(tmp_path, "elements", self.BAD) == []
+
+    def test_wire_filenames_outside_wire_dirs(self, tmp_path):
+        f = tmp_path / "serialize.py"
+        f.write_text(textwrap.dedent(self.BAD))
+        assert "NNL502" in rules_of(lint_protocol([f], root=str(tmp_path)))
+
+    def test_pragma_suppresses(self, tmp_path):
+        clean = _lint_snippet(tmp_path, "query", """
+            import struct
+            def decode_list(blob):
+                (n,) = struct.unpack_from("<I", blob, 0)
+                # nnlint: disable=NNL502 — bounded by caller
+                return [i for i in range(n)]
+        """)
+        assert "NNL502" not in rules_of(clean)
+
+    def test_skip_file(self, tmp_path):
+        clean = _lint_snippet(
+            tmp_path, "query", "# nnlint: skip-file\n" + self.BAD)
+        assert clean == []
+
+    def test_unparsable_wire_file(self, tmp_path):
+        bad = _lint_snippet(tmp_path, "query", "def broken(:\n")
+        assert "NNL100" in rules_of(bad)
+
+
+# ---------------------------------------------------------------------------
+# the self-lint regression gate: the shipped wire stack is NNL5xx-clean
+# ---------------------------------------------------------------------------
+
+class TestSelfLint:
+    def test_tree_has_zero_protocol_findings(self):
+        from pathlib import Path
+
+        import nnstreamer_tpu
+
+        pkg = Path(nnstreamer_tpu.__file__).parent
+        diags = lint_protocol([pkg], root=str(pkg.parent))
+        assert [d.format() for d in diags] == []
+
+    def test_strict_cli_gate_with_family_filter(self, capsys):
+        from pathlib import Path
+
+        import nnstreamer_tpu
+
+        pkg = Path(nnstreamer_tpu.__file__).parent
+        assert lint_main(["--strict", "--rules", "NNL5xx", str(pkg)]) == 0
+        capsys.readouterr()
+
+    def test_rules_catalog_lists_family(self, capsys):
+        assert lint_main(["--rules", "list,NNL5xx"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in ("NNL501", "NNL502", "NNL503", "NNL504", "NNL505"):
+            assert rule_id in out

@@ -1,0 +1,335 @@
+"""NNS_WIREFUZZ: structure-aware frame fuzzer (tools/wirefuzz.py) + the
+sanitizer scorekeeper (analysis/sanitizer.py fourth half).
+
+Covers the scorekeeper ledger units, mutation-catalog determinism and
+coverage, the hostile-peer contract on all three surfaces (offline
+decoders, shm ring, live QueryServer), and the negotiation version-skew
+regression cells this PR hardened."""
+import random
+import socket
+import struct
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import transport
+from nnstreamer_tpu.analysis import sanitizer
+from nnstreamer_tpu.core import Buffer
+from nnstreamer_tpu.core.serialize import pack_tensors, unpack_tensors
+from nnstreamer_tpu.query.protocol import MsgType, recv_msg, send_msg
+from nnstreamer_tpu.query.server import QueryServer
+from nnstreamer_tpu.transport.frame import FrameError
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import wirefuzz  # noqa: E402
+
+CAPS = "other/tensors,format=static,dimensions=8,types=float32"
+
+
+@pytest.fixture
+def armed():
+    was = sanitizer.wirefuzz_enabled()
+    sanitizer.enable_wirefuzz()
+    yield
+    if was:
+        sanitizer.reset_wirefuzz()
+    else:
+        sanitizer.disable_wirefuzz()
+
+
+# ---------------------------------------------------------------------------
+# scorekeeper ledger units
+# ---------------------------------------------------------------------------
+
+class TestWirefuzzLedger:
+    def test_typed_and_clean_outcomes_are_not_violations(self, armed):
+        sanitizer.note_mutant("s", "m1", "typed", "FrameError: x")
+        sanitizer.note_mutant("s", "m2", "clean")
+        assert sanitizer.wirefuzz_violations() == []
+        rep = sanitizer.wirefuzz_report()
+        assert rep["mutants_total"] == 2
+        assert rep["typed"] == 1 and rep["clean"] == 1
+
+    @pytest.mark.wirefuzz_ok
+    def test_hang_crash_silent_record_violations(self, armed):
+        sanitizer.note_mutant("s", "m1", "hang", "6.0s > 5.0s")
+        sanitizer.note_mutant("s", "m2", "crash", "KeyError: boom")
+        sanitizer.note_mutant("s", "m3", "silent", "parity failed")
+        rows = sanitizer.wirefuzz_violations()
+        assert [r["outcome"] for r in rows] == ["hang", "crash", "silent"]
+        rep = sanitizer.wirefuzz_report()
+        assert rep["hangs"] == 1 and rep["crashes"] == 1
+        assert rep["silent"] == 1
+        assert len(rep["violations"]) == 3
+
+    def test_per_surface_breakdown(self, armed):
+        sanitizer.note_mutant("decode_frame", "a", "typed")
+        sanitizer.note_mutant("decode_frame", "b", "typed")
+        sanitizer.note_mutant("shm_ring", "c", "clean")
+        surfaces = sanitizer.wirefuzz_report()["surfaces"]
+        assert surfaces["decode_frame"]["typed"] == 2
+        assert surfaces["shm_ring"]["clean"] == 1
+
+    def test_frame_events_counted(self, armed):
+        sanitizer.note_frame_event("stage_x", 128)
+        sanitizer.note_frame_event("stage_x", 64)
+        frames = sanitizer.wirefuzz_report()["frames"]
+        assert frames["stage_x"] == {"frames": 2, "bytes": 192}
+
+    def test_codec_choke_points_feed_the_ledger(self, armed):
+        def count(stage):
+            entry = sanitizer.wirefuzz_report()["frames"].get(stage)
+            return entry["frames"] if entry else 0
+
+        before = count("wire:encode"), count("wire:decode")
+        buf = Buffer([np.zeros((2, 2), np.float32)])
+        transport.decode_frame(bytes(transport.encode_frame_bytes(buf)))
+        assert count("wire:encode") > before[0]
+        assert count("wire:decode") > before[1]
+
+    def test_disabled_fast_path_records_nothing(self):
+        was = sanitizer.wirefuzz_enabled()
+        sanitizer.disable_wirefuzz()
+        try:
+            sanitizer.note_mutant("ghost", "m", "crash", "never seen")
+            sanitizer.note_frame_event("ghost", 1)
+            assert sanitizer.wirefuzz_violations() == []
+            assert sanitizer.wirefuzz_report()["mutants_total"] == 0
+        finally:
+            if was:
+                sanitizer.enable_wirefuzz()
+
+    @pytest.mark.wirefuzz_ok
+    def test_reset_clears_the_scoreboard(self, armed):
+        sanitizer.note_mutant("s", "m", "crash", "x")
+        sanitizer.reset_wirefuzz()
+        assert sanitizer.wirefuzz_violations() == []
+        assert sanitizer.wirefuzz_report()["mutants_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# mutation catalog: deterministic, structure-aware, broad
+# ---------------------------------------------------------------------------
+
+def _nnsb_blob(seed=19, json_safe=False):
+    rng = random.Random(seed)
+    buf = wirefuzz._baseline_buffers(rng, json_safe=json_safe)[0][1]
+    return bytes(transport.encode_frame_bytes(buf))
+
+
+class TestMutationCatalog:
+    def test_nnsb_catalog_is_deterministic(self):
+        blob = _nnsb_blob()
+        a = list(wirefuzz.nnsb_mutants(blob, random.Random(19)))
+        b = list(wirefuzz.nnsb_mutants(blob, random.Random(19)))
+        assert a == b
+        assert len(a) >= 60
+
+    def test_nnst_catalog_is_deterministic(self):
+        rng = random.Random(19)
+        buf = wirefuzz._baseline_buffers(rng, json_safe=True)[0][1]
+        blob = bytes(pack_tensors(buf))
+        a = list(wirefuzz.nnst_mutants(blob, random.Random(7)))
+        b = list(wirefuzz.nnst_mutants(blob, random.Random(7)))
+        assert a == b
+        assert len(a) >= 15
+
+    def test_catalog_covers_every_mutation_family(self):
+        names = [m for m, _ in wirefuzz.nnsb_mutants(_nnsb_blob(),
+                                                     random.Random(19))]
+        for family in ("truncate@", "bitflip:magic", "bitflip:payload",
+                       "ntensors=", "metalen=", "version=", "magic=NNST",
+                       "t0:dtype", "t0:rank", "t0:nbytes", "t0:dim0",
+                       "meta:count=max", "meta:badtag"):
+            assert any(n.startswith(family) for n in names), family
+
+    def test_every_offline_mutant_is_typed_or_parity_clean(self):
+        blob = _nnsb_blob()
+        base = transport.decode_frame(blob)
+        for mutation, mutant in wirefuzz.nnsb_mutants(blob,
+                                                      random.Random(19)):
+            try:
+                out = transport.decode_frame(mutant)
+            except ValueError:
+                continue  # typed: FrameError is a ValueError
+            # survivors must re-encode/re-decode to the same buffer
+            rt = transport.decode_frame(
+                bytes(transport.encode_frame_bytes(out)))
+            assert wirefuzz._buffers_equal(out, rt), mutation
+
+    def test_trailing_bytes_regression(self):
+        """A zeroed tensor count used to decode 'successfully', silently
+        ignoring every payload byte — the frame must now account for all
+        of its bytes (transport/frame.py full-consumption check)."""
+        blob = bytearray(_nnsb_blob())
+        struct.pack_into("<I", blob, 8, 0)  # ntensors = 0
+        with pytest.raises(FrameError, match="trailing bytes"):
+            transport.decode_frame(bytes(blob))
+
+
+# ---------------------------------------------------------------------------
+# surfaces end-to-end (smoke-scale): zero contract violations
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_decode_surface_smoke(self, armed):
+        before = sanitizer.wirefuzz_report()["mutants_total"]
+        wirefuzz.run_decode_surface(random.Random(19), smoke=True)
+        rep = sanitizer.wirefuzz_report()
+        assert rep["mutants_total"] - before >= 60
+        assert sanitizer.wirefuzz_violations() == []
+
+    def test_shm_surface(self, armed):
+        wirefuzz.run_shm_surface(random.Random(19))
+        rep = sanitizer.wirefuzz_report()
+        assert rep["surfaces"]["shm_ring"]["typed"] >= 10
+        assert sanitizer.wirefuzz_violations() == []
+
+    def test_live_server_surface_smoke(self, armed):
+        wirefuzz.run_live_surface(random.Random(19), smoke=True)
+        rep = sanitizer.wirefuzz_report()
+        per = rep["surfaces"]["query_server"]
+        assert sum(per.values()) >= 5
+        assert sanitizer.wirefuzz_violations() == []
+
+
+# ---------------------------------------------------------------------------
+# negotiation version-skew regression cells (this PR's hardening)
+# ---------------------------------------------------------------------------
+
+def _echo_pump(srv, stop):
+    while not stop.is_set():
+        try:
+            item = srv.inbox.get(timeout=0.05)
+        except Exception:
+            continue
+        if isinstance(item, tuple):
+            continue
+        cid = item.meta.pop("client_id")
+        idx = item.meta.pop("_qserve_idx", None)
+        srv.send(cid, item, mark_idx=idx)
+
+
+class _EchoServer:
+    def __enter__(self):
+        self.srv = QueryServer().start()
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=_echo_pump,
+                                   args=(self.srv, self._stop), daemon=True)
+        self._t.start()
+        return self.srv
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(timeout=5)
+        self.srv.stop()
+
+
+class TestVersionSkew:
+    def test_old_client_new_server_stays_json(self):
+        """A pre-NNSB client offers PLAIN caps (no nns-wire structure);
+        the new server must reply with caps the old parser understands
+        and answer in NNST — never binary frames the old peer cannot
+        decode."""
+        with _EchoServer() as srv:
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5.0)
+            s.settimeout(5.0)
+            try:
+                send_msg(s, MsgType.CAPABILITY, CAPS.encode())
+                msg = recv_msg(s)
+                assert msg is not None and msg[0] is MsgType.CAPABILITY
+                reply = msg[1].decode()
+                assert "nns-wire" not in reply and "selected" not in reply
+                buf = Buffer([np.full(8, 3.0, np.float32)])
+                send_msg(s, MsgType.DATA, bytes(pack_tensors(buf)))
+                msg = recv_msg(s)
+                assert msg is not None and msg[0] is MsgType.DATA
+                assert not transport.is_binary_frame(msg[1])
+                out = unpack_tensors(msg[1])
+                assert np.allclose(np.asarray(out.tensors[0]), 3.0)
+            finally:
+                s.close()
+
+    def test_garbage_caps_token_is_typed_not_fatal(self):
+        """Undecodable capability bytes must produce a typed ERROR or a
+        drop on THAT link; the server keeps serving the next client."""
+        with _EchoServer() as srv:
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5.0)
+            s.settimeout(5.0)
+            try:
+                send_msg(s, MsgType.CAPABILITY, b"\xff\xfe\x00garbage")
+                msg = recv_msg(s)
+                assert msg is None or msg[0] is MsgType.ERROR
+            except ConnectionError:
+                pass  # typed drop is equally acceptable
+            finally:
+                s.close()
+            # the server survived: a well-formed client still negotiates
+            s2 = socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=5.0)
+            s2.settimeout(5.0)
+            try:
+                send_msg(s2, MsgType.CAPABILITY, CAPS.encode())
+                msg = recv_msg(s2)
+                assert msg is not None and msg[0] is MsgType.CAPABILITY
+            finally:
+                s2.close()
+
+    def test_unknown_msg_type_is_typed_connection_error(self):
+        """A frame with an unknown NNSQ message type must surface as the
+        torn-frame family on the reading side, not a raw ValueError from
+        the enum constructor."""
+        with _EchoServer() as srv:
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5.0)
+            s.settimeout(5.0)
+            try:
+                send_msg(s, MsgType.CAPABILITY, CAPS.encode())
+                msg = recv_msg(s)
+                assert msg is not None
+                hdr = struct.Struct("<4sBQ")
+                s.sendall(hdr.pack(b"NNSQ", 99, 4) + b"\x00" * 4)
+                # server drops the link: EOF or reset on our next read
+                try:
+                    assert recv_msg(s) is None
+                except ConnectionError:
+                    pass
+            finally:
+                s.close()
+
+
+# ---------------------------------------------------------------------------
+# harness entrypoint
+# ---------------------------------------------------------------------------
+
+class TestHarness:
+    def test_smoke_run_passes_and_records(self, tmp_path, armed):
+        out = tmp_path / "wf.json"
+        assert wirefuzz.main(["--smoke", "--seed", "19",
+                              "--json", str(out)]) == 0
+        import json
+
+        report = json.loads(out.read_text())
+        assert report["verdict"] == "PASS"
+        assert report["mutants_total"] > 0
+        assert report["violations"] == []
+        assert report["seed"] == 19
+
+    def test_recorded_full_run_scoreboard(self):
+        """WIREFUZZ_r19.json is the committed full-catalog run: keep it
+        honest (PASS, all three surfaces, zero violations)."""
+        import json
+
+        rec = Path(__file__).resolve().parent.parent / "WIREFUZZ_r19.json"
+        report = json.loads(rec.read_text())
+        assert report["verdict"] == "PASS"
+        assert report["violations"] == []
+        assert report["typed"] + report["clean"] == report["mutants_total"]
+        for surface in ("decode_frame", "unpack_tensors", "shm_ring",
+                        "query_server"):
+            assert surface in report["surfaces"], surface

@@ -32,12 +32,18 @@ Scenarios
                    (service/procreplica.py) under traffic: evict →
                    autoscaler respawn → readmit, zero client-visible
                    errors.
+``wire-corruption``  fuzz the NNSB mutation catalog (tools/wirefuzz.py)
+                   into live connections of one replica under traffic:
+                   typed outcomes on the poisoned links only, zero
+                   errors for other clients, threads + shm slots
+                   reclaimed (LEAKCHECK-clean).
 
 Usage::
 
     python tools/chaos.py                 # all scenarios, JSON report
     python tools/chaos.py --smoke         # CI: replica-kill + conn-kill +
                                           # load-ramp + proc-replica-kill
+                                          # + shm-peer-kill + wire-corruption
     python tools/chaos.py --scenario partition
     NNS_TSAN=1 python tools/chaos.py      # under the lock sanitizer
 
@@ -563,6 +569,93 @@ def shm_peer_kill(mgr, duration: float) -> dict:
             "ok": leg_a["ok"] and leg_b["ok"]}
 
 
+@_scenario("wire-corruption")
+def wire_corruption(mgr, duration: float) -> dict:
+    """Fuzz the NNSB mutation catalog into live connections of ONE
+    replica of a 3-replica fabric under traffic (tools/wirefuzz.py is
+    the shared catalog). The hostile-peer gate, now fleet-scale: every
+    poisoned frame resolves as a TYPED outcome on the poisoned link
+    only (server drop / typed ERROR / clean model answer — never a
+    hang), the OTHER clients see zero errors, and every thread and shm
+    slot the fuzzed links touched is reclaimed (LEAKCHECK-clean)."""
+    import random
+    import socket as _socket
+
+    from nnstreamer_tpu import transport
+    from nnstreamer_tpu.analysis import sanitizer
+    from nnstreamer_tpu.query.protocol import MsgType, recv_msg, send_msg
+
+    import wirefuzz  # tools/ sibling: the shared mutation catalog
+
+    had_leakcheck = sanitizer.leakcheck_enabled()
+    if not had_leakcheck:
+        sanitizer.enable_leakcheck()
+    kinds = ("tracked_thread", "shm_segment")
+
+    def _held() -> set:
+        return {(r["kind"], r["key"]) for k in kinds
+                for r in sanitizer.outstanding(k)}
+
+    base_held = _held()
+    fab = _fabric(mgr, "chaos-wire")
+    try:
+        _warmup(fab)
+        port = fab._bound_port(fab.services()[0])
+        rng = random.Random(19)
+        baseline = wirefuzz._baseline_buffers(rng, json_safe=True)[0][1]
+        blob = bytes(transport.encode_frame_bytes(baseline))
+        mutants = list(wirefuzz.nnsb_mutants(blob, rng))
+        typed = clean = 0
+        untyped: list = []
+
+        def _inject(mutation: str, mutant: bytes) -> None:
+            nonlocal typed, clean
+            s = _socket.create_connection(("127.0.0.1", port), timeout=5.0)
+            s.settimeout(5.0)
+            try:
+                send_msg(s, MsgType.CAPABILITY, CAPS.encode())
+                msg = recv_msg(s)
+                assert msg is not None and msg[0] is MsgType.CAPABILITY
+                send_msg(s, MsgType.DATA, mutant)
+                try:
+                    answer = recv_msg(s)
+                except _socket.timeout:
+                    untyped.append(f"{mutation}: no answer and no close")
+                    return
+                except (ConnectionError, OSError):
+                    typed += 1  # torn mid-read: the link died, typed
+                    return
+                if answer is None or answer[0] is MsgType.ERROR:
+                    typed += 1  # dropped link / typed ERROR frame
+                else:
+                    clean += 1  # mutant decoded coherently; model answered
+            finally:
+                s.close()
+
+        with Traffic(fab) as tr:
+            time.sleep(duration / 4)
+            for mutation, mutant in mutants:
+                try:
+                    _inject(mutation, mutant)
+                except Exception as e:  # noqa: BLE001 - every one gates
+                    untyped.append(
+                        f"{mutation}: {type(e).__name__}: {e}")
+            time.sleep(duration / 4)
+        snap = fab.snapshot()
+    finally:
+        fab.stop()
+    leaked = sorted(f"{k}:{key}" for (k, key) in _held() - base_held)
+    if not had_leakcheck:
+        sanitizer.disable_leakcheck()
+    return {"requests": tr.ok, "errors": tr.errors,
+            "mutants_injected": len(mutants),
+            "typed": typed, "clean": clean, "untyped": untyped,
+            "leaked": leaked, "retries": snap["retries"],
+            "ok": (not tr.errors and tr.ok > 0 and not untyped
+                   and not leaked and typed > 0
+                   and typed + clean == len(mutants))}
+
+
 @_scenario("rolling-swap")
 def rolling_swap(mgr, duration: float) -> dict:
     """Roll the model slot across all replicas under traffic; zero
@@ -638,7 +731,8 @@ def main() -> int:
         sanitizer.enable(hold_warn_s=5.0)
     if args.smoke:
         scenarios = ["replica-kill", "conn-kill", "load-ramp",
-                     "proc-replica-kill", "shm-peer-kill"]
+                     "proc-replica-kill", "shm-peer-kill",
+                     "wire-corruption"]
         duration = args.duration or 2.0
     elif args.scenario:
         scenarios = [args.scenario]

@@ -392,6 +392,60 @@ def xfercheck_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     }
 
 
+def wirefuzz_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
+    """NNS_WIREFUZZ scorekeeper cost on the wire codec round trip — the
+    one hot path it hooks (``_note_wire_bytes`` fires per encode and per
+    decode in transport/frame.py). Same three-state protocol and
+    min-of-pairs gate as the leakcheck/xfercheck legs:
+
+    * ``baseline`` — wirefuzz never enabled in this leg's pair;
+    * ``enabled``  — ``sanitizer.enable_wirefuzz()`` (frame ledger
+      recording per codec call) — REPORTED, not gated;
+    * ``disabled`` — after ``disable_wirefuzz()``: back to the
+      one-module-global check, gated at <= 2% vs its paired baseline.
+    """
+    import statistics
+
+    import numpy as np
+
+    from nnstreamer_tpu import transport
+    from nnstreamer_tpu.analysis import sanitizer as nns_sanitizer
+    from nnstreamer_tpu.core import Buffer
+
+    buf = Buffer([np.zeros((16,), np.float32)], meta={"tag": "bench"})
+
+    def roundtrip(n: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            transport.decode_frame(bytes(transport.encode_frame_bytes(buf)))
+        return (time.perf_counter() - t0) / n
+
+    roundtrip(max(200, n_bufs // 4))  # warmup
+    baselines, disableds, enabled = [], [], None
+    for _ in range(attempts):
+        baselines.append(roundtrip(n_bufs))
+        nns_sanitizer.enable_wirefuzz()
+        try:
+            if enabled is None:
+                enabled = roundtrip(n_bufs)
+        finally:
+            nns_sanitizer.disable_wirefuzz()
+        disableds.append(roundtrip(n_bufs))
+    ratios = [d / b for b, d in zip(baselines, disableds)]
+    baseline = min(baselines)
+    return {
+        "n_frames": n_bufs,
+        "attempts": attempts,
+        "baseline_us_per_frame": baseline * 1e6,
+        "enabled_us_per_frame": enabled * 1e6,
+        "disabled_us_per_frame": min(disableds) * 1e6,
+        "pair_ratios": [round(r, 4) for r in ratios],
+        "disabled_overhead_frac": min(ratios) - 1.0,
+        "disabled_overhead_frac_median": statistics.median(ratios) - 1.0,
+        "enabled_overhead_frac": enabled / baseline - 1.0,
+    }
+
+
 def placement_overhead_report(n_bufs: int, attempts: int = 3) -> dict:
     """Placement cost on an 8-element fused DEVICE chain: per-buffer
     steady state with a plan applied vs ``place`` off, same min-of-pairs
@@ -464,6 +518,7 @@ def main() -> None:
         quality = quality_overhead_report(n_bufs=1500, attempts=4)
         leakcheck = leakcheck_overhead_report(n_bufs=2000, attempts=4)
         xfercheck = xfercheck_overhead_report(n_bufs=1500, attempts=4)
+        wirefuzz = wirefuzz_overhead_report(n_bufs=2000, attempts=4)
         best["tracing_overhead"] = tracing
         best["profiler_overhead"] = profiling
         best["placement_overhead"] = placement
@@ -471,6 +526,7 @@ def main() -> None:
         best["quality_overhead"] = quality
         best["leakcheck_overhead"] = leakcheck
         best["xfercheck_overhead"] = xfercheck
+        best["wirefuzz_overhead"] = wirefuzz
         print(json.dumps(best, indent=2))
         ok = best["speedup_marginal"] >= 2.0
         print(f"smoke: fused marginal speedup {best['speedup_marginal']:.1f}x "
@@ -528,15 +584,23 @@ def main() -> None:
               f"{xfercheck['disabled_overhead_frac'] * 100:+.2f}% vs "
               f"baseline (gate <= 2%), enabled mode "
               f"{xfercheck['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
+        wf_ok = wirefuzz["disabled_overhead_frac"] <= 0.02
+        verdict = ("OK" if wf_ok
+                   else "REGRESSION — disabled wirefuzz is not free "
+                        "anymore")
+        print(f"smoke: wirefuzz-disabled fast path "
+              f"{wirefuzz['disabled_overhead_frac'] * 100:+.2f}% vs "
+              f"baseline (gate <= 2%), enabled mode "
+              f"{wirefuzz['enabled_overhead_frac'] * 100:+.1f}% ({verdict})")
         sys.exit(0 if ok and trc_ok and prof_ok and plc_ok and mem_ok
-                 and qual_ok and leak_ok and xc_ok else 1)
+                 and qual_ok and leak_ok and xc_ok and wf_ok else 1)
 
     n_bufs = args.n_frames
     report = {"n_frames": n_bufs, "host_chain": [], "device_chain": None,
               "tracing_overhead": None, "profiler_overhead": None,
               "placement_overhead": None, "memory_overhead": None,
               "quality_overhead": None, "leakcheck_overhead": None,
-              "xfercheck_overhead": None}
+              "xfercheck_overhead": None, "wirefuzz_overhead": None}
     # before any other measurement: the baseline leg requires a process
     # where tracing has never been enabled
     report["tracing_overhead"] = tracing_overhead_report(
@@ -595,6 +659,15 @@ def main() -> None:
         n_bufs=min(n_bufs, 2000))
     t = report["xfercheck_overhead"]
     print("— xfercheck overhead (8-element fused device chain) —")
+    print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
+          f"enabled {t['enabled_us_per_frame']:8.1f} "
+          f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
+          f"disabled {t['disabled_us_per_frame']:8.1f} "
+          f"({t['disabled_overhead_frac'] * 100:+.2f}%, gate <= 2%)")
+    report["wirefuzz_overhead"] = wirefuzz_overhead_report(
+        n_bufs=min(n_bufs, 2000))
+    t = report["wirefuzz_overhead"]
+    print("— wirefuzz overhead (wire codec round trip) —")
     print(f"baseline {t['baseline_us_per_frame']:8.1f} us/frame | "
           f"enabled {t['enabled_us_per_frame']:8.1f} "
           f"({t['enabled_overhead_frac'] * 100:+.1f}%) | "
