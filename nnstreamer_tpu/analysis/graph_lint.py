@@ -432,7 +432,11 @@ def _check_filter_hazards(elements, est) -> List[Diagnostic]:
                  "construction: a shape-poly AOT artifact (NNS_AOT_CACHE, "
                  "docs/aot.md) covers every batch size with ONE "
                  "compilation — trailing dims stay concrete, so bucket "
-                 "those upstream first; NNL015 reports coverage"))
+                 "those upstream first; NNL015 reports coverage. For LM "
+                 "PROMPTS specifically the retirement is chunked prefill "
+                 "(serving.PagedLMEngine, docs/serving.md#paged-kv): the "
+                 "fixed chunk is the ONLY compiled prefill shape, so "
+                 "compile_count stays flat across prompt lengths"))
     return diags
 
 

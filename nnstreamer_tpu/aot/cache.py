@@ -207,6 +207,18 @@ def _attach_xla_cache(root: str) -> None:
     _xla_attached = xdir
 
 
+def attach_xla_cache(root: Optional[str] = None) -> bool:
+    """Public attach point for planes that jit directly instead of going
+    through :class:`CompileCache` (the paged serving engine keys its
+    draft AND target executables here): point XLA's persistent cache at
+    the env-configured root. Returns False when the AOT plane is off."""
+    root = root or os.environ.get(CACHE_ENV, "").strip()
+    if not root:
+        return False
+    _attach_xla_cache(root)
+    return True
+
+
 class CompileCache:
     """On-disk store of exported stage programs, keyed by (topology,
     caps, model version, device signature, jax version) × (stage id,

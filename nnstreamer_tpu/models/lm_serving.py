@@ -287,14 +287,52 @@ class _LMServingEntry:
 
         return stream
 
-    def make_continuous(self, slots: int = 4, mesh=None):
+    def make_continuous(self, slots: int = 4, mesh=None,
+                        paged: bool = False, draft=None,
+                        spec_k: int = 4, **paged_kw):
         """Continuous-batching decode state for the serving layer: a
         fixed-``slots`` engine where sequences join/retire independently
         between decode steps (``serving.DecodeScheduler`` drives it).
-        Params honor the entry's serve knobs (serve_dtype, cache_len)."""
+        Params honor the entry's serve knobs (serve_dtype, cache_len).
+
+        ``paged=True`` builds the block-table
+        :class:`~...serving.PagedLMEngine` (``paged_kw``: page_size /
+        pages / chunk / share_prefixes — see docs/serving.md §paged KV).
+        ``draft`` additionally wraps it in
+        :class:`~...serving.SpeculativeLMEngine`: pass a draft object
+        (``NgramDraft()``), a draft ``_LMServingEntry`` (becomes a
+        ``ModelDraft`` over its own params), or the string ``"ngram"``;
+        ``spec_k`` is the draft burst length verified per target call."""
         from ..serving.lm_engine import from_entry
 
-        return from_entry(self, slots=slots, mesh=mesh)
+        eng = from_entry(self, slots=slots, mesh=mesh, paged=paged,
+                         **paged_kw)
+        if draft is None:
+            return eng
+        if not paged:
+            raise ValueError(
+                "speculative decode rides the paged engine "
+                "(verify() needs block tables); pass paged=True")
+        from ..serving.speculative import (
+            ModelDraft,
+            NgramDraft,
+            SpeculativeLMEngine,
+        )
+
+        if isinstance(draft, str):
+            if draft != "ngram":
+                raise ValueError(f"unknown draft spec {draft!r}")
+            draft = NgramDraft()
+        elif isinstance(draft, _LMServingEntry):
+            dcfg = draft._cfg_serve
+            if dcfg.vocab != self._cfg_serve.vocab:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab} != target vocab "
+                    f"{self._cfg_serve.vocab}: speculative verify "
+                    "compares token ids, the vocabularies must match")
+            dparams, _ = draft._shard_params(None)
+            draft = ModelDraft(dcfg, dparams)
+        return SpeculativeLMEngine(eng, draft, k=spec_k)
 
     def make_session(self, mesh=None, temperature: float = 0.0):
         """Stateful multi-turn serving: ``session.generate(tokens, steps)``
@@ -324,6 +362,11 @@ class _StreamSession:
 # test-size entry: heads=4 supports tp in {1,2,4}; max_seq bounds P+steps
 tiny = _LMServingEntry(
     TransformerConfig(vocab=64, dim=32, heads=4, layers=2, max_seq=64))
+
+# draft companion to ``tiny`` for speculative decode (same vocab — verify
+# compares token ids; half the width, one layer: cheap proposals)
+tiny_draft = _LMServingEntry(
+    TransformerConfig(vocab=64, dim=16, heads=2, layers=1, max_seq=64))
 
 # bench-size entry (~raises to a realistic serving shape on a real chip)
 base = _LMServingEntry(

@@ -841,7 +841,20 @@ def render_section(mem_snap: dict) -> List[str]:
         lines.append("")
         lines.append("MEMORY (serving)")
         for name, snap in sorted(serving.items()):
-            lines.append(f"  {name:<40} {_fmt_bytes(snap.get('bytes')):>10}"
-                         + (f"  peak {_fmt_bytes(snap['peak_bytes'])}"
-                            if "peak_bytes" in snap else ""))
+            row = f"  {name:<40} {_fmt_bytes(snap.get('bytes')):>10}"
+            if "peak_bytes" in snap:
+                row += f"  peak {_fmt_bytes(snap['peak_bytes'])}"
+            if "pages_total" in snap:
+                # paged-KV engines: occupancy answers "how close is the
+                # pool to preempting", sharing answers "is prefix COW
+                # earning its keep"
+                total = snap["pages_total"] or 1
+                row += (f"  pages {snap.get('pages_used', 0)}/"
+                        f"{snap['pages_total']}"
+                        f" ({snap.get('pages_used', 0) / total * 100:.0f}%)")
+                if snap.get("pages_shared"):
+                    row += f"  shared {snap['pages_shared']}"
+            if "spec_acceptance_rate" in snap:
+                row += f"  accept {snap['spec_acceptance_rate'] * 100:.0f}%"
+            lines.append(row)
     return lines

@@ -837,6 +837,82 @@ class CanaryQuality:
         return True, "", rep
 
 
+class SpecAcceptanceGate:
+    """Promote arbitration for speculative-decode (draft, target) pairs
+    (service/models.py slots carry the pair; serving/speculative.py
+    produces the rate). Acceptance is a PERFORMANCE contract, not a
+    correctness one — speculative output is token-identical to
+    target-only by construction — so the gate guards throughput: a
+    draft that stops predicting its target decodes SLOWER than no draft
+    at all (every round still pays K draft steps + one verify), and a
+    candidate pair must not regress the acceptance the fleet currently
+    earns.
+
+    ``min_rate``: absolute floor for the candidate pair's acceptance;
+    ``max_drop``: largest tolerated drop vs the active pair's recorded
+    rate (ignored when no baseline exists yet);
+    ``min_rounds``: speculative rounds the candidate must have run
+    before a verdict is meaningful (same stance as
+    :class:`QualityGate.min_samples`: unobserved ⇒ unpromotable).
+    """
+
+    def __init__(self, min_rate: float = 0.0, max_drop: float = 0.15,
+                 min_rounds: int = 16):
+        if not 0.0 <= min_rate <= 1.0:
+            raise ValueError(f"min_rate={min_rate} must be in [0, 1]")
+        if max_drop < 0.0:
+            raise ValueError(f"max_drop={max_drop} must be >= 0")
+        if min_rounds < 1:
+            raise ValueError(f"min_rounds={min_rounds} must be >= 1")
+        self.min_rate = float(min_rate)
+        self.max_drop = float(max_drop)
+        self.min_rounds = int(min_rounds)
+
+    @classmethod
+    def from_config(cls, cfg) -> Optional["SpecAcceptanceGate"]:
+        """Same contract as :meth:`QualityGate.from_config`."""
+        if cfg is None or cfg is False:
+            return None
+        if cfg is True:
+            return cls()
+        if isinstance(cfg, cls):
+            return cfg
+        if isinstance(cfg, dict):
+            return cls(**cfg)
+        raise ValueError(
+            f"acceptance_gate must be a bool, dict, or SpecAcceptanceGate "
+            f"(got {type(cfg).__name__})")
+
+    def spec(self) -> dict:
+        return {"min_rate": self.min_rate, "max_drop": self.max_drop,
+                "min_rounds": self.min_rounds}
+
+    def verdict(self, candidate: Optional[dict],
+                baseline: Optional[dict] = None) -> Tuple[bool, str]:
+        """(ok, reason). ``candidate``/``baseline`` are
+        ``{"rate": float, "rounds": int}`` observations (``None`` =
+        never observed). A missing or under-sampled candidate refuses;
+        a missing baseline gates on the absolute floor only."""
+        if candidate is None:
+            return False, ("no speculative-acceptance observation for the "
+                           "candidate pair (run it under live/canary "
+                           "traffic first)")
+        rate = float(candidate.get("rate", 0.0))
+        rounds = int(candidate.get("rounds", 0))
+        if rounds < self.min_rounds:
+            return False, (f"insufficient speculative rounds ({rounds} < "
+                           f"{self.min_rounds})")
+        if rate < self.min_rate:
+            return False, (f"acceptance {rate:.3f} below floor "
+                           f"{self.min_rate:g}")
+        if baseline is not None:
+            base = float(baseline.get("rate", 0.0))
+            if base - rate > self.max_drop:
+                return False, (f"acceptance {rate:.3f} regresses baseline "
+                               f"{base:.3f} by more than {self.max_drop:g}")
+        return True, ""
+
+
 GATE_REFUSALS = obs_metrics.counter(
     "nns_quality_gate_refusals_total",
     "canary promotions refused by the output-quality gate")

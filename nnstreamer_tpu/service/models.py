@@ -156,20 +156,37 @@ class ModelSlots:
 
     # -- definition ----------------------------------------------------------
     def define(self, name: str, versions: Dict[str, str],
-               active: str) -> None:
+               active: str, drafts: Optional[Dict[str, str]] = None) -> None:
         """Create/replace a slot: ``versions`` maps version → model URI
-        (any form tensor_filter accepts). Publishes ``registry://name``."""
+        (any form tensor_filter accepts). Publishes ``registry://name``.
+
+        ``drafts`` maps a version to its speculative-decode DRAFT
+        companion URI: the slot then carries (draft, target) as a pair —
+        rollouts move both together, and :meth:`promote_canary` can
+        arbitrate the pair's draft-acceptance rate
+        (docs/service.md#draft-target-slots)."""
         if active not in versions:
             raise KeyError(f"slot '{name}': active version '{active}' not "
                            f"in {sorted(versions)}")
+        drafts = dict(drafts or {})
+        unknown = sorted(set(drafts) - set(versions))
+        if unknown:
+            raise KeyError(f"slot '{name}': draft(s) for unknown "
+                           f"version(s) {unknown}")
         with self._lock:
             self._slots[name] = {"versions": dict(versions),
-                                 "active": active, "canary": None}
+                                 "active": active, "canary": None,
+                                 "drafts": drafts,
+                                 "spec_acceptance": {}}
         self._publish(name)
 
-    def add_version(self, name: str, version: str, uri: str) -> None:
+    def add_version(self, name: str, version: str, uri: str,
+                    draft: Optional[str] = None) -> None:
         with self._lock:
-            self._slot(name)["versions"][version] = uri
+            slot = self._slot(name)
+            slot["versions"][version] = uri
+            if draft is not None:
+                slot["drafts"][version] = draft
         self._publish(name)
 
     def _slot(self, name: str) -> dict:
@@ -198,6 +215,11 @@ class ModelSlots:
             slot = self._slot(name)
             out = {"versions": dict(slot["versions"]),
                    "active": slot["active"]}
+            if slot.get("drafts"):
+                out["drafts"] = dict(slot["drafts"])
+            if slot.get("spec_acceptance"):
+                out["spec_acceptance"] = {
+                    v: dict(o) for v, o in slot["spec_acceptance"].items()}
             canary = slot["canary"]
         if canary is not None:
             version, router = canary
@@ -218,6 +240,34 @@ class ModelSlots:
                 raise KeyError(f"slot '{name}' has no version '{ver}' "
                                f"(have: {sorted(slot['versions'])})")
             return slot["versions"][ver]
+
+    def draft_uri(self, name: str,
+                  version: Optional[str] = None) -> Optional[str]:
+        """The speculative-decode draft companion of ``version`` (active
+        version by default), or None — a version without a draft serves
+        target-only."""
+        with self._lock:
+            slot = self._slot(name)
+            ver = version or slot["active"]
+            if ver not in slot["versions"]:
+                raise KeyError(f"slot '{name}' has no version '{ver}' "
+                               f"(have: {sorted(slot['versions'])})")
+            return slot.get("drafts", {}).get(ver)
+
+    def note_spec_acceptance(self, name: str, version: str,
+                             rate: float, rounds: int) -> None:
+        """Record a (draft, target) pair's observed draft-acceptance
+        rate over ``rounds`` speculative rounds (the serving plane's
+        ``spec_acceptance_rate`` snapshot, or a bench canary). The most
+        recent observation per version is what
+        :meth:`promote_canary`'s acceptance gate arbitrates against."""
+        with self._lock:
+            slot = self._slot(name)
+            if version not in slot["versions"]:
+                raise KeyError(f"slot '{name}' has no version '{version}' "
+                               f"(have: {sorted(slot['versions'])})")
+            slot.setdefault("spec_acceptance", {})[version] = {
+                "rate": float(rate), "rounds": int(rounds)}
 
     # -- live bindings -------------------------------------------------------
     def bound_filters(self, name: str) -> List[Tuple[object, object]]:
@@ -373,7 +423,7 @@ class ModelSlots:
                 "filters": len(routers),
                 "quality_gate": gate.spec() if gate is not None else None}
 
-    def promote_canary(self, name: str) -> dict:
+    def promote_canary(self, name: str, acceptance_gate=None) -> dict:
         """Canary graduates: its backend becomes the active one everywhere,
         the old primary retires, and the slot's active version advances.
 
@@ -383,12 +433,41 @@ class ModelSlots:
         a typed :class:`QualityGateError` — a ``quality`` flight event
         and the ``nns_quality_gate_refusals_total`` counter record the
         refusal, and the canary stays live for more samples or a
-        ``cancel_canary``."""
+        ``cancel_canary``.
+
+        ``acceptance_gate`` additionally arbitrates speculative-decode
+        (draft, target) pairs (``True`` for defaults, a dict of
+        :class:`~..obs.quality.SpecAcceptanceGate` fields, or an
+        instance): the candidate version's recorded draft-acceptance
+        (:meth:`note_spec_acceptance`) must clear the floor and must not
+        regress the ACTIVE pair's rate beyond the gate — output parity
+        is guaranteed by construction, so this gate guards the
+        THROUGHPUT the pair was promoted to win."""
         with self._lock:
-            canary = self._slot(name)["canary"]
+            slot = self._slot(name)
+            canary = slot["canary"]
+            active = slot["active"]
+            acc = dict(slot.get("spec_acceptance", {}))
         if canary is None:
             raise SwapError(f"slot '{name}' has no canary to promote")
         version, router = canary
+        acc_gate = obs_quality.SpecAcceptanceGate.from_config(acceptance_gate)
+        if acc_gate is not None:
+            ok, reason = acc_gate.verdict(acc.get(version), acc.get(active))
+            if not ok:
+                obs_quality.GATE_REFUSALS.inc()
+                obs_flight.record(
+                    "quality", "gate_refused",
+                    {"slot": name, "version": version, "reason": reason,
+                     "gate": "spec_acceptance"})
+                logger.warning("slot %s: canary '%s' promotion REFUSED "
+                               "by acceptance gate: %s", name, version,
+                               reason)
+                raise QualityGateError(
+                    f"slot '{name}': canary '{version}' failed the "
+                    f"speculative-acceptance gate: {reason}",
+                    report={"spec_acceptance": acc,
+                            "gate": acc_gate.spec()})
         monitor = router.quality
         if monitor is not None:
             ok, reason, report = monitor.verdict()

@@ -11,7 +11,12 @@ Public surface:
 * :class:`Scheduler` / :class:`DecodeScheduler` — the two loops;
 * :class:`RequestQueue`, :class:`BatchFormer`, :class:`Request` — the
   building blocks, composable separately;
-* :class:`ContinuousLMEngine` — slot-based LM decode state;
+* :class:`ContinuousLMEngine` / :class:`PagedLMEngine` — slot-based LM
+  decode state (dense per-slot caches vs block-table paged KV pool with
+  COW prefix sharing, chunked prefill, and preempt/restore);
+* :class:`KVPagePool` — the refcounted page allocator + prefix registry;
+* :class:`SpeculativeLMEngine` (+ :class:`NgramDraft`/:class:`ModelDraft`)
+  — draft-verify decoding riding the same join/retire loop;
 * typed admission errors (:class:`AdmissionError` and friends);
 * :func:`metrics_snapshot` — per-request/per-batch observability across
   every live scheduler;
@@ -26,8 +31,14 @@ import threading
 from typing import Callable, Dict, Tuple
 
 from .batcher import Batch, BatchFormer  # noqa: F401
-from .lm_engine import ContinuousLMEngine  # noqa: F401
+from .kv_pool import KVPagePool, PagePoolExhausted  # noqa: F401
+from .lm_engine import ContinuousLMEngine, PagedLMEngine  # noqa: F401
 from .metrics import ServingMetrics, metrics_snapshot  # noqa: F401
+from .speculative import (  # noqa: F401
+    ModelDraft,
+    NgramDraft,
+    SpeculativeLMEngine,
+)
 from .queue import RequestQueue  # noqa: F401
 from .request import (  # noqa: F401
     AdmissionError,

@@ -15,8 +15,10 @@ Join protocol (driven by ``DecodeScheduler``):
 * ``admit(slot, prompt, steps)`` — prefill the prompt in isolation
   (batch-1 cache), then scatter the fresh cache into the slot axis of
   the batched state (one jitted ``.at[slot].set`` per join). Prefill
-  compiles once per distinct prompt length — bucket prompt lengths
-  upstream if that matters for your traffic.
+  compiles once per distinct prompt length — if that recompile churn
+  matters for your traffic, use :class:`PagedLMEngine` below: its
+  chunked prefill makes the chunk size the ONLY compiled prefill shape,
+  so compile_count stays flat across arbitrary prompt lengths.
 * ``step()`` — one vmapped decode step over ALL slots. Inactive slots
   compute garbage at position 0 (static shapes are the point); the
   scheduler ignores their outputs and ``admit`` overwrites their state.
@@ -191,15 +193,599 @@ class ContinuousLMEngine:
                 "slots": self.slots, "active_slots": self.active_slots}
 
 
-def from_entry(entry, slots: int = 4,
-               mesh=None) -> "ContinuousLMEngine":
+class PagedLMEngine:
+    """Block-table paged continuous decoder (the ROADMAP item 4 engine).
+
+    Where :class:`ContinuousLMEngine` gives every slot a dense
+    ``max_seq`` cache, this engine draws fixed-size pages from a
+    :class:`~.kv_pool.KVPagePool` and addresses them through per-slot
+    block tables, gathered/scattered inside the jitted programs:
+
+    * **pool layout** — ``k/v: (layers, pages+1, heads, page, head_dim)``
+      device arrays; page 0 is the null sink inactive/pad writes route
+      to (no branches in the scatter). A slot's logical position ``p``
+      lives at ``(block_table[p // page], p % page)``.
+    * **chunked prefill** — ``admit_start`` queues the prompt and
+      ``prefill_tick`` ingests ONE fixed-size chunk per call, so a long
+      prompt interleaves with running decode instead of stalling the
+      batch, and the chunk size is the only compiled prefill shape
+      (``compile_count`` is flat across prompt lengths — the NNL008
+      churn fix).
+    * **COW prefix sharing** — identical prompt prefixes resolve to the
+      same pages via the pool's registry; ``_ensure_writable`` copies a
+      shared page before any write lands in it, so divergence never
+      perturbs the sibling stream.
+    * **preempt/restore** — ``preempt`` pulls a slot's pages to host and
+      frees them; ``restore`` re-allocates and uploads byte-exact, so
+      memory pressure never drops a request.
+
+    Parity contract: masked scores sit at -1e30 → exact-zero softmax
+    weight, and the gathered context length equals ``max_seq``, so the
+    paged step is token-exact against the dense engine (asserted in
+    test_kv_paged.py).
+    """
+
+    def __init__(self, cfg, params, slots: int = 4, page_size: int = 16,
+                 pages: Optional[int] = None, chunk: int = 32,
+                 share_prefixes: bool = True, pool_name: Optional[str] = None):
+        if slots < 1:
+            raise ValueError(f"slots={slots} must be >= 1")
+        page_size = min(page_size, cfg.max_seq)
+        if cfg.max_seq % page_size:
+            raise ValueError(
+                f"max_seq {cfg.max_seq} must divide by page_size {page_size}")
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.decoding import _ffn, _split_heads
+        from ..models.transformer import _rmsnorm
+        from .kv_pool import KVPagePool
+
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots
+        self.page_size = page_size
+        self.blocks_per_slot = cfg.max_seq // page_size
+        self.chunk = min(chunk, cfg.max_seq)
+        self.share_prefixes = share_prefixes
+        self.compile_count = 0
+        self._jnp = jnp
+        self._jax = jax
+
+        if pages is None:
+            pages = slots * self.blocks_per_slot  # dense-equivalent pool
+        self._mem_name = pool_name or f"lm_engine#{next(_engine_ids)}"
+        self.pool = KVPagePool(pages, page_size, name=self._mem_name)
+
+        cache_dtype = params["embed"].dtype
+        L, H, Dh = cfg.layers, cfg.heads, cfg.head_dim
+        pool_shape = (L, pages + 1, H, page_size, Dh)  # +1: null page 0
+        self._kpool = jnp.zeros(pool_shape, cache_dtype)
+        self._vpool = jnp.zeros(pool_shape, cache_dtype)
+        NB = self.blocks_per_slot
+        ctx = NB * page_size  # == max_seq: dense-identical contraction
+
+        # host mirrors (authoritative; device copies re-synced on change)
+        self._bt = np.zeros((slots, NB), np.int32)
+        self._tok = np.zeros((slots, 1), np.int32)
+        self._pos = np.zeros((slots,), np.int32)
+        self._mask = np.zeros((slots,), bool)
+        self._pending: "dict[int, dict]" = {}  # slot -> chunked-prefill state
+
+        self.cache_bytes = int(self._kpool.nbytes + self._vpool.nbytes)
+        self.page_bytes = int(2 * L * H * page_size * Dh
+                              * jnp.dtype(cache_dtype).itemsize)
+        self.param_bytes = obs_memory.tree_nbytes(params)
+        obs_memory.track_serving(self)
+
+        pg = page_size
+        scale = None  # closed over below via jnp.sqrt like decode_step
+
+        def _gather_ctx(pool, li, bt):
+            # bt (S, NB) -> (S, H, ctx, Dh); logical position p of slot s
+            # is element (s, :, p, :) — identical layout to a dense cache.
+            # jnp.take lowers to a cheaper gather than advanced indexing
+            # on the CPU backend
+            g = jnp.take(pool[li], bt, axis=0)      # (S, NB, H, pg, Dh)
+            S = bt.shape[0]
+            return g.transpose(0, 2, 1, 3, 4).reshape(S, H, ctx, Dh)
+
+        def _step(p, token, pos, mask, bt, kpool, vpool):
+            self.compile_count += 1  # trace-time only: one step program
+            S = token.shape[0]
+            x = (p["embed"][token[:, 0]]
+                 + p["pos"][jnp.clip(pos, 0, cfg.max_seq - 1)]
+                 ).astype(jnp.float32)[:, None, :]  # (S,1,D)
+            bidx = jnp.clip(pos // pg, 0, NB - 1)
+            dest = jnp.where(mask & (pos < cfg.max_seq),
+                             bt[jnp.arange(S), bidx], 0)
+            offs = pos % pg
+            positions = jnp.arange(ctx)
+            visible = (positions[None, :] <= pos[:, None])  # (S, ctx)
+            for li, blk in enumerate(p["blocks"]):
+                h = _rmsnorm(x, blk["ln1"])
+                q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
+                q, k, v = (_split_heads(cfg, t) for t in (q, k, v))
+                kpool = kpool.at[li, dest, :, offs, :].set(
+                    k[:, :, 0, :].astype(kpool.dtype))
+                vpool = vpool.at[li, dest, :, offs, :].set(
+                    v[:, :, 0, :].astype(vpool.dtype))
+                ck = _gather_ctx(kpool, li, bt)
+                cv = _gather_ctx(vpool, li, bt)
+                att = (q @ ck.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+                att = jnp.where(visible[:, None, None, :], att, -1e30)
+                att = jax.nn.softmax(att, axis=-1)
+                o = (att @ cv).transpose(0, 2, 1, 3).reshape(S, 1, cfg.dim)
+                x = x + o @ blk["wo"]
+                x = x + _ffn(blk, _rmsnorm(x, blk["ln2"]), None, cfg)
+            logits = _rmsnorm(x[:, 0], p["out_norm"]) @ p["embed"].T
+            out = jnp.argmax(logits, -1).astype(jnp.int32)
+            token = jnp.where(mask[:, None], out[:, None], token)
+            pos = pos + mask.astype(jnp.int32)
+            return out, token, pos, kpool, vpool
+
+        self._step = functools.partial(
+            jax.jit(_step, donate_argnums=(1, 2, 5, 6)), params)
+
+        C = self.chunk
+
+        def _prefill_chunk(p, toks, start, n_valid, bt, kpool, vpool):
+            # toks (C,) padded; ingest positions start..start+n_valid-1 of
+            # ONE slot. C is static — the only compiled prefill shape.
+            self.compile_count += 1  # trace-time only: once per engine
+            q_pos = start + jnp.arange(C)
+            valid = jnp.arange(C) < n_valid
+            lp = jnp.clip(q_pos, 0, cfg.max_seq - 1)
+            dest = jnp.where(valid, bt[lp // pg], 0)
+            offs = lp % pg
+            x = (p["embed"][toks] + p["pos"][lp]
+                 ).astype(jnp.float32)[None]        # (1, C, D)
+            positions = jnp.arange(ctx)
+            visible = (positions[None, :] <= q_pos[:, None])  # (C, ctx)
+            for li, blk in enumerate(p["blocks"]):
+                h = _rmsnorm(x, blk["ln1"])
+                q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
+                q, k, v = (_split_heads(cfg, t) for t in (q, k, v))
+                kpool = kpool.at[li, dest, :, offs, :].set(
+                    k[0].transpose(1, 0, 2).astype(kpool.dtype))
+                vpool = vpool.at[li, dest, :, offs, :].set(
+                    v[0].transpose(1, 0, 2).astype(vpool.dtype))
+                ck = _gather_ctx(kpool, li, bt[None])   # (1, H, ctx, Dh)
+                cv = _gather_ctx(vpool, li, bt[None])
+                att = (q @ ck.transpose(0, 1, 3, 2)) / jnp.sqrt(cfg.head_dim)
+                att = jnp.where(visible[None, None], att, -1e30)
+                att = jax.nn.softmax(att, axis=-1)
+                o = (att @ cv).transpose(0, 2, 1, 3).reshape(1, C, cfg.dim)
+                x = x + o @ blk["wo"]
+                x = x + _ffn(blk, _rmsnorm(x, blk["ln2"]), None, cfg)
+            logits = _rmsnorm(x[0], p["out_norm"]) @ p["embed"].T  # (C, V)
+            return logits, kpool, vpool
+
+        self._prefill_chunk = functools.partial(
+            jax.jit(_prefill_chunk, donate_argnums=(5, 6)), params)
+
+        def _copy_page(kpool, vpool, dst, src):
+            self.compile_count += 1  # trace-time only: the COW primitive
+            return (kpool.at[:, dst].set(kpool[:, src]),
+                    vpool.at[:, dst].set(vpool[:, src]))
+
+        self._copy_page = jax.jit(_copy_page, donate_argnums=(0, 1))
+
+        def _gather_pages(kpool, vpool, pages_row):
+            # (NB,) page ids -> (L, NB, H, pg, Dh) blobs (preempt read)
+            return kpool[:, pages_row], vpool[:, pages_row]
+
+        self._gather_pages = jax.jit(_gather_pages)
+
+        def _scatter_pages(kpool, vpool, dest_row, kblob, vblob):
+            return (kpool.at[:, dest_row].set(kblob.astype(kpool.dtype)),
+                    vpool.at[:, dest_row].set(vblob.astype(vpool.dtype)))
+
+        self._scatter_pages = jax.jit(_scatter_pages, donate_argnums=(0, 1))
+
+        def _verify(p, toks, pos, mask, bt, kpool, vpool):
+            # speculative verification: score K tokens per slot in ONE
+            # call — toks (S, K) = [carry, draft...], positions
+            # pos..pos+K-1. Writes their K/V (host rolls back rejected
+            # positions by simply not advancing pos past them: the
+            # <=pos visibility mask hides them until overwritten).
+            self.compile_count += 1  # trace-time only: once per K
+            S, K = toks.shape
+            q_pos = pos[:, None] + jnp.arange(K)[None, :]     # (S, K)
+            lp = jnp.clip(q_pos, 0, cfg.max_seq - 1)
+            # overflow rows (q_pos >= max_seq) route to the null page so
+            # they can never clobber the real tail position
+            dest = jnp.where(mask[:, None] & (q_pos < cfg.max_seq),
+                             bt[jnp.arange(S)[:, None], lp // pg], 0)
+            offs = lp % pg
+            x = (p["embed"][toks] + p["pos"][lp]).astype(jnp.float32)
+            positions = jnp.arange(ctx)
+            visible = (positions[None, None, :] <= q_pos[:, :, None])
+            for li, blk in enumerate(p["blocks"]):
+                h = _rmsnorm(x, blk["ln1"])
+                q, k, v = jnp.split(h @ blk["wqkv"], 3, axis=-1)
+                q, k, v = (_split_heads(cfg, t) for t in (q, k, v))
+                kpool = kpool.at[li, dest, :, offs, :].set(
+                    k.transpose(0, 2, 1, 3).astype(kpool.dtype))
+                vpool = vpool.at[li, dest, :, offs, :].set(
+                    v.transpose(0, 2, 1, 3).astype(vpool.dtype))
+                ck = _gather_ctx(kpool, li, bt)
+                cv = _gather_ctx(vpool, li, bt)
+                # broadcast-multiply-reduce instead of batched matmul:
+                # XLA CPU lowers (S*H) tiny K x ctx GEMMs to per-batch
+                # library calls whose fixed cost dwarfs the math; the
+                # explicit reduce fuses into one loop (~30% off the
+                # whole program at K=4)
+                att = ((q[:, :, :, None, :] * ck[:, :, None, :, :]).sum(-1)
+                       / jnp.sqrt(cfg.head_dim))
+                att = jnp.where(visible[:, None], att, -1e30)
+                att = jax.nn.softmax(att, axis=-1)
+                o = (att[..., None] * cv[:, :, None, :, :]).sum(3)
+                o = o.transpose(0, 2, 1, 3).reshape(S, K, cfg.dim)
+                x = x + o @ blk["wo"]
+                x = x + _ffn(blk, _rmsnorm(x, blk["ln2"]), None, cfg)
+            logits = _rmsnorm(x, p["out_norm"]) @ p["embed"].T  # (S, K, V)
+            return logits, kpool, vpool
+
+        self._verify = functools.partial(
+            jax.jit(_verify, donate_argnums=(5, 6)), params)
+
+        def _verify_commit(p, toks, pos, tok, mask, bt, kpool, vpool):
+            # fused speculative round: verify K tokens AND resolve greedy
+            # acceptance + carry advance on device. Greedy acceptance
+            # emits the target's own argmax prefix (accepted drafts match
+            # it by definition, the correction IS it), so the host needs
+            # only (pred, n_emit) — two tiny int pulls, no logits
+            # download, no carry re-upload.
+            logits, kpool, vpool = _verify(p, toks, pos, mask, bt,
+                                           kpool, vpool)
+            S, K = toks.shape
+            pred = jnp.argmax(logits, -1).astype(jnp.int32)   # (S, K)
+            budget = cfg.max_seq - pos                        # emit ceiling
+            # accept proposal i (column i+1) while every earlier one
+            # matched and the emit budget allows position i+1
+            ok = ((toks[:, 1:] == pred[:, :-1])
+                  & (jnp.arange(K - 1)[None, :] < (budget - 1)[:, None]))
+            j = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+            n_emit = jnp.where(mask & (budget > 0), j + 1, 0)
+            last = pred[jnp.arange(S), jnp.maximum(n_emit - 1, 0)]
+            tok = jnp.where((n_emit > 0)[:, None], last[:, None], tok)
+            pos = pos + n_emit
+            # pack [n_emit | pred] into ONE (S, K+1) array: the host does
+            # a single tiny pull per round instead of two
+            out = jnp.concatenate([n_emit[:, None], pred], axis=1)
+            return out, tok, pos, kpool, vpool
+
+        self._verify_commit = functools.partial(
+            jax.jit(_verify_commit, donate_argnums=(2, 3, 6, 7)), params)
+        self._sync_device_state()
+
+    def _sync_device_state(self) -> None:
+        """Re-upload the decode carry from the host mirrors
+        (admit/release/preempt edits only — never per token). Block
+        tables are NOT device-resident: ``self._bt`` rides into every
+        jit call as a numpy arg (the committed-call conversion is ~10x
+        cheaper than maintaining a device mirror that page-boundary
+        crossings would re-upload mid-decode)."""
+        jnp = self._jnp
+        self._tok_dev = jnp.asarray(self._tok)
+        self._pos_dev = jnp.asarray(self._pos)
+        self._mask_dev = jnp.asarray(self._mask)
+
+    # -- page bookkeeping -----------------------------------------------------
+    def _ensure_writable(self, slot: int, lo: int, hi: int) -> None:
+        """Make blocks covering logical positions [lo, hi) exclusively
+        owned by ``slot``: allocate missing pages, COW-copy shared ones.
+        Raises PagePoolExhausted (caller sheds or preempts)."""
+        if hi <= lo:
+            return
+        for b in range(lo // self.page_size,
+                       (hi - 1) // self.page_size + 1):
+            page = int(self._bt[slot, b])
+            if page == 0:
+                # ownership lands in the block table atomically with the
+                # alloc: release(slot) walks _bt on every exit path
+                # nnlint: disable=NNL302
+                self._bt[slot, b] = self.pool.alloc(1)[0]  # pairs-with: release (slot exit)
+            elif self.pool.is_shared(page):
+                new = self.pool.alloc(1)[0]  # pairs-with: release (slot exit)
+                try:
+                    self._kpool, self._vpool = self._copy_page(
+                        self._kpool, self._vpool, new, page)
+                except BaseException:
+                    self.pool.release([new])  # copy failed: page never owned
+                    raise
+                self.pool.release([page])  # drop OUR ref; sibling keeps its page
+                self._bt[slot, b] = new
+                self.pool.note_cow()
+
+    def projected_page_bytes(self, tokens: int, steps: int) -> int:
+        """Worst-case pool bytes a request needs (no sharing assumed) —
+        the AdmissionGuard reservation unit (pages, not dense slots)."""
+        n = -(-(tokens + steps) // self.page_size)
+        return n * self.page_bytes
+
+    # -- scheduler contract ---------------------------------------------------
+    def validate(self, tokens: np.ndarray, steps: int) -> None:
+        if tokens.ndim != 1 or tokens.size == 0:
+            raise ValueError(
+                f"prompt must be non-empty 1-D tokens, got {tokens.shape}")
+        if tokens.size + steps > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt ({tokens.size}) + steps ({steps}) exceeds "
+                f"max_seq {self.cfg.max_seq}")
+
+    def admit_start(self, slot: int, tokens: np.ndarray, steps: int) -> None:
+        """Queue a prompt for chunked prefill (``prefill_tick`` drives
+        it). Shared-prefix pages are mapped in immediately; only the
+        uncovered tail is recomputed."""
+        if self._mask[slot] or slot in self._pending:
+            raise ServingError(f"slot {slot} already active")
+        tokens = np.asarray(tokens, np.int32)
+        self.validate(tokens, steps)
+        covered = 0
+        if self.share_prefixes:
+            pages, covered = self.pool.lookup_prefix(tokens)
+            if pages:
+                self._bt[slot, :len(pages)] = pages
+                # always recompute >=1 position: the final prompt token's
+                # logits seed the first generated token
+                covered = min(covered, tokens.size - 1)
+        self._pending[slot] = {"tokens": tokens, "next": covered,
+                               "steps": steps}
+
+    def prefill_tick(self) -> "list[tuple[int, int]]":
+        """Ingest ONE chunk of ONE pending prompt (oldest first);
+        returns [(slot, first_token)] when that prompt completes, else
+        []. The scheduler calls this once per loop pass so prefill
+        interleaves with running decode instead of stalling it."""
+        if not self._pending:
+            return []
+        jnp = self._jnp
+        slot = next(iter(self._pending))
+        st = self._pending[slot]
+        tokens, start = st["tokens"], st["next"]
+        n_valid = min(self.chunk, tokens.size - start)
+        self._ensure_writable(slot, start, start + n_valid)
+        padded = np.zeros((self.chunk,), np.int32)
+        padded[:n_valid] = tokens[start:start + n_valid]
+        logits, self._kpool, self._vpool = self._prefill_chunk(
+            jnp.asarray(padded), jnp.asarray(start, jnp.int32),
+            jnp.asarray(n_valid, jnp.int32), self._bt[slot],
+            self._kpool, self._vpool)
+        st["next"] = start + n_valid
+        if st["next"] < tokens.size:
+            return []
+        # prompt complete: seed the decode carry from the last REAL row
+        del self._pending[slot]
+        first = int(np.argmax(np.asarray(logits[n_valid - 1])))
+        self._tok[slot, 0] = first
+        self._pos[slot] = tokens.size
+        self._mask[slot] = True
+        if self.share_prefixes:
+            # register FULL pages only: a later prompt sharing just the
+            # prefix (not the tail) still hits, and registered pages are
+            # immutable — this stream's future writes land at positions
+            # >= tokens.size, past every registered page (COW guards the
+            # page-aligned case where position size-1 is in the last
+            # registered page)
+            nb_full = tokens.size // self.page_size
+            if nb_full:
+                self.pool.register_prefix(
+                    tokens,
+                    [int(p) for p in self._bt[slot, :nb_full] if p],
+                    nb_full * self.page_size)
+        self._sync_device_state()
+        return [(slot, first)]
+
+    def admit(self, slot: int, tokens: np.ndarray, steps: int) -> int:
+        """Blocking admit (contract-compatible with the dense engine):
+        runs the chunked prefill to completion before returning."""
+        self.admit_start(slot, tokens, steps)
+        while slot in self._pending:
+            done = self.prefill_tick()
+            for s, first in done:
+                if s == slot:
+                    return first
+        raise ServingError(f"slot {slot} prefill did not complete")
+
+    def step(self) -> np.ndarray:
+        """One paged decode step over every slot; may raise
+        PagePoolExhausted when an active slot crosses into a page the
+        pool cannot supply (scheduler preempts a victim and retries)."""
+        for s in np.flatnonzero(self._mask):
+            if self._pos[s] < self.cfg.max_seq:
+                self._ensure_writable(int(s), int(self._pos[s]),
+                                      int(self._pos[s]) + 1)
+        tok_dev, self._tok_dev, self._pos_dev, self._kpool, self._vpool = \
+            self._step(self._tok_dev, self._pos_dev, self._mask_dev,
+                       self._bt, self._kpool, self._vpool)
+        # nnlint: disable=NNL101 — one (slots,) pull per decode step: the
+        # scheduler needs host ints to append/retire (documented
+        # contract), matching the dense engine's ledger entry
+        tok = self._jax.device_get(tok_dev)
+        self._pos = self._pos + self._mask.astype(np.int32)
+        self._tok[self._mask, 0] = tok[self._mask]
+        return tok
+
+    def verify(self, draft: np.ndarray) -> np.ndarray:
+        """Score ``draft`` (slots, K) token blocks in one call → logits
+        (slots, K, vocab). Column 0 must be each slot's carry token;
+        columns 1.. are proposals. Used by SpeculativeLMEngine."""
+        K = draft.shape[1]
+        for s in np.flatnonzero(self._mask):
+            lo = int(self._pos[s])
+            self._ensure_writable(int(s), lo,
+                                  min(lo + K, self.cfg.max_seq))
+        logits, self._kpool, self._vpool = self._verify(
+            np.ascontiguousarray(draft, np.int32), self._pos_dev,
+            self._mask_dev, self._bt, self._kpool, self._vpool)
+        # nnlint: disable=NNL101 — one (slots, K, V) pull per speculative
+        # round (K tokens' worth), replacing K per-token pulls
+        return self._jax.device_get(logits)
+
+    def verify_commit(self, draft: np.ndarray):
+        """Fused speculative round: verify ``draft`` (slots, K) AND
+        resolve greedy acceptance + carry advance on device in ONE call.
+        Returns ``(pred, n_emit)`` — slot ``s`` emitted
+        ``pred[s, :n_emit[s]]`` (accepted drafts equal the target argmax
+        by definition; the last entry is the correction). The carry
+        stays device-resident: no logits download, no ``commit`` /
+        ``sync_carry`` re-upload — the per-round host traffic that
+        dominated the unfused path."""
+        K = draft.shape[1]
+        for s in np.flatnonzero(self._mask):
+            lo = int(self._pos[s])
+            self._ensure_writable(int(s), lo,
+                                  min(lo + K, self.cfg.max_seq))
+        # np array passed straight to the jit call: the committed-call
+        # conversion is ~10x cheaper than a standalone jnp.asarray
+        (packed, self._tok_dev, self._pos_dev,
+         self._kpool, self._vpool) = self._verify_commit(
+            np.ascontiguousarray(draft, np.int32), self._pos_dev,
+            self._tok_dev, self._mask_dev, self._bt,
+            self._kpool, self._vpool)
+        # nnlint: disable=NNL101 — ONE (slots, K+1) int pull per
+        # speculative round (the emitted burst), replacing the (slots,
+        # K, V) logits pull of the unfused path
+        packed = self._jax.device_get(packed)
+        n_emit, pred = packed[:, 0], packed[:, 1:]
+        for s in np.flatnonzero(n_emit):
+            n = int(n_emit[s])
+            self._pos[s] += n
+            self._tok[s, 0] = int(pred[s, n - 1])
+        return pred, n_emit
+
+    def commit(self, slot: int, tokens: "list[int]",
+               sync: bool = True) -> None:
+        """Advance a slot past ``tokens`` accepted by speculative
+        verification: K/V for them is already in the pool (written by
+        ``verify``); only the host carry moves. The LAST entry is the
+        new carry token (its K/V is NOT yet written). ``sync=False``
+        defers the device upload — the caller batches many slots'
+        commits into ONE :meth:`sync_carry` per round (per-slot uploads
+        would cost more than the verify call they follow)."""
+        if not tokens:
+            return
+        # verify wrote K/V for [carry, accepted...]: len(tokens)
+        # positions are now cache-valid, the new carry's K/V is not
+        self._pos[slot] = int(self._pos[slot]) + len(tokens)
+        self._tok[slot, 0] = int(tokens[-1])
+        if sync:
+            self.sync_carry()
+
+    def sync_carry(self) -> None:
+        """Upload the host carry mirrors (token + position) in one
+        round-trip; pairs with ``commit(..., sync=False)`` batches."""
+        self._tok_dev = self._jnp.asarray(self._tok)
+        self._pos_dev = self._jnp.asarray(self._pos)
+
+    def release(self, slot: int) -> None:
+        self._pending.pop(slot, None)
+        self.pool.release([int(p) for p in self._bt[slot] if p])  # pairs-with: alloc/ref (admit path)
+        self._bt[slot] = 0
+        self._mask[slot] = False
+        self._tok[slot, 0] = 0
+        self._pos[slot] = 0
+        self._sync_device_state()
+
+    # -- preemption -----------------------------------------------------------
+    def preempt(self, slot: int) -> dict:
+        """Evict a slot to host: pull its pages, free them, deactivate.
+        The returned blob restores the request byte-exact later —
+        deadline-aware memory pressure never DROPS work (contract with
+        the scheduler + obs/memory watermark events)."""
+        if not self._mask[slot]:
+            raise ServingError(f"slot {slot} not active")
+        used = self._bt[slot] != 0
+        kblob, vblob = self._gather_pages(
+            self._kpool, self._vpool, self._bt[slot])
+        # nnlint: disable=NNL101 — preemption IS the host transfer: the
+        # victim's pages move to host RAM so the pool can be re-used;
+        # restore uploads the same bytes
+        blob = {"k": self._jax.device_get(kblob),
+                "v": self._jax.device_get(vblob),
+                "used": used.copy(), "tok": int(self._tok[slot, 0]),
+                "pos": int(self._pos[slot])}
+        self.pool.release([int(p) for p in self._bt[slot] if p])  # pairs-with: alloc/ref (admit path)
+        self._bt[slot] = 0
+        self._mask[slot] = False
+        self._sync_device_state()
+        self.pool.note_preemption()
+        return blob
+
+    def restore(self, slot: int, blob: dict) -> None:
+        """Re-admit a preempted request: fresh pages, byte-exact upload,
+        decode resumes mid-sequence. Raises PagePoolExhausted if the
+        pool still cannot hold it (scheduler keeps it queued)."""
+        if self._mask[slot]:
+            raise ServingError(f"slot {slot} already active")
+        used = blob["used"]
+        fresh = self.pool.alloc(int(used.sum()))  # pairs-with: release (slot exit)
+        row = np.zeros_like(self._bt[slot])
+        row[used] = fresh
+        self._bt[slot] = row
+        dest = self._jnp.asarray(row)
+        self._kpool, self._vpool = self._scatter_pages(
+            self._kpool, self._vpool, dest,
+            self._jnp.asarray(blob["k"]), self._jnp.asarray(blob["v"]))
+        self._tok[slot, 0] = blob["tok"]
+        self._pos[slot] = blob["pos"]
+        self._mask[slot] = True
+        self._sync_device_state()
+        self.pool.note_restore()
+
+    # -- introspection --------------------------------------------------------
+    @property
+    def active_slots(self) -> int:
+        return int(self._mask.sum())
+
+    def memory_bytes(self) -> dict:
+        """Serving-plane byte source (obs/memory.py ``track_serving``):
+        the page pool is the engine's resident buffer; page occupancy
+        rides along so obs top can render utilization, not just
+        capacity."""
+        s = self.pool.stats()
+        return {"name": self._mem_name, "kind": "kv_pool",
+                "bytes": self.cache_bytes,
+                "param_bytes": self.param_bytes,
+                "slots": self.slots, "active_slots": self.active_slots,
+                "pages_total": s["pages_total"],
+                "pages_used": s["pages_used"],
+                "pages_shared": s["pages_shared"],
+                "page_bytes": self.page_bytes}
+
+    def close(self) -> None:
+        for slot in range(self.slots):
+            if self._mask[slot] or self._bt[slot].any():
+                self.release(slot)
+        self.pool.close()
+
+
+def from_entry(entry, slots: int = 4, mesh=None, paged: bool = False,
+               **paged_kw):
     """Build an engine from an ``lm_serving`` entry (params initialized /
     dtype-cast per the entry's serve knobs; ``mesh`` reserved for
-    sharded slot state — single-device only today)."""
+    sharded slot state — single-device only today). ``paged=True``
+    builds the block-table :class:`PagedLMEngine` (``paged_kw``:
+    page_size/pages/chunk/share_prefixes); its executables key into the
+    PR 14 AOT cache when ``NNS_AOT_CACHE`` is set."""
     if mesh is not None:
         raise NotImplementedError(
             "continuous decode is single-device today; shard the batch "
             "with the whole-sequence lm_serving paths instead")
     cfg = entry._cfg_serve
     params, _ = entry._shard_params(None)
+    if paged:
+        import os
+
+        from ..aot import cache as aot_cache
+
+        if os.environ.get(aot_cache.CACHE_ENV):
+            # draft AND target executables land in the same persistent
+            # XLA cache: a fleet restart replays both without retracing
+            aot_cache.attach_xla_cache()
+        return PagedLMEngine(cfg, params, slots=slots, **paged_kw)
     return ContinuousLMEngine(cfg, params, slots=slots)

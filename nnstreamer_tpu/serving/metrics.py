@@ -83,6 +83,8 @@ class ServingMetrics:
         self.padded_rows = 0       # rows incl. bucket padding
         self.decode_steps = 0
         self.retired_early = 0     # decode: finished before max steps (eos)
+        self.preempted = 0         # pages evicted to host (pressure)
+        self.restored = 0          # preempted requests resumed
         # device channel: batch execution time (dispatch+block, the
         # reference-comparable number); reservoirs: per-request tails
         self.device = InvokeStats()
@@ -147,6 +149,14 @@ class ServingMetrics:
         with self._lock:
             self.retired_early += 1
 
+    def record_preemption(self) -> None:
+        with self._lock:
+            self.preempted += 1
+
+    def record_restore(self) -> None:
+        with self._lock:
+            self.restored += 1
+
     # -- snapshot -----------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
@@ -163,6 +173,8 @@ class ServingMetrics:
                 "batches": self.batches,
                 "decode_steps": self.decode_steps,
                 "retired_early": self.retired_early,
+                "preempted": self.preempted,
+                "restored": self.restored,
                 "batch_occupancy": occupancy,
             }
         out["device"] = self.device.snapshot()

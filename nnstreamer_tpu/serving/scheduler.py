@@ -155,14 +155,19 @@ class Scheduler:
         self.metrics.record_shed(deadline=True)
 
     # -- memory admission (obs/memory.py AdmissionGuard) --------------------
+    def _projected_bytes(self, req: Request) -> int:
+        """What this request will hold resident if admitted (the guard's
+        reservation unit). One-shot batching: its input tensors."""
+        return _tensors_nbytes(req.tensors)
+
     def _reserve_mem(self, req: Request) -> None:
-        """Reserve the request's tensor bytes against the guard's
+        """Reserve the request's projected bytes against the guard's
         watermark; sheds with a typed MemoryPressureError when the
         projection would cross it. No guard = no-op."""
         guard = self.memory_guard
         if guard is None:
             return
-        nb = _tensors_nbytes(req.tensors)
+        nb = self._projected_bytes(req)
         if not guard.reserve(nb):
             err = MemoryPressureError(
                 f"request {req.id} shed: projected serving memory "
@@ -383,6 +388,27 @@ class DecodeScheduler:
       slot (inactive slots compute garbage; the loop ignores them);
     * ``release(slot)`` — slot freed (optional);
     * ``compile_count`` — optional compile hook.
+
+    Optional extensions the paged/speculative engines provide
+    (``lm_engine.PagedLMEngine`` / ``speculative.SpeculativeLMEngine``):
+
+    * ``admit_start``/``prefill_tick`` — chunked prefill: admit queues
+      the prompt, the loop ingests ONE bounded chunk per pass, so a
+      long prompt interleaves with running decode instead of stalling
+      the batch;
+    * ``step_tokens() -> list[list[int]]`` — burst decode (speculative
+      rounds emit 1..K tokens per slot per pass);
+    * ``preempt(slot) -> blob``/``restore(slot, blob)`` — deadline-aware
+      memory pressure: on ``PagePoolExhausted`` the loop evicts the
+      victim with the MOST deadline slack to host and requeues it;
+      readmission restores byte-exact — the request is never dropped;
+    * ``projected_page_bytes(tokens, steps)`` — the AdmissionGuard
+      reserves page-pool bytes instead of dense tensor bytes.
+
+    Page-release invariant: EVERY request exit path — normal retire,
+    deadline shed (queued or mid-decode), batch failure, close — goes
+    through ``engine.release(slot)``, so page refcounts reach zero
+    whatever killed the request (asserted by the NNS_LEAKCHECK ledger).
     """
 
     def __init__(self, engine, *,
@@ -400,6 +426,7 @@ class DecodeScheduler:
         self.name = register_scheduler(name, self)
         self.metrics.series = f"serving:{self.name}"
         self._active: Dict[int, Request] = {}
+        self._prefilling: Dict[int, Request] = {}  # chunked-prefill slots
         self._free: List[int] = list(range(engine.slots))[::-1]
         self._running = threading.Event()
         self._closed = False
@@ -425,10 +452,22 @@ class DecodeScheduler:
             self._thread.join(timeout=5.0)
             self._thread = None
         err = SchedulerClosedError(f"scheduler {self.name} closed")
-        for req in list(self._active.values()) + self.queue.drain():
+        # in-flight slots MUST release through the engine (page-release
+        # invariant: close is an exit path like any other — without this
+        # the pool leaks every page a live request held at shutdown)
+        for slot in list(self._active) + list(self._prefilling):
+            req = self._active.pop(slot, None) or \
+                self._prefilling.pop(slot, None)
+            if req is not None:
+                req.fail(err)
+                self._record_done(req, failed=True)
+            self._retire_slot_only(slot)
+        for req in self.queue.drain():
             req.fail(err)
             self._record_done(req, failed=True)
-        self._active.clear()
+        close = getattr(self.engine, "close", None)
+        if close is not None:
+            close()  # paged engine: drop the prefix registry's page refs
 
     # -- submission ---------------------------------------------------------
     def submit(self, tokens, steps: int, priority: int = 0,
@@ -481,6 +520,15 @@ class DecodeScheduler:
     _release_mem = Scheduler._release_mem
     _record_done = Scheduler._record_done
 
+    def _projected_bytes(self, req: Request) -> int:
+        """Paged engines reserve PAGES (what the request will actually
+        pin in the pool), not dense tensor bytes — the AdmissionGuard
+        gate matches the resource that can actually run out."""
+        projected = getattr(self.engine, "projected_page_bytes", None)
+        if projected is not None and req.steps:
+            return projected(int(req.tensors[0].size), int(req.steps))
+        return _tensors_nbytes(req.tensors)
+
     @property
     def compile_count(self) -> int:
         return getattr(self.engine, "compile_count", 0)
@@ -492,13 +540,72 @@ class DecodeScheduler:
         snap["active_slots"] = len(self._active)
         snap["slots"] = self.engine.slots
         snap["compile_count"] = self.compile_count
+        pool = getattr(self.engine, "pool", None)
+        if pool is not None:
+            snap["kv_pool"] = pool.stats()
+        rate = getattr(self.engine, "acceptance_rate", None)
+        if rate is not None:
+            snap["spec_acceptance_rate"] = rate()
+            snap["spec_rounds"] = self.engine.spec_rounds
+            snap["spec_proposed"] = self.engine.spec_proposed
+            snap["spec_accepted"] = self.engine.spec_accepted
         return snap
 
     # -- loop ---------------------------------------------------------------
-    def _admit_one(self, req: Request) -> None:
+    def _admit_one(self, req: Request) -> bool:
+        """Place a request into a free slot: restore a preempted one,
+        queue a chunked prefill, or run the blocking admit. Returns
+        False when the pool cannot take it YET (request requeued; stop
+        admitting this pass)."""
+        from .kv_pool import PagePoolExhausted
+
         slot = self._free.pop()
         t0 = time.monotonic()
-        req.metrics["queue_wait_s"] = t0 - req.metrics["enqueue_time"]
+        req.metrics.setdefault("queue_wait_s",
+                               t0 - req.metrics["enqueue_time"])
+        blob = req.metrics.pop("_preempt_blob", None)
+        if blob is not None:
+            try:
+                self.engine.restore(slot, blob)
+            except PagePoolExhausted:
+                # still too tight: keep it queued, blob intact
+                self._free.append(slot)
+                req.metrics["_preempt_blob"] = blob
+                self._requeue(req)
+                return False
+            except Exception as e:  # noqa: BLE001 - engine rejected restore
+                self._free.append(slot)
+                req.fail(e if isinstance(e, ServingError)
+                         else ServingError(f"decode restore failed: {e}"))
+                self._record_done(req, failed=True)
+                return True
+            req.metrics["slot"] = slot
+            self._active[slot] = req
+            self.metrics.record_restore()
+            obs_flight.record("memory", "preempt_restore",
+                              {"scheduler": self.name, "request": req.id,
+                               "slot": slot})
+            return True
+        if getattr(self.engine, "admit_start", None) is not None:
+            try:
+                self.engine.admit_start(slot, req.tensors[0], req.steps)
+            except PagePoolExhausted:
+                self._free.append(slot)
+                if not self._preempt_victim():
+                    self._fail_mem(req)
+                else:
+                    self._requeue(req)
+                return False
+            except Exception as e:  # noqa: BLE001 - engine rejected prompt
+                self._free.append(slot)
+                req.fail(e if isinstance(e, ServingError)
+                         else ServingError(f"decode admit failed: {e}"))
+                self._record_done(req, failed=True)
+                return True
+            req.metrics["slot"] = slot
+            req.metrics["_prefill_t0"] = t0
+            self._prefilling[slot] = req
+            return True
         try:
             first = int(self.engine.admit(slot, req.tensors[0], req.steps))
         except Exception as e:  # noqa: BLE001 - engine rejected this prompt
@@ -506,7 +613,7 @@ class DecodeScheduler:
             req.fail(e if isinstance(e, ServingError)
                      else ServingError(f"decode admit failed: {e}"))
             self._record_done(req, failed=True)
-            return
+            return True
         now = time.monotonic()
         req.metrics["slot"] = slot
         req.metrics["ttft_s"] = now - req.metrics["enqueue_time"]
@@ -516,6 +623,61 @@ class DecodeScheduler:
             self._retire(slot, req, early=False)
         else:
             self._active[slot] = req
+        return True
+
+    def _requeue(self, req: Request) -> None:
+        """Put a preempted/deferred request back in line; if the queue
+        itself sheds it, the failure is typed like any admission shed."""
+        try:
+            self.queue.put(req)
+        except AdmissionError as e:
+            from .request import DeadlineExceededError
+
+            self.metrics.record_shed(
+                deadline=isinstance(e, DeadlineExceededError))
+            req.fail(e)
+            self._record_done(req, failed=True)
+
+    def _fail_mem(self, req: Request) -> None:
+        err = MemoryPressureError(
+            f"request {req.id} shed: KV page pool exhausted and no "
+            "preemptable victim (typed shed, not an OOM)")
+        self.metrics.record_shed(memory=True)
+        obs_flight.record("memory", "page_pool_shed",
+                          {"scheduler": self.name, "request": req.id})
+        req.fail(err)
+        self._record_done(req, failed=True)
+
+    def _preempt_victim(self, min_active: int = 1) -> bool:
+        """Deadline-aware eviction: push the ACTIVE request with the
+        most slack (no deadline beats any deadline; later beats sooner)
+        to host and requeue it — never drop it. False when the engine
+        cannot preempt or fewer than ``min_active`` streams are running
+        (evicting the only runner to feed itself is a livelock, not
+        progress — the caller sheds typed instead)."""
+        preempt = getattr(self.engine, "preempt", None)
+        if preempt is None or len(self._active) < min_active:
+            return False
+        slot = max(self._active,
+                   key=lambda s: (self._active[s].deadline is None,
+                                  self._active[s].deadline or 0.0))
+        req = self._active.pop(slot)
+        try:
+            blob = preempt(slot)
+        except Exception:  # noqa: BLE001 - engine state is authoritative
+            logger.exception("serving %s: preempt of slot %d failed",
+                             self.name, slot)
+            self._active[slot] = req
+            return False
+        self._free.append(slot)
+        req.metrics["_preempt_blob"] = blob
+        self.metrics.record_preemption()
+        obs_flight.record("memory", "preemption",
+                          {"scheduler": self.name, "request": req.id,
+                           "slot": slot,
+                           "decoded": len(req.tokens)})
+        self._requeue(req)
+        return True
 
     def _finished(self, req: Request, last_token: int) -> bool:
         if len(req.tokens) >= req.steps:
@@ -536,45 +698,159 @@ class DecodeScheduler:
         req.complete((np.asarray(req.tokens, np.int32),))
         self._record_done(req)
 
+    def _prefill_tick(self) -> None:
+        """Ingest ONE prompt chunk (chunked-prefill engines): long
+        prompts advance one bounded chunk per loop pass, interleaved
+        with decode steps, instead of stalling the whole batch."""
+        from .kv_pool import PagePoolExhausted
+
+        # bounded retry IN THIS PASS: preempting a victim only helps if
+        # the tick reclaims the freed pages before the admit phase
+        # restores the victim (otherwise preempt/restore ping-pong
+        # forever and the starved prompt never advances)
+        done = []
+        for _ in range(self.engine.slots + 1):
+            try:
+                done = self.engine.prefill_tick()
+                break
+            except PagePoolExhausted:
+                if self._preempt_victim():
+                    continue
+                # no victim left: shed the oldest prefilling request
+                # (typed, never an OOM)
+                if self._prefilling:
+                    slot = next(iter(self._prefilling))
+                    req = self._prefilling.pop(slot)
+                    self._fail_mem(req)
+                    self._retire_slot_only(slot)
+                return
+            except Exception as e:  # noqa: BLE001 - fail that prompt, keep serving
+                logger.exception("serving %s: prefill chunk failed",
+                                 self.name)
+                if self._prefilling:
+                    slot = next(iter(self._prefilling))
+                    req = self._prefilling.pop(slot)
+                    req.fail(e if isinstance(e, ServingError)
+                             else ServingError(f"decode prefill failed: {e}"))
+                    self._record_done(req, failed=True)
+                    self._retire_slot_only(slot)
+                return
+        now = time.monotonic()
+        for slot, first in done:
+            req = self._prefilling.pop(slot, None)
+            if req is None:
+                continue
+            req.metrics["ttft_s"] = now - req.metrics["enqueue_time"]
+            req.metrics["prefill_s"] = now - req.metrics.pop(
+                "_prefill_t0", now)
+            req.tokens.append(int(first))
+            if self._finished(req, int(first)):
+                self._retire(slot, req, early=False)
+            else:
+                self._active[slot] = req
+
+    def _shed_expired_active(self) -> None:
+        """Mid-decode deadline enforcement: a stream that cannot finish
+        in time stops burning slots and steps NOW — and its exit goes
+        through the engine release path like every other (pages freed)."""
+        now = time.monotonic()
+        for slot, req in list(self._active.items()):
+            if req.deadline is not None and now > req.deadline:
+                from .request import DeadlineExceededError
+
+                req.fail(DeadlineExceededError(
+                    f"request {req.id} deadline expired mid-decode "
+                    f"after {len(req.tokens)} tokens"))
+                self.metrics.record_shed(deadline=True)
+                self._record_done(req, failed=True)
+                self._retire_slot_only(slot)
+
     def _loop(self) -> None:
+        from .kv_pool import PagePoolExhausted
+
+        has_chunked = getattr(self.engine, "prefill_tick", None) is not None
+        step_tokens = getattr(self.engine, "step_tokens", None)
         while self._running.is_set():
             # JOIN: fill free slots from the queue between decode steps —
             # block only when the whole batch is idle
             while self._free:
-                req = self.queue.get(
-                    timeout=0 if self._active else 0.05)
+                busy = self._active or self._prefilling
+                req = self.queue.get(timeout=0 if busy else 0.05)
                 if req is None:
                     break
-                self._admit_one(req)
+                if not self._admit_one(req):
+                    break  # pool saturated this pass; retry next pass
+            if has_chunked and self._prefilling:
+                self._prefill_tick()
+            if not self._active:
+                continue
+            self._shed_expired_active()
             if not self._active:
                 continue
             t0 = time.monotonic()
-            try:
-                # nnlint: disable=NNL101 — the decode loop's one designed
-                # pull: (slots,) tokens must reach host to route/retire
-                toks = np.asarray(self.engine.step())
-            except Exception as e:  # noqa: BLE001 - fail the batch, keep serving
-                err = ServingError(f"decode step failed: {e}")
-                logger.exception("serving %s: decode step failed", self.name)
-                for slot, req in list(self._active.items()):
-                    req.fail(err)
-                    self._record_done(req, failed=True)
-                    self._retire_slot_only(slot)
+            toks = bursts = None
+            stepped = False
+            # bounded retry IN THIS PASS (same reasoning as
+            # _prefill_tick): after a preemption the survivors must
+            # retry the step BEFORE the admit phase restores the victim,
+            # or the two sides ping-pong pages forever with zero decode
+            # progress. min_active=2 — preempting the only runner to
+            # feed itself is that same livelock in one slot.
+            for _ in range(self.engine.slots + 1):
+                try:
+                    if step_tokens is not None:
+                        bursts = step_tokens()  # 1..K tokens per slot
+                    else:
+                        # nnlint: disable=NNL101 — the decode loop's one
+                        # designed pull: (slots,) tokens must reach host
+                        # to route/retire
+                        toks = np.asarray(self.engine.step())
+                    stepped = True
+                    break
+                except PagePoolExhausted:
+                    # a running stream crossed into a page the pool
+                    # cannot supply: evict the slackest victim and retry
+                    # now; if nothing is preemptable the starved stream
+                    # sheds typed rather than OOM-ing the device
+                    if self._preempt_victim(min_active=2):
+                        continue
+                    if self._active:
+                        slot = next(iter(self._active))
+                        req = self._active.pop(slot)
+                        self._fail_mem(req)
+                        self._retire_slot_only(slot)
+                    break
+                except Exception as e:  # noqa: BLE001 - fail batch, keep serving
+                    err = ServingError(f"decode step failed: {e}")
+                    logger.exception("serving %s: decode step failed",
+                                     self.name)
+                    for slot, req in list(self._active.items()):
+                        req.fail(err)
+                        self._record_done(req, failed=True)
+                        self._retire_slot_only(slot)
+                    break
+            if not stepped:
                 continue
             device_s = time.monotonic() - t0
             self.queue.observe_service_time(device_s)
             self.metrics.record_decode_step(len(self._active),
                                             self.engine.slots, device_s)
             for slot, req in list(self._active.items()):
-                tok = int(toks[slot])
-                req.tokens.append(tok)
+                burst = ([int(toks[slot])] if bursts is None
+                         else [int(t) for t in bursts[slot]])
                 req.metrics["device_time_s"] = \
                     req.metrics.get("device_time_s", 0.0) + device_s
-                if self._finished(req, tok):
-                    # RETIRE early: the slot frees this step, not at the
-                    # end of the longest sequence in the batch
-                    self._retire(slot, req,
-                                 early=len(req.tokens) < req.steps)
+                for tok in burst:
+                    req.tokens.append(tok)
+                    if self._finished(req, tok):
+                        # RETIRE early: the slot frees this step, not at
+                        # the end of the longest sequence in the batch —
+                        # surplus burst tokens past eos/steps are
+                        # dropped (cache-consistent: commit already
+                        # advanced past them)
+                        self._retire(slot, req,
+                                     early=len(req.tokens) < req.steps)
+                        break
 
     def _retire_slot_only(self, slot: int) -> None:
         self._active.pop(slot, None)

@@ -1,0 +1,479 @@
+"""Paged KV-cache serving (serving/kv_pool.py + PagedLMEngine).
+
+The properties the paged data plane exists for, each asserted directly:
+
+* parity — the block-table gather/scatter programs are token-exact
+  against the dense engine AND against batch-1 unbatched decode, so
+  paging is purely a memory-layout change;
+* copy-on-write prefix sharing — a registered prefix is mapped, not
+  recomputed, and a sharer's writes never corrupt the other stream;
+* preemption — evict-to-host then restore is byte-exact (the request
+  is paused, never dropped), both directly and through DecodeScheduler
+  under a pool that cannot hold both streams;
+* speculative decode — the draft/verify burst emits the target's own
+  greedy stream for ANY acceptance pattern (all-reject, all-accept,
+  alternating, real drafts), so speculation can change latency only;
+* compile discipline — the chunk size is the only compiled prefill
+  shape, so compile_count is flat across prompt lengths;
+* page lifecycle — every scheduler exit path (retire, close with
+  in-flight work, deadline shed, batch failure) releases through
+  ``engine.release`` and page refcounts reach zero (the NNS_LEAKCHECK
+  ledger asserts the same pairing at the acquire/release sites).
+"""
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.analysis import sanitizer
+from nnstreamer_tpu.serving import (
+    DecodeScheduler,
+    PagedLMEngine,
+    ServingError,
+)
+
+
+@pytest.fixture
+def leakcheck():
+    was = sanitizer.leakcheck_enabled()
+    sanitizer.enable_leakcheck()
+    yield sanitizer
+    if was:
+        # session-level NNS_LEAKCHECK run: re-arm with a clean ledger so
+        # the autouse fixture's baseline stays truthful
+        sanitizer.enable_leakcheck()
+    else:
+        sanitizer.disable_leakcheck()
+        sanitizer.reset_leakcheck()
+
+
+def _tiny():
+    from nnstreamer_tpu.models.lm_serving import tiny
+    from nnstreamer_tpu.models.transformer import init_params
+
+    cfg = tiny.cfg
+    return cfg, init_params(cfg, seed=0)
+
+
+def _dense_baseline(cfg, params, prompt, steps):
+    """Unbatched greedy decode via models/decoding — the stream every
+    paged/speculative configuration must reproduce token-exact."""
+    from nnstreamer_tpu.models.decoding import make_generate
+
+    gen = make_generate(cfg)
+    out = np.asarray(gen(params, np.asarray(prompt)[None, :], steps))
+    return out[0, len(prompt):].tolist()
+
+
+def _decode(engine, slot, prompt, steps):
+    """Drive one slot of a paged engine directly: admit, step to
+    completion, release. Steps the whole batch (other active slots
+    advance too — callers collect their own streams)."""
+    out = [engine.admit(slot, np.asarray(prompt, np.int32), steps)]
+    while len(out) < steps:
+        out.append(int(engine.step()[slot]))
+    engine.release(slot)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# parity — paging is a memory-layout change, not a numerics change
+# ---------------------------------------------------------------------------
+class TestPagedParity:
+    def test_paged_matches_dense_token_exact(self):
+        cfg, params = _tiny()
+        rng = np.random.default_rng(7)
+        p1 = rng.integers(0, cfg.vocab, 11).astype(np.int32)
+        p2 = rng.integers(0, cfg.vocab, 5).astype(np.int32)
+        eng = PagedLMEngine(cfg, params, slots=2, page_size=8, pages=16,
+                            chunk=16, share_prefixes=False)
+        sched = DecodeScheduler(eng, name="parity")
+        try:
+            r1 = sched.submit(p1, steps=9)
+            r2 = sched.submit(p2, steps=4)
+            got1 = np.asarray(r1.result(120)[0]).tolist()
+            got2 = np.asarray(r2.result(120)[0]).tolist()
+        finally:
+            sched.close()
+        assert got1 == _dense_baseline(cfg, params, p1, 9)
+        assert got2 == _dense_baseline(cfg, params, p2, 4)
+        assert eng.pool.used_pages == 0
+
+    def test_slot_churn_does_not_perturb_streams(self):
+        # sequences join/retire mid-flight; block-table reuse across
+        # admissions must not leak state between tenants of a slot
+        cfg, params = _tiny()
+        rng = np.random.default_rng(11)
+        eng = PagedLMEngine(cfg, params, slots=1, page_size=8, pages=8,
+                            chunk=16, share_prefixes=False)
+        for n in (3, 17, 9):
+            p = rng.integers(0, cfg.vocab, n).astype(np.int32)
+            assert _decode(eng, 0, p, 6) == \
+                _dense_baseline(cfg, params, p, 6)
+
+    def test_compile_count_flat_across_prompt_lengths(self):
+        # the chunk size is the ONLY compiled prefill shape: arbitrary
+        # prompt lengths reuse the same executables (the dense engine
+        # compiles once per distinct length — the NNL008 churn)
+        cfg, params = _tiny()
+        rng = np.random.default_rng(13)
+        eng = PagedLMEngine(cfg, params, slots=1, page_size=8, pages=8,
+                            chunk=16, share_prefixes=False)
+        p = rng.integers(0, cfg.vocab, 4).astype(np.int32)
+        _decode(eng, 0, p, 3)
+        frozen = eng.compile_count
+        for n in (1, 7, 16, 23, 40):
+            p = rng.integers(0, cfg.vocab, n).astype(np.int32)
+            _decode(eng, 0, p, 3)
+        assert eng.compile_count == frozen, \
+            "prompt length must not be a compiled shape"
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write prefix sharing
+# ---------------------------------------------------------------------------
+class TestPrefixSharing:
+    def test_shared_prefix_hits_and_streams_stay_isolated(self):
+        cfg, params = _tiny()
+        rng = np.random.default_rng(17)
+        prefix = rng.integers(0, cfg.vocab, 16).astype(np.int32)  # 2 pages
+        t1 = rng.integers(0, cfg.vocab, 4).astype(np.int32)
+        t2 = rng.integers(0, cfg.vocab, 6).astype(np.int32)
+        p1 = np.concatenate([prefix, t1])
+        p2 = np.concatenate([prefix, t2])
+        eng = PagedLMEngine(cfg, params, slots=2, page_size=8, pages=16,
+                            chunk=16, share_prefixes=True)
+        # first tenant registers the prefix's full pages on prefill
+        # completion; the second maps them instead of recomputing
+        out1 = [eng.admit(0, p1, 8)]
+        assert eng.pool.stats()["prefix_hits_total"] == 0
+        out2 = [eng.admit(1, p2, 8)]
+        assert eng.pool.stats()["prefix_hits_total"] >= 1
+        assert eng.pool.shared_pages >= 2
+        while len(out1) < 8:
+            tok = eng.step()
+            out1.append(int(tok[0]))
+            out2.append(int(tok[1]))
+        assert out1 == _dense_baseline(cfg, params, p1, 8)
+        assert out2 == _dense_baseline(cfg, params, p2, 8)
+        eng.release(0)
+        eng.release(1)
+        # registry still holds its refs; closing drops them
+        eng.close()
+        assert eng.pool.used_pages == 0
+
+    def test_sharer_writes_never_corrupt_the_registered_pages(self):
+        # page-aligned prompt: the LAST prompt page is registered and
+        # shared, and the sharer's first decode write lands exactly one
+        # position past it — COW must keep the registered page immutable
+        cfg, params = _tiny()
+        rng = np.random.default_rng(19)
+        prompt = rng.integers(0, cfg.vocab, 16).astype(np.int32)
+        eng = PagedLMEngine(cfg, params, slots=2, page_size=8, pages=16,
+                            chunk=16, share_prefixes=True)
+        base = _dense_baseline(cfg, params, prompt, 10)
+        out1 = [eng.admit(0, prompt, 10)]
+        out2 = [eng.admit(1, prompt, 10)]  # identical prompt: full hit
+        assert eng.pool.stats()["prefix_hits_total"] >= 1
+        while len(out1) < 10:
+            tok = eng.step()
+            out1.append(int(tok[0]))
+            out2.append(int(tok[1]))
+        # both streams must equal the baseline: if either slot's decode
+        # writes had landed in a shared page, the OTHER stream diverges
+        assert out1 == base
+        assert out2 == base
+        eng.release(0)
+        eng.release(1)
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# preemption — evict to host, restore byte-exact, never drop
+# ---------------------------------------------------------------------------
+class TestPreemptRestore:
+    def test_preempt_restore_byte_exact(self):
+        cfg, params = _tiny()
+        rng = np.random.default_rng(23)
+        p1 = rng.integers(0, cfg.vocab, 9).astype(np.int32)
+        p2 = rng.integers(0, cfg.vocab, 6).astype(np.int32)
+        eng = PagedLMEngine(cfg, params, slots=2, page_size=8, pages=16,
+                            chunk=16, share_prefixes=False)
+        out1 = [eng.admit(0, p1, 12)]
+        out2 = [eng.admit(1, p2, 12)]
+        for _ in range(4):
+            tok = eng.step()
+            out1.append(int(tok[0]))
+            out2.append(int(tok[1]))
+        used_before = eng.pool.used_pages
+        blob = eng.preempt(0)
+        assert eng.pool.used_pages < used_before  # pages actually freed
+        # the survivor keeps decoding while slot 0 sits on the host
+        for _ in range(3):
+            out2.append(int(eng.step()[1]))
+        eng.restore(0, blob)
+        while len(out1) < 12:
+            tok = eng.step()
+            out1.append(int(tok[0]))
+            if len(out2) < 12:
+                out2.append(int(tok[1]))
+        assert out1 == _dense_baseline(cfg, params, p1, 12)
+        assert out2 == _dense_baseline(cfg, params, p2, 12)
+        eng.release(0)
+        eng.release(1)
+        assert eng.pool.used_pages == 0
+
+    def test_tight_pool_preemption_through_scheduler(self):
+        # pool holds ~1.2 streams: the scheduler must preempt a victim
+        # on PagePoolExhausted, finish the other, restore, and finish
+        # the victim — zero memory sheds, zero corrupted tokens
+        cfg, params = _tiny()
+        p1 = (np.arange(1, 14, dtype=np.int32) % 60)
+        p2 = ((np.arange(3, 23, dtype=np.int32) * 7) % 60).astype(np.int32)
+        base1 = _dense_baseline(cfg, params, p1, 20)
+        base2 = _dense_baseline(cfg, params, p2, 10)
+        eng = PagedLMEngine(cfg, params, slots=2, page_size=8, pages=6,
+                            chunk=16, share_prefixes=False)
+        sched = DecodeScheduler(eng, name="tight")
+        try:
+            r1 = sched.submit(p1, steps=20)
+            r2 = sched.submit(p2, steps=10)
+            o1 = np.asarray(r1.result(120)[0]).tolist()
+            o2 = np.asarray(r2.result(120)[0]).tolist()
+            snap = sched.metrics_snapshot()
+        finally:
+            sched.close()
+        assert o1 == base1
+        assert o2 == base2
+        assert snap["preempted"] >= 1, "pool pressure must preempt"
+        assert snap["preempted"] < 50, \
+            f"preempt/restore ping-pong: {snap['preempted']}"
+        assert snap["restored"] == snap["preempted"]
+        assert snap["shed_memory"] == 0, "preemption means never-drop"
+        assert eng.pool.used_pages == 0
+
+
+# ---------------------------------------------------------------------------
+# speculative decode — output identical to target-only for ANY
+# acceptance pattern
+# ---------------------------------------------------------------------------
+class _ScriptDraft:
+    """Oracle-backed draft with a scripted accuracy pattern: proposal i
+    of round r is the TRUE next token when ``correct(r, i)``, else a
+    deliberately wrong one. Drives the verifier through every
+    acceptance count without depending on model behavior."""
+
+    def __init__(self, oracle, correct):
+        self._oracle = oracle  # slot -> full true stream (prompt+emits)
+        self._correct = correct
+        self._round = 0
+
+    def admit(self, slot, tokens, first):
+        pass
+
+    def propose(self, slot, hist, k):
+        truth = self._oracle[slot]
+        r, self._round = self._round, self._round + 1
+        props = []
+        for i in range(k):
+            pos = len(hist) + i
+            true_tok = truth[pos] if pos < len(truth) else 0
+            props.append(true_tok if self._correct(r, i)
+                         else (true_tok + 1) % 64)
+        return props
+
+    def commit(self, slot, emitted):
+        pass
+
+    def release(self, slot):
+        pass
+
+    def restore(self, slot, hist):
+        pass
+
+
+class TestSpeculativeParity:
+    def _spec_stream(self, eng, prompt, steps):
+        out = [eng.admit(0, np.asarray(prompt, np.int32), steps)]
+        while len(out) < steps:
+            out.extend(eng.step_tokens()[0])
+        eng.release(0)
+        return out[:steps]
+
+    @pytest.mark.parametrize("pattern,expected_rate", [
+        (lambda r, i: False, 0.0),        # every proposal rejected
+        (lambda r, i: True, 1.0),         # every proposal accepted
+        (lambda r, i: r % 2 == 0, None),  # alternating rounds
+        (lambda r, i: i == 0, None),      # exactly one accept per round
+    ])
+    def test_scripted_acceptance_patterns_token_exact(self, pattern,
+                                                      expected_rate):
+        from nnstreamer_tpu.serving.speculative import SpeculativeLMEngine
+
+        cfg, params = _tiny()
+        rng = np.random.default_rng(29)
+        prompt = rng.integers(0, cfg.vocab, 7).astype(np.int32)
+        steps = 12
+        base = _dense_baseline(cfg, params, prompt, steps)
+        oracle = {0: [int(t) for t in prompt] + base}
+        target = PagedLMEngine(cfg, params, slots=1, page_size=8,
+                               pages=8, chunk=16, share_prefixes=False)
+        eng = SpeculativeLMEngine(
+            target, _ScriptDraft(oracle, pattern), k=4)
+        assert self._spec_stream(eng, prompt, steps) == base
+        if expected_rate is not None:
+            assert eng.acceptance_rate() == pytest.approx(
+                expected_rate, abs=0.05)
+        eng.close()
+
+    def test_ngram_draft_token_exact(self):
+        from nnstreamer_tpu.serving.speculative import (
+            NgramDraft,
+            SpeculativeLMEngine,
+        )
+
+        cfg, params = _tiny()
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(0, cfg.vocab, 8).astype(np.int32)
+        base = _dense_baseline(cfg, params, prompt, 16)
+        target = PagedLMEngine(cfg, params, slots=1, page_size=8,
+                               pages=8, chunk=16, share_prefixes=False)
+        eng = SpeculativeLMEngine(target, NgramDraft(), k=4)
+        assert self._spec_stream(eng, prompt, 16) == base
+        eng.close()
+
+    def test_model_draft_token_exact_through_scheduler(self):
+        # the full production stack: tiny_draft ModelDraft proposals,
+        # paged target verify, DecodeScheduler burst consumption
+        from nnstreamer_tpu.models.lm_serving import tiny, tiny_draft
+
+        eng = tiny.make_continuous(
+            slots=2, paged=True, draft=tiny_draft, spec_k=4,
+            page_size=8, pages=16, chunk=16, share_prefixes=False)
+        cfg, params = eng.cfg, eng.target.params
+        rng = np.random.default_rng(37)
+        p1 = rng.integers(0, cfg.vocab, 9).astype(np.int32)
+        p2 = rng.integers(0, cfg.vocab, 4).astype(np.int32)
+        sched = DecodeScheduler(eng, name="spec-sched")
+        try:
+            r1 = sched.submit(p1, steps=10)
+            r2 = sched.submit(p2, steps=7)
+            got1 = np.asarray(r1.result(120)[0]).tolist()
+            got2 = np.asarray(r2.result(120)[0]).tolist()
+        finally:
+            sched.close()
+        assert got1 == _dense_baseline(cfg, params, p1, 10)
+        assert got2 == _dense_baseline(cfg, params, p2, 7)
+        assert eng.pool.used_pages == 0
+
+    def test_speculation_survives_preemption(self):
+        # preempt/restore must round-trip the draft's history too: the
+        # restored stream continues token-exact
+        from nnstreamer_tpu.serving.speculative import (
+            NgramDraft,
+            SpeculativeLMEngine,
+        )
+
+        cfg, params = _tiny()
+        rng = np.random.default_rng(41)
+        prompt = rng.integers(0, cfg.vocab, 8).astype(np.int32)
+        steps = 14
+        base = _dense_baseline(cfg, params, prompt, steps)
+        target = PagedLMEngine(cfg, params, slots=1, page_size=8,
+                               pages=8, chunk=16, share_prefixes=False)
+        eng = SpeculativeLMEngine(target, NgramDraft(), k=4)
+        out = [eng.admit(0, prompt, steps)]
+        out.extend(eng.step_tokens()[0])
+        blob = eng.preempt(0)
+        assert target.pool.used_pages == 0
+        eng.restore(0, blob)
+        while len(out) < steps:
+            out.extend(eng.step_tokens()[0])
+        assert out[:steps] == base
+        eng.release(0)
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# page lifecycle — refcounts reach zero on EVERY scheduler exit path
+# ---------------------------------------------------------------------------
+class TestPageLifecycle:
+    def _engine(self, slots=2, pages=16):
+        cfg, params = _tiny()
+        return cfg, PagedLMEngine(cfg, params, slots=slots, page_size=8,
+                                  pages=pages, chunk=16,
+                                  share_prefixes=False)
+
+    def test_release_on_close_with_inflight_work(self):
+        cfg, eng = self._engine()
+        sched = DecodeScheduler(eng, name="close-leak")
+        p = np.arange(1, 10, dtype=np.int32)
+        reqs = [sched.submit(p, steps=50) for _ in range(2)]
+        # close while decoding: in-flight slots MUST release through
+        # the engine — anything else leaks every page they held
+        sched.close()
+        for r in reqs:
+            with pytest.raises(Exception):
+                r.result(timeout=5.0)
+        assert eng.pool.used_pages == 0
+
+    def test_release_on_deadline_shed(self):
+        cfg, eng = self._engine(slots=1)
+        sched = DecodeScheduler(eng, name="deadline-leak")
+        p = np.arange(1, 8, dtype=np.int32)
+        try:
+            blocker = sched.submit(p, steps=40)
+            # expires while queued behind the blocker (slots=1): shed at
+            # pop time, before any pages were mapped for it
+            late = sched.submit(p, steps=40, deadline_s=0.01)
+            with pytest.raises(Exception):
+                late.result(timeout=30.0)
+            blocker.result(timeout=120.0)
+            assert sched.metrics_snapshot()["shed_deadline"] >= 1
+        finally:
+            sched.close()
+        assert eng.pool.used_pages == 0
+
+    def test_release_on_batch_failure(self):
+        cfg, eng = self._engine(slots=1)
+        sched = DecodeScheduler(eng, name="fail-leak")
+        orig_step = eng.step
+
+        def boom():
+            raise ServingError("injected device fault")
+
+        p = np.arange(1, 8, dtype=np.int32)
+        try:
+            eng.step = boom
+            req = sched.submit(p, steps=10)
+            with pytest.raises(Exception):
+                req.result(timeout=30.0)
+        finally:
+            eng.step = orig_step
+            sched.close()
+        assert eng.pool.used_pages == 0, \
+            "batch failure must still release the slot's pages"
+
+    def test_leak_ledger_pairs_pool_acquire_release(self, leakcheck):
+        # runtime twin of the `# pairs-with:` comments in kv_pool.py:
+        # a full admit/decode/release cycle leaves zero outstanding
+        # kv_page acquisitions in the sanitizer ledger
+        cfg, eng = self._engine(slots=1, pages=8)
+        sanitizer.reset_leakcheck()
+        p = np.arange(1, 12, dtype=np.int32)
+        _decode(eng, 0, p, 6)
+        assert eng.pool.used_pages == 0
+        assert sanitizer.outstanding("kv_page") == []
+        rep = sanitizer.leak_report()
+        assert rep["enabled"] and rep["outstanding_units"] == 0
+
+    def test_leak_ledger_flags_held_pages(self, leakcheck):
+        # negative control: a slot still active IS an outstanding
+        # acquisition — the ledger must see it (otherwise the positive
+        # test above proves nothing)
+        cfg, eng = self._engine(slots=1, pages=8)
+        sanitizer.reset_leakcheck()
+        p = np.arange(1, 12, dtype=np.int32)
+        eng.admit(0, p, 6)
+        assert sanitizer.outstanding("kv_page"), \
+            "active slot's pages must show in the ledger"
+        eng.release(0)
+        assert sanitizer.outstanding("kv_page") == []

@@ -302,6 +302,47 @@ class TestMemoryAdmission:
                 r.result(timeout=1.0)
         assert guard.inflight_bytes == 0
 
+    def test_paged_page_reservation_and_refcounts_drain(self):
+        # paged serving reserves PAGES against the guard (the resource
+        # that actually runs out), and _release_mem fires on every exit
+        # path — so the guard ledger and the pool refcounts must drain
+        # TOGETHER: zero inflight bytes, zero held pages, every page
+        # refcount back to zero
+        from nnstreamer_tpu.models.lm_serving import tiny
+        from nnstreamer_tpu.models.transformer import init_params
+        from nnstreamer_tpu.serving import DecodeScheduler, PagedLMEngine
+
+        cfg = tiny.cfg
+        eng = PagedLMEngine(cfg, init_params(cfg, seed=0), slots=2,
+                            page_size=8, pages=16, chunk=16,
+                            share_prefixes=False)
+        # 9-token prompt + 7 steps = 16 positions = 2 pages per request;
+        # budget 4 pages -> exactly two requests fit under the watermark
+        guard = obs_memory.AdmissionGuard(
+            budget_bytes=eng.page_bytes * 4, watermark=1.0,
+            overhead=1.0, name="pages")
+        sched = DecodeScheduler(eng, name="mem-paged",
+                                memory_guard=guard)
+        prompt = np.arange(1, 10, dtype=np.int32)
+        done, shed = [], 0
+        try:
+            for _ in range(6):
+                try:
+                    done.append(sched.submit(prompt, steps=7))
+                except MemoryPressureError:
+                    shed += 1
+            assert shed > 0, "flood past the page budget must shed"
+            assert len(done) == 2
+            assert guard.inflight_bytes == 2 * 2 * eng.page_bytes
+            for r in done:
+                r.result(timeout=120.0)
+        finally:
+            sched.close()
+        assert guard.inflight_bytes == 0
+        assert eng.pool.used_pages == 0
+        assert all(eng.pool.refcount(p) == 0
+                   for p in range(1, eng.pool.pages + 1))
+
     def test_no_guard_no_change(self):
         sched = Scheduler(fn=lambda x: x * 2, bucket_sizes=(1,),
                           name="mem-off")
